@@ -1,0 +1,23 @@
+"""cuda_knearests_tpu: a TPU-native k-nearest-neighbors framework.
+
+A ground-up JAX/XLA/Pallas redesign with the capabilities of
+``ssloy/cuda_knearests`` (see SURVEY.md): uniform-grid spatial hash, supercell-
+tiled kNN solve with provable completeness certificates, exact C++ kd-tree
+oracle, and -- beyond the reference -- multi-chip grid-slab sharding with ICI
+halo exchange.
+"""
+
+from .api import KnnProblem, knn
+from .config import DEFAULT_CELL_DENSITY, DEFAULT_K, DOMAIN_SIZE, KnnConfig
+from .ops.gridhash import GridHash, build_grid, cell_coords, cell_ids, \
+    unpermute_neighbors
+from .ops.solve import KnnResult, brute_force_by_index, build_plan, solve
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "KnnProblem", "knn", "KnnConfig", "KnnResult", "GridHash",
+    "build_grid", "build_plan", "solve", "brute_force_by_index",
+    "cell_coords", "cell_ids", "unpermute_neighbors",
+    "DOMAIN_SIZE", "DEFAULT_K", "DEFAULT_CELL_DENSITY",
+]

@@ -1,0 +1,141 @@
+"""Engine API: the idiomatic equivalent of the reference's ``kn_*`` C surface.
+
+Reference parity (C1, /root/reference/knearests.h:21-29, impl knearests.cu:235-466):
+
+  =======================  ==============================================
+  reference                this framework
+  =======================  ==============================================
+  ``kn_prepare(pts, n)``   ``KnnProblem.prepare(points, config)``
+  ``kn_solve(kn)``         ``problem.solve()``
+  ``kn_get_points``        ``problem.get_points()``
+  ``kn_get_knearests``     ``problem.get_knearests()`` (sorted indexing)
+  ``kn_get_permutation``   ``problem.get_permutation()``
+  ``kn_print_stats``       ``problem.print_stats()``
+  ``kn_free``              (garbage collection -- no manual lifetime)
+  =======================  ==============================================
+
+Beyond parity: ``k`` is a runtime argument instead of a compile-time macro
+(params.h:4), results carry per-query completeness certificates, and uncertified
+queries are resolved exactly by a brute-force fallback pass, so the final answer
+is exact -- not "exact assuming the ring budget sufficed" like the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .config import KnnConfig
+from .ops.gridhash import GridHash, build_grid, unpermute_neighbors
+from .ops.solve import (KnnResult, SolvePlan, brute_force_by_index, build_plan,
+                        solve)
+from .utils import stats as _stats
+
+
+def _pad_pow2(x: np.ndarray, fill: int, minimum: int = 8) -> np.ndarray:
+    m = max(minimum, 1 << (int(x.size) - 1).bit_length()) if x.size else minimum
+    out = np.full((m,), fill, x.dtype)
+    out[: x.size] = x
+    return out
+
+
+@dataclasses.dataclass
+class KnnProblem:
+    """One prepared all-points kNN problem (reference analog: struct kn_problem,
+    /root/reference/knearests.h:3-16)."""
+
+    grid: GridHash
+    config: KnnConfig
+    plan: Optional[SolvePlan] = None
+    result: Optional[KnnResult] = None
+
+    @classmethod
+    def prepare(cls, points, config: KnnConfig | None = None,
+                dim: int | None = None) -> "KnnProblem":
+        """Stage points, build the spatial hash and the supercell schedule.
+
+        Like kn_prepare (knearests.cu:235-344), input points must already satisfy
+        the [0, domain]^3 contract (io.normalize_points enforces it).
+        """
+        config = config or KnnConfig()
+        grid = build_grid(np.asarray(points, np.float32), dim=dim,
+                          density=config.density)
+        plan = build_plan(grid, config)
+        return cls(grid=grid, config=config, plan=plan)
+
+    def solve(self) -> KnnResult:
+        """Run the grid solve, then resolve uncertified queries exactly
+        (reference analog: kn_solve, knearests.cu:348-392)."""
+        res = solve(self.grid, self.config, self.plan)
+        if self.config.fallback == "brute":
+            res = self._resolve_uncertified(res)
+        self.result = res
+        return res
+
+    def _resolve_uncertified(self, res: KnnResult) -> KnnResult:
+        cert = np.asarray(jax.device_get(res.certified))
+        bad = np.nonzero(~cert)[0].astype(np.int32)
+        if bad.size == 0:
+            return res
+        # Pad to a power of two so repeated solves reuse a handful of compiles.
+        q_idx = _pad_pow2(bad, fill=-1)
+        b_ids, b_d2 = brute_force_by_index(
+            self.grid.points, jax.numpy.asarray(q_idx), self.config.k,
+            self.config.exclude_self)
+        safe = np.where(q_idx >= 0, q_idx, self.grid.n_points)
+        neighbors = res.neighbors.at[safe].set(b_ids, mode="drop")
+        dists = res.dists_sq.at[safe].set(b_d2, mode="drop")
+        certified = res.certified.at[safe].set(True, mode="drop")
+        return KnnResult(neighbors=neighbors, dists_sq=dists, certified=certified)
+
+    # -- result extraction (reference: kn_get_*, knearests.cu:406-437) ----------
+
+    def get_points(self) -> np.ndarray:
+        """Points in sorted (grid) order, like kn_get_points (knearests.cu:406)."""
+        return np.asarray(jax.device_get(self.grid.points))
+
+    def get_permutation(self) -> np.ndarray:
+        """sorted position -> original index, like kn_get_permutation
+        (knearests.cu:430)."""
+        return np.asarray(jax.device_get(self.grid.permutation))
+
+    def get_knearests(self) -> np.ndarray:
+        """(n, k) neighbor ids in *sorted* indexing, ascending by distance --
+        the reference's output contract (knearests.cu:141-147,420)."""
+        self._require_solved()
+        return np.asarray(jax.device_get(self.result.neighbors))
+
+    def get_knearests_original(self) -> np.ndarray:
+        """(n, k) neighbor table re-expressed in original point ids -- the
+        un-permute step the reference leaves to its caller
+        (test_knearests.cu:155-160)."""
+        self._require_solved()
+        return np.asarray(jax.device_get(
+            unpermute_neighbors(self.grid, self.result.neighbors)))
+
+    def get_dists_sq(self) -> np.ndarray:
+        self._require_solved()
+        return np.asarray(jax.device_get(self.result.dists_sq))
+
+    def print_stats(self):
+        """Occupancy histogram + certification + memory (reference:
+        kn_print_stats, knearests.cu:440-466)."""
+        return _stats.print_stats(self)
+
+    def stats(self):
+        return _stats.problem_stats(self)
+
+    def _require_solved(self) -> None:
+        if self.result is None:
+            raise RuntimeError("call solve() first")
+
+
+def knn(points, k: int = 10, config: KnnConfig | None = None) -> np.ndarray:
+    """One-call convenience: exact all-points kNN in original indexing."""
+    cfg = dataclasses.replace(config or KnnConfig(), k=k)
+    problem = KnnProblem.prepare(points, cfg)
+    problem.solve()
+    return problem.get_knearests_original()

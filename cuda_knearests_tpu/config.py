@@ -1,0 +1,95 @@
+"""Runtime-first configuration for the TPU kNN engine.
+
+The reference keeps its whole configuration in compile-time macros
+(``/root/reference/params.h:3-6``: ``DEFAULT_NB_PLANES 50`` = k baked into kernel
+shared-memory shapes, ``POINTS_PER_BLOCK 32``) plus hard-coded grid constants inside
+``kn_prepare`` (``/root/reference/knearests.cu:249,254``: density target 3.1 points
+per cell, ring budget ``KN_global_stack_size = 16``).  Here every one of those knobs
+is a first-class runtime parameter; ``k`` and the tile sizes are *static for a given
+compile* (XLA needs static shapes) but freely chosen per problem.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+
+# The reference's domain contract: all points must lie in [0, 1000]^3
+# (/root/reference/knearests.cu:21 "it is supposed that all points fit in range
+# [0,1000]^3").  We keep the same contract; io.normalize_points enforces it.
+DOMAIN_SIZE = 1000.0
+
+# Average points-per-cell target used to size the grid, same constant as the
+# reference (/root/reference/knearests.cu:249: dim = (N/3.1)^(1/3)).
+DEFAULT_CELL_DENSITY = 3.1
+
+# Default k matches the reference's DEFAULT_NB_PLANES (/root/reference/params.h:4).
+DEFAULT_K = 50
+
+
+def grid_dim_for(n_points: int, density: float = DEFAULT_CELL_DENSITY) -> int:
+    """Cells per axis for a cubic grid with ~`density` points per cell.
+
+    Mirrors /root/reference/knearests.cu:249-252 (``round((N/3.1)^(1/3))``) but
+    without the reference's hard ``dim >= 16`` exit (knearests.cu:254-258): small
+    point sets simply get a small grid (min 1 cell per axis).
+    """
+    return max(1, int(round((n_points / density) ** (1.0 / 3.0))))
+
+
+def default_ring_radius(k: int, density: float = DEFAULT_CELL_DENSITY) -> int:
+    """Ring radius (in cells) expected to certify most queries for a given k.
+
+    The expected k-th neighbor radius for a uniform point process with `density`
+    points per cell of width w is ``w * (3k / (4 pi density))^(1/3)``.  A query in
+    the interior of a supercell dilated by R cells is certified once its k-th
+    distance is below its margin to the dilated box, which is at least R cell
+    widths.  One extra cell of slack keeps the uncertified-fallback fraction tiny.
+    """
+    r_expect = (3.0 * k / (4.0 * math.pi * density)) ** (1.0 / 3.0)
+    return max(1, int(math.ceil(r_expect)) + 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class KnnConfig:
+    """All tunables of the engine in one place (reference analog: params.h).
+
+    Attributes:
+      k: neighbors per query (reference: DEFAULT_NB_PLANES=50, compile-time only).
+      density: grid sizing target, avg points/cell (reference: 3.1 hard-coded).
+      ring_radius: candidate dilation radius in cells around each supercell; the
+        functional analog of the reference's ring-expanding traversal budget
+        (knearests.cu:254 Nmax=16).  None -> derived from k via
+        default_ring_radius().
+      supercell: query-tile side length in cells.  Queries in the same supercell
+        share one gathered candidate set -- this is the TPU replacement for the
+        reference's one-thread-per-point divergent traversal (knearests.cu:93-148).
+      sc_batch: how many supercells one jitted chunk processes (bounds peak memory).
+      dist_method: 'diff' = sum((a-b)^2), identical arithmetic to the oracle and to
+        the reference (knearests.cu:125) so single-chip results match exactly;
+        'dot' = |a|^2+|b|^2-2ab via batched matmul on the MXU (fast path, may
+        reorder near-ties).
+      exclude_self: drop the query point itself *by storage index*, matching the
+        reference's ``if (ptr == point_in) continue`` (knearests.cu:123) --
+        coordinate duplicates of the query are still reported.
+      fallback: resolve uncertified queries exactly by tiled brute force ('brute'),
+        or leave them best-effort ('none').
+      interpret: run Pallas kernels in interpreter mode (CPU testing).
+    """
+
+    k: int = DEFAULT_K
+    density: float = DEFAULT_CELL_DENSITY
+    ring_radius: Optional[int] = None
+    supercell: int = 4
+    sc_batch: int = 64
+    dist_method: str = "diff"
+    exclude_self: bool = True
+    fallback: str = "brute"
+    interpret: bool = False
+
+    def resolved_ring_radius(self) -> int:
+        if self.ring_radius is not None:
+            return max(1, int(self.ring_radius))
+        return default_ring_radius(self.k, self.density)

@@ -1,0 +1,132 @@
+"""Point-cloud I/O, normalization, and synthetic dataset generators.
+
+Reference parity (component C11/C15 in SURVEY.md):
+  * ``.xyz`` text format: line 1 = point count, then ``x y z`` per line
+    (/root/reference/test_knearests.cu:40-62).
+  * Normalization into the engine's ``[0, 1000]^3`` domain contract, preserving
+    aspect ratio and padding the bbox slightly so no point sits exactly on the
+    boundary (/root/reference/test_knearests.cu:15-38,65-78).
+  * Synthetic generators regenerate the datasets the reference references but does
+    not ship (``pts300K.xyz``, ``300k_blue_cube.xyz``, ``900k_blue_cube.xyz`` --
+    /root/reference/.MISSING_LARGE_BLOBS:1-3): uniform random and blue-noise
+    (dart-throwing via grid-jitter) samplers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+from .config import DOMAIN_SIZE
+
+
+def load_xyz(path: str) -> np.ndarray:
+    """Parse an .xyz file -> float32 array (n, 3).
+
+    Format per /root/reference/test_knearests.cu:48-62: first line is the point
+    count, each following line has three floats.  Raises on count mismatch (the
+    reference uses ``assert``, test_knearests.cu:62).
+    """
+    with open(path, "r") as f:
+        first = f.readline().split()
+        n = int(first[0])
+        data = np.loadtxt(f, dtype=np.float32)
+    data = np.atleast_2d(data)[:, :3].astype(np.float32)
+    if data.shape[0] != n:
+        raise ValueError(f"{path}: header says {n} points, found {data.shape[0]}")
+    return np.ascontiguousarray(data)
+
+
+def save_xyz(path: str, points: np.ndarray) -> None:
+    """Write points in the reference's .xyz format (count header + rows)."""
+    points = np.asarray(points, dtype=np.float32)
+    with open(path, "w") as f:
+        f.write(f"{points.shape[0]}\n")
+        np.savetxt(f, points, fmt="%.9g")
+
+
+def bbox(points: np.ndarray, pad_fraction: float = 0.001) -> Tuple[np.ndarray, np.ndarray]:
+    """Axis-aligned bounding box padded by `pad_fraction` of its max side.
+
+    Mirrors get_bbox (/root/reference/test_knearests.cu:15-38), which pads by
+    0.1% of the largest side so normalized points land strictly inside the domain.
+    """
+    points = np.asarray(points)
+    lo = points.min(axis=0).astype(np.float64)
+    hi = points.max(axis=0).astype(np.float64)
+    pad = float((hi - lo).max()) * pad_fraction
+    return lo - pad, hi + pad
+
+
+def normalize_points(points: np.ndarray, domain: float = DOMAIN_SIZE) -> np.ndarray:
+    """Rescale so the longest bbox side maps to [0, domain], preserving aspect.
+
+    Engine-domain contract enforcement, mirroring
+    /root/reference/test_knearests.cu:65-78.
+    """
+    points = np.asarray(points, dtype=np.float32)
+    lo, hi = bbox(points)
+    scale = domain / float((hi - lo).max())
+    out = (points.astype(np.float64) - lo) * scale
+    return np.ascontiguousarray(out.astype(np.float32))
+
+
+def generate_uniform(n: int, seed: int = 0, domain: float = DOMAIN_SIZE) -> np.ndarray:
+    """n i.i.d. uniform points in [0, domain]^3 (regenerates pts300K-style sets)."""
+    rng = np.random.default_rng(seed)
+    pts = rng.random((n, 3), dtype=np.float64) * domain
+    return pts.astype(np.float32)
+
+
+def generate_blue_noise(n: int, seed: int = 0, domain: float = DOMAIN_SIZE) -> np.ndarray:
+    """~n blue-noise points in [0, domain]^3 (regenerates *_blue_cube.xyz-style sets).
+
+    Grid-jitter stratified sampling: one sample per cell of an m^3 grid
+    (m = ceil(n^(1/3))), uniformly jittered within its cell, then a random subset
+    of exactly n.  This has the blue-noise property that matters for the kNN
+    workload -- near-uniform local density with a minimum-distance tendency, i.e.
+    the grid occupancy histogram is tightly concentrated (cf. SURVEY.md section 5
+    "Statistical sanity").
+    """
+    rng = np.random.default_rng(seed)
+    m = int(np.ceil(n ** (1.0 / 3.0)))
+    cells = m * m * m
+    ijk = np.stack(np.meshgrid(np.arange(m), np.arange(m), np.arange(m), indexing="ij"), axis=-1)
+    ijk = ijk.reshape(cells, 3).astype(np.float64)
+    jitter = rng.random((cells, 3))
+    pts = (ijk + jitter) * (domain / m)
+    keep = rng.permutation(cells)[:n]
+    keep.sort()
+    return pts[keep].astype(np.float32)
+
+
+_GENERATORS = {
+    "pts20K.xyz": lambda: generate_uniform(20626, seed=20),
+    "pts300K.xyz": lambda: generate_uniform(300_000, seed=300),
+    "300k_blue_cube.xyz": lambda: generate_blue_noise(300_000, seed=301),
+    "900k_blue_cube.xyz": lambda: generate_blue_noise(900_000, seed=900),
+}
+
+_REFERENCE_FIXTURES = "/root/reference"
+
+
+def get_dataset(name: str, data_dir: str = "data") -> np.ndarray:
+    """Fetch a named dataset, normalized into the engine domain.
+
+    Resolution order: reference checkout (only pts20K.xyz survives there) ->
+    cached regenerated copy in `data_dir` -> regenerate via _GENERATORS and cache.
+    """
+    ref = os.path.join(_REFERENCE_FIXTURES, name)
+    if os.path.exists(ref):
+        return normalize_points(load_xyz(ref))
+    cached = os.path.join(data_dir, name)
+    if os.path.exists(cached):
+        return normalize_points(load_xyz(cached))
+    if name not in _GENERATORS:
+        raise FileNotFoundError(f"unknown dataset {name!r}")
+    pts = _GENERATORS[name]()
+    os.makedirs(data_dir, exist_ok=True)
+    save_xyz(cached, pts)
+    return normalize_points(pts)

@@ -1,0 +1,3 @@
+from . import gridhash, rings, solve, topk
+
+__all__ = ["gridhash", "rings", "solve", "topk"]

@@ -1,0 +1,130 @@
+"""Deterministic uniform-grid spatial hash build (sort-based counting sort).
+
+Reference parity (C2, /root/reference/knearests.cu:22-60,152-201): the reference
+builds its grid with three CUDA kernels -- ``count`` (atomicAdd histogram),
+``reserve`` (atomicAdd segment allocation, *nondeterministic* segment order), and
+``store`` (atomicAdd scatter recording a permutation).  XLA has no global atomics
+and does not need them: a single stable sort by cell id yields the same CSR layout
+-- sorted points, segment starts, segment counts, and the sorted-position ->
+original-index permutation -- fully deterministically (fixing the reference's
+nondeterministic ``reserve`` ordering, knearests.cu:40-48, flagged in SURVEY.md
+section 2.2).
+
+Cell addressing: like the reference's ``cellFromPoint`` (knearests.cu:22-30),
+points are assumed to lie in ``[0, domain]^3`` and indices are clamped to the
+grid.  Linearization here is ``x + y*dim + z*dim^2`` -- x fastest, z slowest -- so
+that z-slabs of cells are contiguous in the sorted point array, which is what the
+sharded path slices along (parallel/sharded.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..config import DEFAULT_CELL_DENSITY, DOMAIN_SIZE, grid_dim_for
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("points", "permutation", "cell_starts", "cell_counts"),
+    meta_fields=("dim", "domain"),
+)
+@dataclasses.dataclass(frozen=True)
+class GridHash:
+    """CSR grid layout (reference analog: kn_problem, /root/reference/knearests.h:3-16).
+
+    Attributes:
+      points: (n, 3) f32 -- points reordered by cell (ref: d_stored_points).
+      permutation: (n,) i32 -- sorted position -> original index (ref: d_permutation).
+      cell_starts: (dim^3,) i32 -- CSR segment start per cell (ref: d_ptrs).
+      cell_counts: (dim^3,) i32 -- points per cell (ref: d_counters).
+      dim: cells per axis (static; ref: kn_problem.dimx/y/z, always cubic).
+      domain: side length of the point domain (static; ref hard-codes 1000).
+    """
+
+    points: jax.Array
+    permutation: jax.Array
+    cell_starts: jax.Array
+    cell_counts: jax.Array
+    dim: int
+    domain: float
+
+    @property
+    def n_points(self) -> int:
+        return self.points.shape[0]
+
+    @property
+    def n_cells(self) -> int:
+        return self.dim ** 3
+
+
+def cell_coords(points: jax.Array, dim: int, domain: float = DOMAIN_SIZE) -> jax.Array:
+    """(n, 3) integer cell coordinates, clamped to the grid.
+
+    Reference: cellFromPoint (/root/reference/knearests.cu:22-30) -- same
+    floor-scale-clamp, but kept as per-axis (i, j, k) rather than immediately
+    linearized, so ring traversal can clamp per axis instead of inheriting the
+    reference's linearized-delta boundary wraparound (SURVEY.md section 2.2).
+    """
+    scaled = points * (dim / domain)
+    return jnp.clip(scaled.astype(jnp.int32), 0, dim - 1)
+
+
+def linearize(coords: jax.Array, dim: int) -> jax.Array:
+    """Linear cell id with x fastest, z slowest: x + dim*(y + dim*z)."""
+    return coords[..., 0] + dim * (coords[..., 1] + dim * coords[..., 2])
+
+
+def cell_ids(points: jax.Array, dim: int, domain: float = DOMAIN_SIZE) -> jax.Array:
+    return linearize(cell_coords(points, dim, domain), dim)
+
+
+@functools.partial(jax.jit, static_argnames=("dim", "domain"))
+def _build(points: jax.Array, dim: int, domain: float) -> GridHash:
+    n = points.shape[0]
+    ncells = dim ** 3
+    cids = cell_ids(points, dim, domain)
+    # Stable argsort keeps same-cell points in input order: deterministic, and the
+    # permutation is exactly the reference's d_permutation contract (sorted
+    # position -> original id, knearests.cu:51-60).
+    order = jnp.argsort(cids, stable=True).astype(jnp.int32)
+    sorted_points = jnp.take(points, order, axis=0)
+    counts = jnp.zeros((ncells,), jnp.int32).at[cids].add(1)
+    starts = jnp.cumsum(counts) - counts  # exclusive prefix sum (deterministic
+    # replacement for the reference's atomicAdd segment allocator, knearests.cu:40-48)
+    return GridHash(points=sorted_points, permutation=order,
+                    cell_starts=starts.astype(jnp.int32),
+                    cell_counts=counts, dim=dim, domain=domain)
+
+
+def build_grid(points: jax.Array, dim: int | None = None,
+               density: float = DEFAULT_CELL_DENSITY,
+               domain: float = DOMAIN_SIZE) -> GridHash:
+    """Build the spatial hash (reference analog: kn_firstbuild via kn_prepare,
+    /root/reference/knearests.cu:152-201,235-344)."""
+    if dim is None:
+        dim = grid_dim_for(points.shape[0], density)
+    return _build(jnp.asarray(points, jnp.float32), dim=int(dim), domain=float(domain))
+
+
+def unpermute_neighbors(grid: GridHash, neighbors_sorted: jax.Array,
+                        fill: int = -1) -> jax.Array:
+    """Translate a (n, k) neighbor table from sorted indexing to original ids.
+
+    The reference's search kernel emits neighbor ids that index the *sorted*
+    point array, and the caller untangles them with the permutation
+    (/root/reference/test_knearests.cu:155-160:
+    ``neighbors[perm[i]*K+j] = perm[knearests[i*K+j]]``).  Same contract here;
+    `fill` (< 0) marks not-found slots (the reference uses UINT_MAX).
+    """
+    valid = neighbors_sorted >= 0
+    mapped = jnp.where(valid,
+                       jnp.take(grid.permutation,
+                                jnp.clip(neighbors_sorted, 0, grid.n_points - 1)),
+                       fill)
+    out = jnp.zeros_like(mapped)
+    return out.at[grid.permutation].set(mapped)

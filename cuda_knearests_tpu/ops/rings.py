@@ -1,0 +1,103 @@
+"""Chebyshev ring traversal schedule with distance lower bounds.
+
+Reference parity (C3, /root/reference/knearests.cu:254-300): the reference
+precomputes, on the host, the linearized offsets of every cell in Chebyshev rings
+0..Nmax-1 around a query cell, each ring carrying a conservative lower bound on
+the squared distance from anywhere in the center cell to that ring
+(``((ring-1) * cell_width)^2``, knearests.cu:278-279).  Ring-ordered traversal +
+that bound gives the provable early exit (knearests.cu:116).
+
+Differences from the reference (deliberate, SURVEY.md section 2.2):
+  * Offsets are kept per-axis ``(di, dj, dk)`` instead of linearized deltas, so
+    grid-boundary handling is an explicit clamp/mask rather than the reference's
+    silent wraparound into adjacent rows/slabs (knearests.cu:119).
+  * The schedule is a static device array usable inside ``lax.while_loop`` /
+    Pallas grids, not a host loop artifact.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+
+class RingSchedule(NamedTuple):
+    """Static traversal schedule for rings 0..nmax-1.
+
+    offsets:    (m, 3) i32 -- (di, dj, dk) per candidate cell, ring-major order.
+    ring_of:    (m,) i32   -- Chebyshev ring of each offset.
+    ring_start: (nmax+1,) i32 -- offsets of ring r live at [ring_start[r], ring_start[r+1]).
+    """
+
+    offsets: np.ndarray
+    ring_of: np.ndarray
+    ring_start: np.ndarray
+
+    @property
+    def nmax(self) -> int:
+        return len(self.ring_start) - 1
+
+
+def ring_schedule(nmax: int) -> RingSchedule:
+    """All (2*nmax-1)^3 cell offsets around a center cell, ordered by ring.
+
+    Ring 0 is the center cell itself; ring r (1 <= r < nmax) is the Chebyshev
+    shell ``max(|di|,|dj|,|dk|) == r`` (reference loop at knearests.cu:263-287).
+    Within a ring, order is lexicographic (deterministic).
+    """
+    if nmax < 1:
+        raise ValueError("nmax must be >= 1")
+    r = np.arange(-(nmax - 1), nmax, dtype=np.int32)
+    di, dj, dk = np.meshgrid(r, r, r, indexing="ij")
+    offs = np.stack([di.ravel(), dj.ravel(), dk.ravel()], axis=1)
+    ring = np.abs(offs).max(axis=1).astype(np.int32)
+    # stable sort by ring keeps lexicographic order within each shell
+    order = np.argsort(ring, kind="stable")
+    offs, ring = offs[order], ring[order]
+    ring_start = np.searchsorted(ring, np.arange(nmax + 1), side="left").astype(np.int32)
+    return RingSchedule(offsets=np.ascontiguousarray(offs),
+                        ring_of=np.ascontiguousarray(ring),
+                        ring_start=ring_start)
+
+
+def ring_lower_bounds_sq(nmax: int, cell_width: float) -> np.ndarray:
+    """(nmax,) f32 -- conservative min squared distance from any point in the
+    center cell to any point of ring r.
+
+    A point anywhere in the center cell is at least ``(r-1) * cell_width`` away
+    from every cell of ring r (0 for rings 0 and 1) -- the same bound the
+    reference uses (knearests.cu:278-279).  Non-decreasing in r by construction,
+    which is what makes "kth_best < bound(r)" a valid stopping rule.
+    """
+    r = np.arange(nmax, dtype=np.float64)
+    d = np.maximum(r - 1.0, 0.0) * cell_width
+    return (d * d).astype(np.float32)
+
+
+def box_margin_bound_sq(query: np.ndarray, lo: np.ndarray, hi: np.ndarray,
+                        domain: float) -> np.ndarray:
+    """Squared distance from each query to the *complement* of box [lo, hi].
+
+    Used to certify supercell-tiled results: every un-gathered point lies outside
+    the dilated candidate box, hence at distance >= the query's margin to the box
+    boundary.  Sides of the box at or beyond the domain boundary contribute no
+    constraint (all points live in [0, domain]^3).  Pure-numpy twin of the jnp
+    version in ops/solve.py, kept for tests.
+    """
+    margins = []
+    for ax in range(3):
+        m_lo = np.where(lo[..., ax] <= 0.0, np.inf, query[..., ax] - lo[..., ax])
+        m_hi = np.where(hi[..., ax] >= domain, np.inf, hi[..., ax] - query[..., ax])
+        margins.append(np.minimum(m_lo, m_hi))
+    m = np.maximum(np.minimum.reduce(margins), 0.0)
+    return np.where(np.isinf(m), np.inf, m * m)
+
+
+def dilated_box(sc_coord: Tuple[int, int, int], supercell: int, radius: int,
+                dim: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Cell-coordinate bounds [lo, hi) of a supercell dilated by `radius` cells,
+    clamped to the grid."""
+    lo = np.maximum(np.asarray(sc_coord) * supercell - radius, 0)
+    hi = np.minimum(np.asarray(sc_coord) * supercell + supercell + radius, dim)
+    return lo.astype(np.int32), hi.astype(np.int32)

@@ -1,0 +1,319 @@
+"""All-points k-nearest-neighbor solve over the uniform grid (TPU-first).
+
+Reference parity (C4, /root/reference/knearests.cu:93-148,348-392): the reference
+launches one CUDA thread per query point, each walking precomputed Chebyshev ring
+offsets with a shared-memory max-heap and a divergent per-thread early exit.
+
+The TPU design replaces per-thread divergence with *supercell tiling*:
+
+  1. Queries are grouped by supercell (a tile of ``s^3`` grid cells).  Every query
+     in a supercell shares one candidate set -- the supercell dilated by the ring
+     radius R -- so the candidate gather is amortized and the distance computation
+     becomes a dense, static-shape ``(Q, C)`` tile that XLA maps onto the VPU/MXU.
+  2. The reference's per-thread early-exit bound (knearests.cu:116) becomes a
+     per-query *completeness certificate*: a query is certified iff its k-th
+     neighbor distance is within its margin to the dilated box boundary, so every
+     un-gathered point is provably farther.  The reference's racy "max visited
+     ring" telemetry (SURVEY.md section 2.2) thus becomes an exact guarantee.
+  3. Uncertified stragglers (typically <<1%) are resolved exactly by a tiled
+     brute-force pass (api.py drives this).
+
+All shapes are static per (dataset, config): capacities are measured on the host
+from the grid occupancy at plan time, the analog of kn_prepare's host-side setup
+(/root/reference/knearests.cu:235-344).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import KnnConfig
+from .gridhash import GridHash
+from .topk import INVALID_ID, init_topk, masked_topk, merge_topk
+
+_FAR = 1.0e30  # padding coordinate; squared distances to it dwarf any real pair
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("own_cells", "cand_cells", "box_lo", "box_hi"),
+    meta_fields=("qcap", "ccap", "n_chunks", "batch"),
+)
+@dataclasses.dataclass(frozen=True)
+class SolvePlan:
+    """Static supercell schedule, built host-side at prepare time.
+
+    own_cells:  (n_chunks, batch, s^3) i32 linear cell ids per supercell (-1 pad).
+    cand_cells: (n_chunks, batch, (s+2R)^3) i32 dilated-box cell ids (-1 pad).
+    box_lo/hi:  (n_chunks, batch, 3) f32 dilated-box corners in domain coordinates.
+    qcap/ccap:  static per-supercell query / candidate capacities (measured maxima).
+    """
+
+    own_cells: jax.Array
+    cand_cells: jax.Array
+    box_lo: jax.Array
+    box_hi: jax.Array
+    qcap: int
+    ccap: int
+    n_chunks: int
+    batch: int
+
+
+@dataclasses.dataclass(frozen=True)
+class KnnResult:
+    """neighbors/dists are in *sorted* point indexing, like the reference's
+    g_knearests output (knearests.cu:141-147); translate with
+    gridhash.unpermute_neighbors.  ``certified`` marks queries whose result is
+    proven complete by the box-margin bound."""
+
+    neighbors: np.ndarray | jax.Array  # (n, k) i32, ascending by distance
+    dists_sq: np.ndarray | jax.Array   # (n, k) f32
+    certified: np.ndarray | jax.Array  # (n,) bool
+
+
+def _boxes_grid(n_sc: int) -> np.ndarray:
+    """(n_sc^3, 3) supercell integer coordinates, x fastest (matches linearize)."""
+    r = np.arange(n_sc, dtype=np.int32)
+    zz, yy, xx = np.meshgrid(r, r, r, indexing="ij")
+    return np.stack([xx.ravel(), yy.ravel(), zz.ravel()], axis=1)
+
+
+def _box_cell_ids(sc_coords: np.ndarray, lo_off: int, hi_off: int, s: int,
+                  dim: int) -> np.ndarray:
+    """Linear cell ids of box [sc*s+lo_off, sc*s+s+hi_off) per supercell, -1 where
+    the box exceeds the grid.  Returns (num_sc, (s+hi_off-lo_off)^3) i32."""
+    side = s + hi_off - lo_off
+    offs = np.arange(lo_off, s + hi_off, dtype=np.int32)
+    ax = sc_coords[:, :, None] * s + offs[None, None, :]      # (num_sc, 3, side)
+    ok = (ax >= 0) & (ax < dim)
+    axc = np.clip(ax, 0, dim - 1)
+    x, y, z = axc[:, 0], axc[:, 1], axc[:, 2]                  # (num_sc, side)
+    okx, oky, okz = ok[:, 0], ok[:, 1], ok[:, 2]
+    lin = (x[:, None, None, :]
+           + dim * y[:, None, :, None]
+           + dim * dim * z[:, :, None, None])                  # (num_sc, side, side, side)
+    valid = okx[:, None, None, :] & oky[:, None, :, None] & okz[:, :, None, None]
+    out = np.where(valid, lin, -1).reshape(sc_coords.shape[0], side ** 3)
+    return out.astype(np.int32)
+
+
+def _box_sums(counts3: np.ndarray, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    """Sum of per-cell counts over boxes [lo, hi) via a 3D summed-area table.
+    counts3 is (dim,dim,dim) indexed [z,y,x]; lo/hi are (m,3) as (x,y,z)."""
+    dim = counts3.shape[0]
+    sat = np.zeros((dim + 1,) * 3, dtype=np.int64)
+    sat[1:, 1:, 1:] = counts3.cumsum(0).cumsum(1).cumsum(2)
+    lo = np.clip(lo, 0, dim)
+    hi = np.clip(hi, 0, dim)
+    x0, y0, z0 = lo[:, 0], lo[:, 1], lo[:, 2]
+    x1, y1, z1 = hi[:, 0], hi[:, 1], hi[:, 2]
+    s = (sat[z1, y1, x1] - sat[z0, y1, x1] - sat[z1, y0, x1] - sat[z1, y1, x0]
+         + sat[z0, y0, x1] + sat[z0, y1, x0] + sat[z1, y0, x0] - sat[z0, y0, x0])
+    return s
+
+
+def _round_up(x: int, m: int) -> int:
+    return max(m, ((int(x) + m - 1) // m) * m)
+
+
+def build_plan(grid: GridHash, cfg: KnnConfig,
+               cell_counts_host: np.ndarray | None = None) -> SolvePlan:
+    """Host-side supercell schedule (analog of kn_prepare's table precomputation,
+    /root/reference/knearests.cu:254-300, but per-axis and clamped -- no boundary
+    wraparound)."""
+    dim, s = grid.dim, cfg.supercell
+    radius = cfg.resolved_ring_radius()
+    n_sc = -(-dim // s)
+    sc = _boxes_grid(n_sc)
+    num_sc = sc.shape[0]
+
+    counts = (np.asarray(cell_counts_host) if cell_counts_host is not None
+              else np.asarray(jax.device_get(grid.cell_counts)))
+    counts3 = counts.reshape(dim, dim, dim)  # [z, y, x]
+
+    own = _box_cell_ids(sc, 0, 0, s, dim)
+    cand = _box_cell_ids(sc, -radius, radius, s, dim)
+
+    own_n = _box_sums(counts3, sc * s, np.minimum(sc * s + s, dim))
+    cand_n = _box_sums(counts3, sc * s - radius, sc * s + s + radius)
+    qcap = _round_up(own_n.max() if num_sc else 1, 8)
+    ccap = _round_up(cand_n.max() if num_sc else 1, 128)
+
+    w = grid.domain / dim
+    box_lo = ((sc * s - radius) * w).astype(np.float32)
+    box_hi = ((sc * s + s + radius) * w).astype(np.float32)
+
+    batch = max(1, int(cfg.sc_batch))
+    n_chunks = -(-num_sc // batch)
+    pad = n_chunks * batch - num_sc
+
+    def _pad(a: np.ndarray, fill) -> np.ndarray:
+        if pad:
+            a = np.concatenate([a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
+        return a.reshape(n_chunks, batch, *a.shape[1:])
+
+    return SolvePlan(
+        own_cells=jnp.asarray(_pad(own, -1)),
+        cand_cells=jnp.asarray(_pad(cand, -1)),
+        box_lo=jnp.asarray(_pad(box_lo, 0.0)),
+        box_hi=jnp.asarray(_pad(box_hi, 0.0)),
+        qcap=int(qcap), ccap=int(ccap), n_chunks=int(n_chunks), batch=int(batch),
+    )
+
+
+def pack_cells(cells: jax.Array, starts: jax.Array, counts: jax.Array,
+               cap: int) -> Tuple[jax.Array, jax.Array]:
+    """Dense-pack the points of a ragged cell list: CSR -> (B, cap) point indices.
+
+    For each row (a supercell), slot t holds the t-th point across the row's
+    cells in order.  This is a static-shape segmented gather -- the functional
+    replacement for the reference's pointer-chasing over d_ptrs/d_counters in the
+    search kernel's inner loop (knearests.cu:119-134).
+    Returns (indices, valid) with indices clamped to 0 where invalid.
+    """
+    valid_cell = cells >= 0
+    safe = jnp.where(valid_cell, cells, 0)
+    cnt = jnp.where(valid_cell, jnp.take(counts, safe), 0)        # (B, M)
+    cum = jnp.cumsum(cnt, axis=1)
+    off = cum - cnt
+    total = cum[:, -1]
+    slots = jnp.arange(cap, dtype=cnt.dtype)
+    which = jax.vmap(lambda c: jnp.searchsorted(c, slots, side="right"))(cum)
+    which = jnp.clip(which, 0, cells.shape[1] - 1)
+    base = jnp.take_along_axis(jnp.take(starts, safe), which, axis=1)
+    begin = jnp.take_along_axis(off, which, axis=1)
+    idx = base + (slots[None, :] - begin)
+    ok = slots[None, :] < total[:, None]
+    return jnp.where(ok, idx, 0).astype(jnp.int32), ok
+
+
+def _pair_d2(q: jax.Array, c: jax.Array, method: str) -> jax.Array:
+    """(B, Q, 3) x (B, C, 3) -> (B, Q, C) squared distances.
+
+    'diff' uses sum_axis (q-c)^2 with x,y,z accumulation order -- identical
+    arithmetic to the reference kernel (knearests.cu:125) and the C++ oracle, so
+    single-chip results are bit-comparable.  'dot' is the MXU form
+    |q|^2+|c|^2-2qc (fast path; may reorder exact near-ties).
+    """
+    if method == "dot":
+        qq = jnp.sum(q * q, axis=-1)
+        cc = jnp.sum(c * c, axis=-1)
+        qc = jnp.einsum("bqd,bcd->bqc", q, c,
+                        preferred_element_type=jnp.float32)
+        return qq[:, :, None] + cc[:, None, :] - 2.0 * qc
+    d2 = jnp.zeros(q.shape[:2] + (c.shape[1],), jnp.float32)
+    for ax in range(3):
+        diff = q[:, :, None, ax] - c[:, None, :, ax]
+        d2 = d2 + diff * diff
+    return d2
+
+
+def _margin_sq(q: jax.Array, lo: jax.Array, hi: jax.Array,
+               domain: float) -> jax.Array:
+    """Squared margin from each query to the complement of its dilated box.
+
+    Box sides at/beyond the domain boundary are unconstraining (all points live
+    in [0, domain]^3).  jnp twin of rings.box_margin_bound_sq.
+    """
+    m_lo = jnp.where(lo[:, None, :] <= 0.0, jnp.inf, q - lo[:, None, :])
+    m_hi = jnp.where(hi[:, None, :] >= domain, jnp.inf, hi[:, None, :] - q)
+    m = jnp.maximum(jnp.minimum(m_lo, m_hi).min(axis=-1), 0.0)
+    return jnp.where(jnp.isinf(m), jnp.inf, m * m)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "dist_method", "exclude_self",
+                                             "domain"))
+def _solve_planned(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                   plan: SolvePlan, k: int, dist_method: str, exclude_self: bool,
+                   domain: float):
+    n = points.shape[0]
+    out_d = jnp.full((n, k), jnp.inf, jnp.float32)
+    out_i = jnp.full((n, k), INVALID_ID, jnp.int32)
+    out_cert = jnp.zeros((n,), bool)
+
+    def step(carry, chunk):
+        out_d, out_i, out_cert = carry
+        own, cand, lo, hi = chunk
+        q_idx, q_valid = pack_cells(own, starts, counts, plan.qcap)
+        c_idx, c_valid = pack_cells(cand, starts, counts, plan.ccap)
+        q = jnp.take(points, q_idx, axis=0)
+        c = jnp.take(points, c_idx, axis=0)
+        d2 = _pair_d2(q, c, dist_method)
+        mask = q_valid[:, :, None] & c_valid[:, None, :]
+        if exclude_self:
+            # skip self by *storage index* (knearests.cu:123): coordinate
+            # duplicates of the query are still reported.
+            mask = mask & (c_idx[:, None, :] != q_idx[:, :, None])
+        ids = jnp.broadcast_to(c_idx[:, None, :], d2.shape)
+        best_d, best_i = masked_topk(d2, ids, mask, k)
+        kth = best_d[..., -1]
+        cert = q_valid & (kth <= _margin_sq(q, lo, hi, domain))
+        safe = jnp.where(q_valid, q_idx, n)  # n = out of bounds -> dropped
+        out_d = out_d.at[safe].set(best_d, mode="drop")
+        out_i = out_i.at[safe].set(best_i, mode="drop")
+        out_cert = out_cert.at[safe].set(cert, mode="drop")
+        return (out_d, out_i, out_cert), None
+
+    (out_d, out_i, out_cert), _ = jax.lax.scan(
+        step, (out_d, out_i, out_cert),
+        (plan.own_cells, plan.cand_cells, plan.box_lo, plan.box_hi))
+    return out_i, out_d, out_cert
+
+
+def solve(grid: GridHash, cfg: KnnConfig, plan: SolvePlan | None = None) -> KnnResult:
+    """Grid-accelerated all-points kNN (reference analog: kn_solve,
+    /root/reference/knearests.cu:348-392).  Results are in sorted indexing;
+    uncertified queries are *not* fixed up here -- api.KnnProblem drives the
+    exact fallback."""
+    if plan is None:
+        plan = build_plan(grid, cfg)
+    nbr, d2, cert = _solve_planned(grid.points, grid.cell_starts, grid.cell_counts,
+                                   plan, cfg.k, cfg.dist_method, cfg.exclude_self,
+                                   grid.domain)
+    return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "exclude_self", "tile"))
+def brute_force_by_index(points: jax.Array, q_idx: jax.Array, k: int,
+                         exclude_self: bool = True, tile: int = 8192):
+    """Exact kNN for selected stored points against the full set, tiled.
+
+    Streaming merge_topk over point tiles -- the exact-resolution path for
+    uncertified queries and the small-n reference solver for tests.  q_idx may be
+    padded with -1 (rows ignored).  Returns ((m, k) ids ascending, (m, k) d2) in
+    sorted indexing.
+    """
+    n = points.shape[0]
+    n_pad = -(-n // tile) * tile
+    pts = jnp.concatenate(
+        [points, jnp.full((n_pad - n, 3), _FAR, points.dtype)], axis=0)
+    q_ok = q_idx >= 0
+    q = jnp.take(points, jnp.where(q_ok, q_idx, 0), axis=0)
+
+    ids_all = jnp.arange(n_pad, dtype=jnp.int32)
+
+    def body(carry, inp):
+        best_d, best_i = carry
+        pts_t, ids_t = inp
+        d2 = jnp.zeros((q.shape[0], tile), jnp.float32)
+        for ax in range(3):
+            diff = q[:, None, ax] - pts_t[None, :, ax]
+            d2 = d2 + diff * diff
+        mask = (ids_t[None, :] < n)
+        if exclude_self:
+            mask = mask & (ids_t[None, :] != q_idx[:, None])
+        ids_b = jnp.broadcast_to(ids_t[None, :], d2.shape)
+        return merge_topk(best_d, best_i, d2, ids_b, mask), None
+
+    init = init_topk((q.shape[0],), k)
+    (best_d, best_i), _ = jax.lax.scan(
+        body, init, (pts.reshape(-1, tile, 3), ids_all.reshape(-1, tile)))
+    best_i = jnp.where(q_ok[:, None], best_i, INVALID_ID)
+    best_d = jnp.where(q_ok[:, None], best_d, jnp.inf)
+    return best_i, best_d

@@ -1,0 +1,68 @@
+"""Masked and streaming top-k utilities (smallest-distance semantics).
+
+Reference parity (C4's data structure): the reference maintains a bounded k-max-
+heap per query thread in CUDA shared memory (heapify/heapsort,
+/root/reference/knearests.cu:62-91,95-110) and heapsorts it into an ascending
+neighbor list.  On TPU there is no per-thread mutable heap; the idiomatic
+replacement is ``lax.top_k`` over (masked) candidate tiles, and a concat+top_k
+*merge* for streaming candidates ring-by-ring or tile-by-tile.  Results come out
+ascending (nearest first), matching the reference's post-heapsort output
+(knearests.cu:141-147).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+INVALID_ID = -1  # "not found" sentinel (the reference uses UINT_MAX, knearests.cu:107)
+
+
+def masked_topk(d2: jax.Array, ids: jax.Array, mask: jax.Array,
+                k: int) -> Tuple[jax.Array, jax.Array]:
+    """Smallest-k over the last axis with a validity mask.
+
+    Args:
+      d2:   (..., m) squared distances.
+      ids:  (..., m) candidate ids aligned with d2.
+      mask: (..., m) True where the candidate is real.
+      k:    static neighbor count.
+    Returns:
+      (dists, ids): (..., k) ascending; masked-out / missing slots get
+      +inf / INVALID_ID.
+    """
+    d2 = jnp.where(mask, d2, jnp.inf)
+    neg, slot = jax.lax.top_k(-d2, k)  # top_k is largest-k -> negate for smallest
+    best_d = -neg
+    best_i = jnp.take_along_axis(ids, slot, axis=-1)
+    best_i = jnp.where(jnp.isfinite(best_d), best_i, INVALID_ID)
+    return best_d, best_i
+
+
+def merge_topk(best_d: jax.Array, best_i: jax.Array,
+               new_d: jax.Array, new_i: jax.Array, new_mask: jax.Array,
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Fold a fresh candidate tile into a running ascending top-k.
+
+    The streaming analog of the reference's heap-root replace+sift
+    (knearests.cu:127-133): concat the running best (..., k) with the new tile
+    (..., t), take smallest-k of the union.  Used by the ring-streaming and
+    brute-force-tiled paths.
+    """
+    k = best_d.shape[-1]
+    d2 = jnp.concatenate([best_d, jnp.where(new_mask, new_d, jnp.inf)], axis=-1)
+    ids = jnp.concatenate([best_i, new_i], axis=-1)
+    neg, slot = jax.lax.top_k(-d2, k)
+    out_d = -neg
+    out_i = jnp.take_along_axis(ids, slot, axis=-1)
+    out_i = jnp.where(jnp.isfinite(out_d), out_i, INVALID_ID)
+    return out_d, out_i
+
+
+def init_topk(batch_shape: Tuple[int, ...], k: int) -> Tuple[jax.Array, jax.Array]:
+    """Empty running top-k state: +inf distances, INVALID_ID ids (the reference
+    initializes its heap slots to FLT_MAX / UINT_MAX, knearests.cu:107-110)."""
+    return (jnp.full(batch_shape + (k,), jnp.inf, jnp.float32),
+            jnp.full(batch_shape + (k,), INVALID_ID, jnp.int32))

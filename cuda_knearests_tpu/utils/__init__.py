@@ -1,0 +1,4 @@
+from . import devinfo, memory, stats, stopwatch
+from .stopwatch import Stopwatch, timed
+
+__all__ = ["devinfo", "memory", "stats", "stopwatch", "Stopwatch", "timed"]

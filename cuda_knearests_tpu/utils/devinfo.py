@@ -1,0 +1,53 @@
+"""Device discovery and property dump.
+
+Reference parity (C12, /root/reference/test_knearests.cu:83-115 printDevProp):
+prints every accelerator visible to JAX with the properties that matter for this
+workload (platform, memory, core counts where exposed), plus process/topology info
+the multi-chip path cares about.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+
+
+def device_properties() -> List[Dict[str, Any]]:
+    props = []
+    for d in jax.devices():
+        entry: Dict[str, Any] = {
+            "id": d.id,
+            "platform": d.platform,
+            "device_kind": d.device_kind,
+            "process_index": d.process_index,
+        }
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # not all backends expose memory stats
+            pass
+        if "bytes_limit" in stats:
+            entry["memory_limit_bytes"] = stats["bytes_limit"]
+        if "bytes_in_use" in stats:
+            entry["memory_in_use_bytes"] = stats["bytes_in_use"]
+        core = getattr(d, "core_on_chip", None)
+        if core is not None:
+            entry["core_on_chip"] = core
+        coords = getattr(d, "coords", None)
+        if coords is not None:
+            entry["coords"] = tuple(coords)
+        props.append(entry)
+    return props
+
+
+def print_device_properties() -> None:
+    """Human-readable dump (reference: printDevProp, test_knearests.cu:83-115)."""
+    devs = device_properties()
+    print(f"There are {len(devs)} JAX devices "
+          f"(backend={jax.default_backend()}, processes={jax.process_count()})")
+    for p in devs:
+        print(f"  device {p['id']}: {p['device_kind']} [{p['platform']}]")
+        for key in ("memory_limit_bytes", "memory_in_use_bytes", "coords", "core_on_chip"):
+            if key in p:
+                print(f"    {key}: {p[key]}")

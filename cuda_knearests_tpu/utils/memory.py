@@ -1,0 +1,53 @@
+"""Checked host<->device staging helpers.
+
+Reference parity (C5/C10): the reference wraps every cudaMalloc/Memcpy/Memset in
+checked helpers that report the failing site and abort
+(/root/reference/knearests.cu:205-231), and tracks total device memory used --
+with a ``bufsize +=`` accounting bug that inflates the stat
+(/root/reference/knearests.cu:329,333,342).  JAX owns allocation, so the useful
+equivalents are: validated H2D staging (`to_device`), D2H extraction
+(`from_device`), and *correct* buffer-size accounting for diagnostics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DeviceMemoryError(RuntimeError):
+    """Raised when staging fails validation (analog of the reference's
+    print-and-exit in gpuMalloc*, knearests.cu:205-231 -- but recoverable)."""
+
+
+def to_device(x: np.ndarray, dtype: Any = jnp.float32,
+              sharding: Optional[jax.sharding.Sharding] = None) -> jax.Array:
+    """Validated host->HBM staging (analog of gpuMallocNCopy, knearests.cu:219-226)."""
+    arr = np.asarray(x)
+    if not np.isfinite(arr).all():
+        raise DeviceMemoryError("refusing to stage non-finite data to device")
+    arr = np.ascontiguousarray(arr, dtype=np.dtype(dtype))
+    try:
+        return jax.device_put(arr, sharding)
+    except Exception as e:  # surface the failing site like the reference does
+        raise DeviceMemoryError(f"device_put failed for shape={arr.shape} "
+                                f"dtype={arr.dtype}: {e}") from e
+
+
+def from_device(x: jax.Array) -> np.ndarray:
+    """D2H readback (analog of the kn_get_* D2H copies, knearests.cu:406-437)."""
+    return np.asarray(jax.device_get(x))
+
+
+def nbytes(tree: Any) -> int:
+    """Total bytes of all arrays in a pytree.
+
+    The correct version of the reference's "GPU memory used" stat
+    (knearests.cu:342), whose ``bufsize +=`` bug (knearests.cu:329,333) this
+    framework fixes rather than reproduces (SURVEY.md section 2.2).
+    """
+    leaves = jax.tree.leaves(tree)
+    return int(sum(getattr(l, "nbytes", 0) for l in leaves))

@@ -1,0 +1,73 @@
+"""Diagnostics: grid occupancy, convergence/certification, memory -- as JSON.
+
+Reference parity (C6, /root/reference/knearests.cu:440-466 kn_print_stats and the
+max-ring readback at :378-390): min/max/avg points-per-cell plus a full occupancy
+histogram, and a convergence statistic.  Differences: the reference's "Max
+visited ring" is computed with a data race and an off-by-one (SURVEY.md section
+2.2); here the equivalent quantity is the *certified fraction* -- an exact
+per-query completeness guarantee -- and everything is emitted as a
+machine-readable dict (BASELINE.md wants machine-readable numbers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .memory import nbytes
+
+
+def occupancy_stats(cell_counts: np.ndarray) -> Dict[str, Any]:
+    """Occupancy histogram over grid cells (reference: knearests.cu:440-466)."""
+    counts = np.asarray(cell_counts)
+    vals, freq = np.unique(counts, return_counts=True)
+    return {
+        "num_cells": int(counts.size),
+        "num_points": int(counts.sum()),
+        "min_per_cell": int(counts.min()) if counts.size else 0,
+        "max_per_cell": int(counts.max()) if counts.size else 0,
+        "avg_per_cell": float(counts.mean()) if counts.size else 0.0,
+        "histogram": {int(v): int(f) for v, f in zip(vals, freq)},
+    }
+
+
+def problem_stats(problem) -> Dict[str, Any]:
+    """Full stats for an api.KnnProblem (post-solve fields optional)."""
+    grid = problem.grid
+    out: Dict[str, Any] = {
+        "n_points": grid.n_points,
+        "grid_dim": grid.dim,
+        "k": problem.config.k,
+        "ring_radius": problem.config.resolved_ring_radius(),
+        "supercell": problem.config.supercell,
+        "occupancy": occupancy_stats(np.asarray(grid.cell_counts)),
+        "device_bytes": nbytes((grid, problem.plan)),
+    }
+    if problem.plan is not None:
+        out["plan"] = {"qcap": problem.plan.qcap, "ccap": problem.plan.ccap,
+                       "n_supercell_chunks": problem.plan.n_chunks,
+                       "chunk_batch": problem.plan.batch}
+    if problem.result is not None:
+        cert = np.asarray(problem.result.certified)
+        out["certified_fraction"] = float(cert.mean()) if cert.size else 1.0
+        out["uncertified"] = int((~cert).sum())
+    return out
+
+
+def print_stats(problem) -> Dict[str, Any]:
+    """Human-readable dump (reference: kn_print_stats, knearests.cu:440-466)."""
+    s = problem_stats(problem)
+    occ = s["occupancy"]
+    print(f"grid {s['grid_dim']}^3, {s['n_points']} points, k={s['k']}, "
+          f"ring_radius={s['ring_radius']}, supercell={s['supercell']}^3")
+    print(f"points per cell: min {occ['min_per_cell']} / "
+          f"avg {occ['avg_per_cell']:.2f} / max {occ['max_per_cell']}")
+    hist = occ["histogram"]
+    for v in sorted(hist):
+        print(f"  cells with {v:3d} points: {hist[v]}")
+    if "certified_fraction" in s:
+        print(f"certified: {100.0 * s['certified_fraction']:.4f}% "
+              f"({s['uncertified']} fallback queries)")
+    print(f"device memory: {s['device_bytes'] / 1e6:.1f} MB")
+    return s

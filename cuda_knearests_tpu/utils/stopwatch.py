@@ -1,0 +1,79 @@
+"""Wall-clock and device-accurate timing utilities.
+
+Reference parity (C7, /root/reference/stopwatch.h:11-43): an RAII timer printing
+elapsed wall time for a named phase.  The reference's version has 10 ms resolution
+(``times()``); this one uses ``perf_counter`` (ns resolution) and knows about the
+two things a CUDA stopwatch does not need to know about JAX: asynchronous dispatch
+(results must be blocked on before stopping the clock) and one-time compilation
+cost (the analog of the reference's dummy ``cudaMalloc`` context-warmup at
+/root/reference/test_knearests.cu:138-139), which ``timed`` separates out.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+
+
+class Stopwatch:
+    """Context-manager / RAII-style phase timer (reference: stopwatch.h:11-43)."""
+
+    def __init__(self, name: str = "", verbose: bool = True):
+        self.name = name
+        self.verbose = verbose
+        self.start = time.perf_counter()
+        self.last = self.start
+        self.elapsed = 0.0
+        if verbose and name:
+            print(f"[{name} start]", flush=True)
+
+    def tick(self) -> float:
+        """Seconds since the previous tick (reference: Stopwatch::tick)."""
+        now = time.perf_counter()
+        dt = now - self.last
+        self.last = now
+        return dt
+
+    def stop(self) -> float:
+        self.elapsed = time.perf_counter() - self.start
+        if self.verbose and self.name:
+            print(f"[{self.name}: {self.elapsed:.6f} s]", flush=True)
+        return self.elapsed
+
+    def __enter__(self) -> "Stopwatch":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def block(tree: Any) -> Any:
+    """Block until every array in a pytree is computed (async-dispatch barrier)."""
+    return jax.block_until_ready(tree)
+
+
+def timed(fn: Callable[..., Any], *args: Any, warmup: int = 1, iters: int = 3,
+          **kwargs: Any) -> Tuple[Any, Dict[str, float]]:
+    """Run `fn`, separating compile/warmup time from steady-state time.
+
+    Returns (result, {"warmup_s", "mean_s", "min_s"}).  The warmup split is the
+    JAX analog of the reference keeping CUDA context creation outside its inner
+    "knn subgpu" timer (test_knearests.cu:136-144).
+    """
+    t0 = time.perf_counter()
+    out = block(fn(*args, **kwargs))
+    warmup_s = time.perf_counter() - t0
+    for _ in range(max(0, warmup - 1)):
+        block(fn(*args, **kwargs))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = block(fn(*args, **kwargs))
+        times.append(time.perf_counter() - t0)
+    return out, {
+        "warmup_s": warmup_s,
+        "mean_s": sum(times) / len(times),
+        "min_s": min(times),
+    }
