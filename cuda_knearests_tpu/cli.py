@@ -1,0 +1,152 @@
+"""End-to-end differential driver: the framework's `test_knearests` equivalent.
+
+Reference parity (C13 + C12, /root/reference/test_knearests.cu:117-235): load an
+``.xyz`` point cloud (normalizing into the engine domain), dump device
+properties, run the accelerated all-points kNN (timed, compile split out),
+sanity-check the permutation and duplicate invariants, run the exact CPU oracle
+(timed), and compare the two per point.  Differences by design: k and every
+other knob are CLI flags instead of compile-time macros, comparison is
+tie-aware (exact f32 ties accept either id), a recall@k summary is printed for
+machine consumption, and ``--sharded N`` exercises the multi-chip slab path the
+reference does not have.
+
+Usage:
+    python -m cuda_knearests_tpu.cli pts20K.xyz --k 10
+    python -m cuda_knearests_tpu.cli 900k_blue_cube.xyz --k 20 --sharded 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def set_recall(got: np.ndarray, ref_ids: np.ndarray) -> float:
+    """Order-insensitive recall@k: mean fraction of oracle ids recovered."""
+    got_s = np.sort(got, axis=1)
+    ref_s = np.sort(ref_ids, axis=1)
+    n, k = got_s.shape
+    hits = (got_s == ref_s).sum(axis=1).astype(np.float64)
+    for i in np.nonzero((got_s != ref_s).any(axis=1))[0]:
+        hits[i] = len(set(got_s[i].tolist()) & set(ref_s[i].tolist()))
+    return float(hits.sum() / (n * k))
+
+
+def _tie_aware_mismatches(points: np.ndarray, got: np.ndarray, ref_ids: np.ndarray,
+                          ref_d2: np.ndarray) -> tuple[int, int]:
+    """Count per-point neighbor-set disagreements, splitting exact-tie flips
+    (acceptable) from hard mismatches (bugs).  Returns (ties, hard)."""
+    got_s = np.sort(got, axis=1)
+    ref_s = np.sort(ref_ids, axis=1)
+    rows = np.nonzero((got_s != ref_s).any(axis=1))[0]
+    ties = hard = 0
+    for i in rows:
+        diff = np.array(sorted(set(got_s[i].tolist()) ^ set(ref_s[i].tolist())))
+        kth = float(ref_d2[i, -1])
+        d2 = ((points[diff].astype(np.float64)
+               - points[i].astype(np.float64)) ** 2).sum(-1)
+        if np.allclose(d2, kth, rtol=2e-6, atol=0.0):
+            ties += 1
+        else:
+            hard += 1
+    return ties, hard
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="All-points kNN: TPU engine vs exact kd-tree oracle "
+                    "(the reference test_knearests, rebuilt)")
+    ap.add_argument("points", help=".xyz file path or known dataset name "
+                    "(e.g. pts20K.xyz, 900k_blue_cube.xyz)")
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--density", type=float, default=3.1)
+    ap.add_argument("--ring-radius", type=int, default=None)
+    ap.add_argument("--supercell", type=int, default=4)
+    ap.add_argument("--dist", choices=("diff", "dot"), default="diff")
+    ap.add_argument("--sharded", type=int, default=0, metavar="N",
+                    help="solve over an N-chip mesh (slab + halo exchange)")
+    ap.add_argument("--no-oracle", action="store_true",
+                    help="skip the CPU oracle comparison (benchmark mode)")
+    ap.add_argument("--json", action="store_true", help="emit a JSON summary line")
+    args = ap.parse_args(argv)
+
+    from .utils.platform import honor_jax_platforms_env
+    honor_jax_platforms_env()
+
+    from . import KnnConfig, KnnProblem
+    from .io import get_dataset, load_xyz, normalize_points
+    from .utils.devinfo import print_device_properties
+    from .utils.stopwatch import Stopwatch, timed
+
+    print_device_properties()
+
+    if os.path.exists(args.points):
+        points = normalize_points(load_xyz(args.points))
+    else:
+        points = get_dataset(args.points)
+    n = points.shape[0]
+    print(f"loaded {n} points -> [0,1000]^3")
+
+    cfg = KnnConfig(k=args.k, density=args.density, ring_radius=args.ring_radius,
+                    supercell=args.supercell, dist_method=args.dist)
+    summary = {"n": n, "k": args.k, "mode": "sharded" if args.sharded else "single"}
+
+    # --- accelerated solve (reference "knn gpu" phase, test_knearests.cu:136) ---
+    if args.sharded:
+        from .parallel.sharded import ShardedKnnProblem
+        with Stopwatch("prepare (grid + slab plan)"):
+            sp = ShardedKnnProblem.prepare(points, n_devices=args.sharded,
+                                           config=cfg)
+        with Stopwatch("solve (sharded, incl. compile)"):
+            neighbors, d2, cert = sp.solve()
+        perm = np.asarray(sp.grid.permutation)
+    else:
+        with Stopwatch("prepare (grid + plan)"):
+            problem = KnnProblem.prepare(points, cfg)
+        _, t = timed(lambda: problem.solve(), warmup=1, iters=1)
+        print(f"solve: compile+first {t['warmup_s']:.3f}s, "
+              f"steady {t['min_s']:.3f}s "
+              f"({n / t['min_s']:.0f} queries/sec)")
+        summary["solve_s"] = t["min_s"]
+        summary["qps"] = n / t["min_s"]
+        problem.print_stats()
+        neighbors = problem.get_knearests_original()
+        perm = problem.get_permutation()
+
+    # --- sanity: permutation bijection (test_knearests.cu:162-168) -------------
+    assert np.array_equal(np.sort(perm), np.arange(n)), "permutation not a bijection"
+    # --- sanity: no duplicate neighbor ids (test_knearests.cu:174-191) ---------
+    valid = neighbors >= 0
+    srt = np.sort(np.where(valid, neighbors, np.arange(n)[:, None] + n), axis=1)
+    dupes = int(((np.diff(srt, axis=1) == 0) & valid[:, 1:]).sum())
+    print(f"duplicate-neighbor check: {dupes} duplicates")
+    assert dupes == 0, "duplicate neighbor ids found"
+
+    # --- exact oracle comparison (test_knearests.cu:194-232) -------------------
+    if not args.no_oracle:
+        from .oracle import KdTreeOracle
+        with Stopwatch("knn cpu (kd-tree oracle)"):
+            oracle = KdTreeOracle(points)
+            ref_ids, ref_d2 = oracle.knn_all_points(k=args.k)
+        ties, hard = _tie_aware_mismatches(points, neighbors, ref_ids, ref_d2)
+        matched = n - ties - hard
+        recall = set_recall(neighbors, ref_ids)
+        print(f"oracle comparison: {matched}/{n} exact, {ties} tie flips, "
+              f"{hard} hard mismatches; recall@{args.k} = {recall:.6f}")
+        summary.update(exact=matched, ties=ties, hard=hard,
+                       recall=float(recall))
+        if hard:
+            print("FAILED", file=sys.stderr)
+            return 1
+    print("OK")
+    if args.json:
+        print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,7 +68,13 @@ def normalize_points(points: np.ndarray, domain: float = DOMAIN_SIZE) -> np.ndar
     """
     points = np.asarray(points, dtype=np.float32)
     lo, hi = bbox(points)
-    scale = domain / float((hi - lo).max())
+    extent = float((hi - lo).max())
+    if extent <= 0.0:
+        # degenerate cloud (single point / all identical): center it instead of
+        # dividing by zero -- the engine handles identical points fine
+        out = points.astype(np.float64) - lo + domain / 2.0
+        return np.ascontiguousarray(out.astype(np.float32))
+    scale = domain / extent
     out = (points.astype(np.float64) - lo) * scale
     return np.ascontiguousarray(out.astype(np.float32))
 

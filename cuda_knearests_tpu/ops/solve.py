@@ -122,11 +122,16 @@ def _round_up(x: int, m: int) -> int:
     return max(m, ((int(x) + m - 1) // m) * m)
 
 
-def build_plan(grid: GridHash, cfg: KnnConfig,
-               cell_counts_host: np.ndarray | None = None) -> SolvePlan:
-    """Host-side supercell schedule (analog of kn_prepare's table precomputation,
-    /root/reference/knearests.cu:254-300, but per-axis and clamped -- no boundary
-    wraparound)."""
+def global_schedule(grid: GridHash, cfg: KnnConfig,
+                    cell_counts_host: np.ndarray | None = None):
+    """Host-side supercell schedule shared by the single-chip and sharded
+    planners (analog of kn_prepare's table precomputation,
+    /root/reference/knearests.cu:254-300, but per-axis and clamped -- no
+    boundary wraparound).
+
+    Returns (own_cells, cand_cells, box_lo, box_hi, qcap, ccap), all over the
+    z-major global supercell grid.
+    """
     dim, s = grid.dim, cfg.supercell
     radius = cfg.resolved_ring_radius()
     n_sc = -(-dim // s)
@@ -143,12 +148,23 @@ def build_plan(grid: GridHash, cfg: KnnConfig,
     own_n = _box_sums(counts3, sc * s, np.minimum(sc * s + s, dim))
     cand_n = _box_sums(counts3, sc * s - radius, sc * s + s + radius)
     qcap = _round_up(own_n.max() if num_sc else 1, 8)
-    ccap = _round_up(cand_n.max() if num_sc else 1, 128)
+    # lower-bound ccap by k so lax.top_k(k) is always legal even when the
+    # candidate pool is smaller than k (k > n case: surplus slots stay masked
+    # and come out as -1/inf sentinels)
+    ccap = _round_up(max(cand_n.max() if num_sc else 1, cfg.k), 128)
 
     w = grid.domain / dim
     box_lo = ((sc * s - radius) * w).astype(np.float32)
     box_hi = ((sc * s + s + radius) * w).astype(np.float32)
+    return own, cand, box_lo, box_hi, int(qcap), int(ccap)
 
+
+def build_plan(grid: GridHash, cfg: KnnConfig,
+               cell_counts_host: np.ndarray | None = None) -> SolvePlan:
+    """Single-chip supercell plan: the global schedule, chunked for lax.scan."""
+    own, cand, box_lo, box_hi, qcap, ccap = global_schedule(
+        grid, cfg, cell_counts_host)
+    num_sc = own.shape[0]
     batch = max(1, int(cfg.sc_batch))
     n_chunks = -(-num_sc // batch)
     pad = n_chunks * batch - num_sc
@@ -227,6 +243,34 @@ def _margin_sq(q: jax.Array, lo: jax.Array, hi: jax.Array,
     return jnp.where(jnp.isinf(m), jnp.inf, m * m)
 
 
+def chunk_best(points: jax.Array, starts: jax.Array, counts: jax.Array,
+               own: jax.Array, cand: jax.Array, lo: jax.Array, hi: jax.Array,
+               qcap: int, ccap: int, k: int, dist_method: str,
+               exclude_self: bool, domain: float):
+    """Score one batch of supercells: gather queries + candidates, dense
+    distances, masked top-k, completeness certificates.
+
+    The reusable core of both the single-chip scan below and the sharded path
+    (parallel/sharded.py), which calls it on halo-extended local arrays.
+    Returns (q_idx, q_valid, best_d, best_i, cert); q_idx/best_i index `points`.
+    """
+    q_idx, q_valid = pack_cells(own, starts, counts, qcap)
+    c_idx, c_valid = pack_cells(cand, starts, counts, ccap)
+    q = jnp.take(points, q_idx, axis=0)
+    c = jnp.take(points, c_idx, axis=0)
+    d2 = _pair_d2(q, c, dist_method)
+    mask = q_valid[:, :, None] & c_valid[:, None, :]
+    if exclude_self:
+        # skip self by *storage index* (knearests.cu:123): coordinate
+        # duplicates of the query are still reported.
+        mask = mask & (c_idx[:, None, :] != q_idx[:, :, None])
+    ids = jnp.broadcast_to(c_idx[:, None, :], d2.shape)
+    best_d, best_i = masked_topk(d2, ids, mask, k)
+    kth = best_d[..., -1]
+    cert = q_valid & (kth <= _margin_sq(q, lo, hi, domain))
+    return q_idx, q_valid, best_d, best_i, cert
+
+
 @functools.partial(jax.jit, static_argnames=("k", "dist_method", "exclude_self",
                                              "domain"))
 def _solve_planned(points: jax.Array, starts: jax.Array, counts: jax.Array,
@@ -240,20 +284,9 @@ def _solve_planned(points: jax.Array, starts: jax.Array, counts: jax.Array,
     def step(carry, chunk):
         out_d, out_i, out_cert = carry
         own, cand, lo, hi = chunk
-        q_idx, q_valid = pack_cells(own, starts, counts, plan.qcap)
-        c_idx, c_valid = pack_cells(cand, starts, counts, plan.ccap)
-        q = jnp.take(points, q_idx, axis=0)
-        c = jnp.take(points, c_idx, axis=0)
-        d2 = _pair_d2(q, c, dist_method)
-        mask = q_valid[:, :, None] & c_valid[:, None, :]
-        if exclude_self:
-            # skip self by *storage index* (knearests.cu:123): coordinate
-            # duplicates of the query are still reported.
-            mask = mask & (c_idx[:, None, :] != q_idx[:, :, None])
-        ids = jnp.broadcast_to(c_idx[:, None, :], d2.shape)
-        best_d, best_i = masked_topk(d2, ids, mask, k)
-        kth = best_d[..., -1]
-        cert = q_valid & (kth <= _margin_sq(q, lo, hi, domain))
+        q_idx, q_valid, best_d, best_i, cert = chunk_best(
+            points, starts, counts, own, cand, lo, hi,
+            plan.qcap, plan.ccap, k, dist_method, exclude_self, domain)
         safe = jnp.where(q_valid, q_idx, n)  # n = out of bounds -> dropped
         out_d = out_d.at[safe].set(best_d, mode="drop")
         out_i = out_i.at[safe].set(best_i, mode="drop")

@@ -1,0 +1,136 @@
+"""ctypes binding to the native C++ kd-tree oracle (oracle/kd_tree.cpp).
+
+Reference parity (C9): the exact CPU oracle the differential tests compare the
+TPU engine against, playing the role the reference's KdTree plays in its test
+(/root/reference/test_knearests.cu:194-232).  Builds the shared library on
+demand via ``make -C oracle``; if no C++ toolchain is available, a pure-numpy
+brute-force fallback keeps the differential tests runnable (slower, same
+semantics).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ORACLE_DIR = os.path.join(_REPO_ROOT, "oracle")
+_LIB_PATH = os.path.join(_ORACLE_DIR, "liboracle.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH):
+                subprocess.run(["make", "-C", _ORACLE_DIR, "-s"], check=True,
+                               capture_output=True)
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.kdt_build.restype = ctypes.c_void_p
+            lib.kdt_build.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                      ctypes.c_int64]
+            lib.kdt_free.argtypes = [ctypes.c_void_p]
+            lib.kdt_num_nodes.restype = ctypes.c_int64
+            lib.kdt_num_nodes.argtypes = [ctypes.c_void_p]
+            lib.kdt_knn.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+                ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float)]
+        except Exception:
+            # stale/wrong-arch .so or no toolchain: fall back to numpy brute
+            return None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class KdTreeOracle:
+    """Exact k-NN oracle over a fixed point set.
+
+    Query semantics match the reference oracle: the query point is not excluded
+    unless an exclude id is given (the reference test queries k+1 and drops the
+    self hit, test_knearests.cu:205-211).
+    """
+
+    def __init__(self, points: np.ndarray):
+        self.points = np.ascontiguousarray(points, dtype=np.float32)
+        if self.points.ndim != 2 or self.points.shape[1] != 3:
+            raise ValueError("points must be (n, 3)")
+        self._lib = _load()
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._lib.kdt_build(
+                self.points.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self.points.shape[0])
+
+    def __del__(self):
+        if getattr(self, "_handle", None) and self._lib is not None:
+            self._lib.kdt_free(self._handle)
+            self._handle = None
+
+    def knn(self, queries: np.ndarray, k: int,
+            exclude_ids: Optional[np.ndarray] = None
+            ) -> Tuple[np.ndarray, np.ndarray]:
+        """(nq, k) nearest ids + squared distances, ascending; -1/inf padding."""
+        queries = np.ascontiguousarray(queries, dtype=np.float32)
+        nq = queries.shape[0]
+        out_ids = np.empty((nq, k), dtype=np.int32)
+        out_d2 = np.empty((nq, k), dtype=np.float32)
+        if self._handle is not None:
+            excl = None
+            if exclude_ids is not None:
+                excl = np.ascontiguousarray(exclude_ids, dtype=np.int32)
+                ep = excl.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            else:
+                ep = None
+            self._lib.kdt_knn(
+                self._handle,
+                queries.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                nq, k, ep,
+                out_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                out_d2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            return out_ids, out_d2
+        return self._brute(queries, k, exclude_ids)
+
+    def knn_all_points(self, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """All-points self-query with self excluded by index -- the oracle side
+        of the differential test (reference: test_knearests.cu:203-212)."""
+        excl = np.arange(self.points.shape[0], dtype=np.int32)
+        return self.knn(self.points, k, exclude_ids=excl)
+
+    def _brute(self, queries, k, exclude_ids, chunk: int = 512):
+        n = self.points.shape[0]
+        nq = queries.shape[0]
+        out_ids = np.full((nq, k), -1, np.int32)
+        out_d2 = np.full((nq, k), np.inf, np.float32)
+        for s in range(0, nq, chunk):
+            e = min(s + chunk, nq)
+            q = queries[s:e]
+            d2 = ((q[:, None, :] - self.points[None, :, :]) ** 2).sum(-1)
+            if exclude_ids is not None:
+                rows = np.arange(e - s)
+                ex = exclude_ids[s:e]
+                ok = ex >= 0
+                d2[rows[ok], ex[ok]] = np.inf
+            kk = min(k, n)
+            part = np.argpartition(d2, kk - 1, axis=1)[:, :kk]
+            pd = np.take_along_axis(d2, part, axis=1)
+            order = np.argsort(pd, axis=1, kind="stable")
+            ids = np.take_along_axis(part, order, axis=1)
+            d2s = np.take_along_axis(pd, order, axis=1)
+            good = np.isfinite(d2s)
+            out_ids[s:e, :kk] = np.where(good, ids, -1)
+            out_d2[s:e, :kk] = np.where(good, d2s, np.inf)
+        return out_ids, out_d2

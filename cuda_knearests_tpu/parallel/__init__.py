@@ -1,1 +1,3 @@
+from . import sharded
+
 __all__ = ["sharded"]

@@ -1,0 +1,365 @@
+"""Multi-chip kNN: grid-slab sharding over a device mesh with ICI halo exchange.
+
+The reference is strictly single-GPU -- its only "communication" is cudaMemcpy
+H2D/D2H (SURVEY.md section 2.3).  This module is the framework's new scaling
+capability, per the BASELINE.json north star: for point sets beyond single-chip
+HBM, shard the uniform grid into contiguous z-slabs across a 1-D
+``jax.sharding.Mesh``; each chip owns its slab's points and CSR, and queries
+near slab faces need candidates from the neighboring chips' boundary cells --
+exchanged as fixed-size halo buffers with ``lax.ppermute`` over ICI inside a
+``jax.shard_map``.  DCN is crossed only at multi-host slab seams, by the same
+collective.
+
+Decomposition invariants:
+  * The global grid is built once (ops/gridhash.py); its x-fastest/z-slowest
+    cell order makes every z-slab a *contiguous* range of the sorted point
+    array, so slabbing is slicing, not reshuffling.
+  * Slab boundaries are supercell-aligned (z cell extent per chip = Zcap =
+    layers * supercell), so every chip reuses the single-chip supercell
+    schedule unchanged -- the candidate boxes of a chip's supercells always fit
+    inside [slab - halo, slab + halo].
+  * Halo depth equals the ring radius R, so boundary queries get exactly the
+    candidate set the single-chip solver would gather; certificates remain
+    valid verbatim.  Queries whose k-th distance exceeds their margin (rare)
+    are resolved exactly on the host against the global array.
+
+All shapes are static and identical across chips (capacities are global
+maxima), which is what lets one ``shard_map`` program serve every chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import KnnConfig
+from ..ops.gridhash import GridHash, build_grid
+from ..ops.solve import (_FAR, _round_up, brute_force_by_index, chunk_best,
+                         global_schedule)
+from ..ops.topk import INVALID_ID
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedPlan:
+    """Host-built static schedule + device-stacked inputs (leading axis = chip)."""
+
+    # per-chip point slabs and CSR (stacked on axis 0, sharded over the mesh)
+    local_pts: np.ndarray     # (ndev, Pcap, 3) f32, FAR-padded
+    local_counts: np.ndarray  # (ndev, Zcap*A) i32
+    local_base: np.ndarray    # (ndev, 1) i32 global sorted index of slab start
+    n_local: np.ndarray       # (ndev, 1) i32
+    # halo send buffers (bottom goes to chip-1, top goes to chip+1)
+    bot_pts: np.ndarray       # (ndev, Hcap, 3) f32
+    bot_counts: np.ndarray    # (ndev, R*A) i32
+    bot_base: np.ndarray      # (ndev, 1) i32
+    top_pts: np.ndarray       # (ndev, Hcap, 3) f32
+    top_counts: np.ndarray    # (ndev, R*A) i32
+    top_base: np.ndarray      # (ndev, 1) i32
+    # supercell schedule in halo-extended local cell coordinates
+    own_cells: np.ndarray     # (ndev, nchunks, B, s^3) i32, -1 padded
+    cand_cells: np.ndarray    # (ndev, nchunks, B, (s+2R)^3) i32
+    box_lo: np.ndarray        # (ndev, nchunks, B, 3) f32
+    box_hi: np.ndarray        # (ndev, nchunks, B, 3) f32
+    # static meta
+    ndev: int
+    qcap: int
+    ccap: int
+    pcap: int
+    hcap: int
+
+
+def _slab_bounds(dim: int, supercell: int, ndev: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Supercell-aligned z-cell ranges per chip: [zc0[d], zc1[d])."""
+    n_sc_z = -(-dim // supercell)
+    layers = -(-n_sc_z // ndev)
+    zcap = layers * supercell
+    zc0 = np.arange(ndev) * zcap
+    zc1 = np.minimum(zc0 + zcap, dim)
+    zc1 = np.maximum(zc1, np.minimum(zc0, dim))  # empty slabs: zc1 == zc0
+    return zc0, zc1, zcap
+
+
+def build_sharded_plan(grid: GridHash, cfg: KnnConfig, ndev: int,
+                       cell_counts_host: Optional[np.ndarray] = None) -> ShardedPlan:
+    dim, s = grid.dim, cfg.supercell
+    radius = cfg.resolved_ring_radius()
+    domain = grid.domain
+    w = domain / dim
+    A = dim * dim
+    n = grid.n_points
+
+    zc0, zc1, zcap = _slab_bounds(dim, s, ndev)
+    if zcap < radius:
+        raise ValueError(
+            f"slab thickness {zcap} cells < halo depth {radius}: halo would "
+            f"span multiple chips. Use fewer devices, a larger supercell, or a "
+            f"smaller ring radius (dim={dim}, ndev={ndev}).")
+
+    counts = (np.asarray(cell_counts_host) if cell_counts_host is not None
+              else np.asarray(jax.device_get(grid.cell_counts)))
+    starts = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+
+    def pts_at(zcell: int) -> int:
+        """Global sorted index of the first point at z-layer `zcell` (clamped)."""
+        c = int(np.clip(zcell, 0, dim)) * A
+        return int(starts[c])
+
+    # ---- global supercell schedule (shared with the single-chip planner) ----
+    own_g, cand_g, box_lo_g, box_hi_g, qcap, ccap = global_schedule(
+        grid, cfg, counts)
+    n_sc = -(-dim // s)
+
+    # ---- per-chip slicing ----------------------------------------------------
+    nxy = n_sc * n_sc                       # supercells per z-layer of supercells
+    layers = zcap // s
+    sc_per_dev = layers * nxy
+    batch = max(1, int(cfg.sc_batch))
+    nchunks = -(-sc_per_dev // batch)
+    sc_pad = nchunks * batch
+
+    p0 = np.array([pts_at(z) for z in zc0])
+    p1 = np.array([pts_at(z) for z in zc1])
+    pcap = _round_up(int((p1 - p0).max()) if ndev else 1, 8)
+
+    # halo regions: bottom R layers [zc0, zc0+R), top R layers [zc0+zcap-R, zc0+zcap)
+    b0, b1 = p0, np.array([pts_at(z) for z in zc0 + radius])
+    t0 = np.array([pts_at(z) for z in zc0 + zcap - radius])
+    t1 = np.array([pts_at(z) for z in zc0 + zcap])
+    hcap = _round_up(int(max((b1 - b0).max(), (t1 - t0).max())) if ndev else 1, 8)
+
+    pts_sorted = np.asarray(jax.device_get(grid.points))
+
+    def pad_pts(lo: int, hi: int, cap: int) -> np.ndarray:
+        out = np.full((cap, 3), _FAR, np.float32)
+        out[: hi - lo] = pts_sorted[lo:hi]
+        return out
+
+    def counts_slice(z_from: int, z_to: int) -> np.ndarray:
+        """Per-cell counts for z-layers [z_from, z_to), zero-padded beyond grid."""
+        out = np.zeros(((z_to - z_from) * A,), np.int32)
+        lo, hi = np.clip([z_from, z_to], 0, dim)
+        if hi > lo:
+            out[(lo - z_from) * A:(hi - z_from) * A] = counts[lo * A:hi * A]
+        return out
+
+    local_pts = np.stack([pad_pts(p0[d], p1[d], pcap) for d in range(ndev)])
+    local_counts = np.stack([counts_slice(zc0[d], zc0[d] + zcap)
+                             for d in range(ndev)])
+    bot_pts = np.stack([pad_pts(b0[d], b1[d], hcap) for d in range(ndev)])
+    bot_counts = np.stack([counts_slice(zc0[d], zc0[d] + radius)
+                           for d in range(ndev)])
+    top_pts = np.stack([pad_pts(t0[d], t1[d], hcap) for d in range(ndev)])
+    top_counts = np.stack([counts_slice(zc0[d] + zcap - radius, zc0[d] + zcap)
+                           for d in range(ndev)])
+
+    def per_dev_plan(d: int):
+        r0, r1 = d * sc_per_dev, min((d + 1) * sc_per_dev, own_g.shape[0])
+        rows = slice(r0, r1)
+        nrows = r1 - r0 if r1 > r0 else 0
+
+        def pad_rows(a: np.ndarray, fill) -> np.ndarray:
+            out = np.full((sc_pad,) + a.shape[1:], fill, a.dtype)
+            if nrows > 0:
+                out[:nrows] = a[rows]
+            return out
+
+        # global linear cell id -> halo-extended local id: subtract the window
+        # origin (zc0 - R) * A; -1 mask passes through
+        shift = A * (radius - int(zc0[d]))
+        own = pad_rows(own_g, -1)
+        own = np.where(own >= 0, own + shift, -1).astype(np.int32)
+        cand = pad_rows(cand_g, -1)
+        cand = np.where(cand >= 0, cand + shift, -1).astype(np.int32)
+        lo = pad_rows(box_lo_g, 0.0)
+        hi = pad_rows(box_hi_g, 0.0)
+        rs = lambda a: a.reshape(nchunks, batch, *a.shape[1:])
+        return rs(own), rs(cand), rs(lo), rs(hi)
+
+    per_dev = [per_dev_plan(d) for d in range(ndev)]
+    own_cells = np.stack([p[0] for p in per_dev])
+    cand_cells = np.stack([p[1] for p in per_dev])
+    box_lo = np.stack([p[2] for p in per_dev])
+    box_hi = np.stack([p[3] for p in per_dev])
+
+    as_col = lambda a: a.astype(np.int32).reshape(ndev, 1)
+    return ShardedPlan(
+        local_pts=local_pts, local_counts=local_counts,
+        local_base=as_col(p0), n_local=as_col(p1 - p0),
+        bot_pts=bot_pts, bot_counts=bot_counts, bot_base=as_col(b0),
+        top_pts=top_pts, top_counts=top_counts, top_base=as_col(t0),
+        own_cells=own_cells, cand_cells=cand_cells,
+        box_lo=box_lo.astype(np.float32), box_hi=box_hi.astype(np.float32),
+        ndev=ndev, qcap=int(qcap), ccap=int(ccap), pcap=int(pcap),
+        hcap=int(hcap))
+
+
+def _make_device_solve(plan: ShardedPlan, cfg: KnnConfig, domain: float):
+    """The per-chip program run under shard_map: halo exchange + local solve."""
+    ndev, k = plan.ndev, cfg.k
+    hcap, pcap = plan.hcap, plan.pcap
+    fwd = [(i, i + 1) for i in range(ndev - 1)]   # chip d -> d+1
+    bwd = [(i + 1, i) for i in range(ndev - 1)]   # chip d -> d-1
+
+    def device_fn(local_pts, local_counts, local_base, bot_pts, bot_counts,
+                  bot_base, top_pts, top_counts, top_base, own, cand, blo, bhi):
+        # shard_map blocks carry the leading mesh axis of size 1
+        sq = lambda a: a[0]
+        local_pts, local_counts = sq(local_pts), sq(local_counts)
+        local_base = sq(local_base)[0]
+        own, cand, blo, bhi = sq(own), sq(cand), sq(blo), sq(bhi)
+
+        if ndev > 1:
+            # halo exchange over ICI: my top region becomes my upper neighbor's
+            # lower halo and vice versa.  Edge chips receive zeros -- zero
+            # counts, so the empty halos are never gathered from.
+            lo_pts = jax.lax.ppermute(sq(top_pts), "z", fwd)
+            lo_counts = jax.lax.ppermute(sq(top_counts), "z", fwd)
+            lo_base = jax.lax.ppermute(sq(top_base), "z", fwd)[0]
+            hi_pts = jax.lax.ppermute(sq(bot_pts), "z", bwd)
+            hi_counts = jax.lax.ppermute(sq(bot_counts), "z", bwd)
+            hi_base = jax.lax.ppermute(sq(bot_base), "z", bwd)[0]
+        else:
+            lo_pts = jnp.full_like(sq(top_pts), _FAR)
+            lo_counts = jnp.zeros_like(sq(top_counts))
+            lo_base = jnp.int32(0)
+            hi_pts = jnp.full_like(sq(bot_pts), _FAR)
+            hi_counts = jnp.zeros_like(sq(bot_counts))
+            hi_base = jnp.int32(0)
+
+        # halo-extended point array + CSR over the z-window [zc0-R, zc0+Zcap+R)
+        ext_pts = jnp.concatenate([lo_pts, local_pts, hi_pts], axis=0)
+        mk_starts = lambda c: jnp.cumsum(c) - c
+        ext_starts = jnp.concatenate([
+            mk_starts(lo_counts),
+            mk_starts(local_counts) + hcap,
+            mk_starts(hi_counts) + hcap + pcap]).astype(jnp.int32)
+        ext_counts = jnp.concatenate([lo_counts, local_counts, hi_counts])
+
+        # mark the carry as device-varying over the mesh axis (each chip
+        # accumulates its own slab's outputs)
+        vary = lambda a: jax.lax.pcast(a, ("z",), to="varying")
+        out_d = vary(jnp.full((pcap, k), jnp.inf, jnp.float32))
+        out_i = vary(jnp.full((pcap, k), INVALID_ID, jnp.int32))
+        out_cert = vary(jnp.zeros((pcap,), bool))
+
+        def step(carry, chunk):
+            out_d, out_i, out_cert = carry
+            own_c, cand_c, lo_c, hi_c = chunk
+            q_idx, q_valid, best_d, best_i, cert = chunk_best(
+                ext_pts, ext_starts, ext_counts, own_c, cand_c, lo_c, hi_c,
+                plan.qcap, plan.ccap, k, cfg.dist_method, cfg.exclude_self,
+                domain)
+            # extended index -> global sorted index
+            in_lo = best_i < hcap
+            in_loc = best_i < hcap + pcap
+            gl = jnp.where(in_lo, lo_base + best_i,
+                           jnp.where(in_loc, local_base + best_i - hcap,
+                                     hi_base + best_i - hcap - pcap))
+            gl = jnp.where(best_i == INVALID_ID, INVALID_ID, gl).astype(jnp.int32)
+            row = q_idx - hcap  # queries always live in the local section
+            safe = jnp.where(q_valid & (row >= 0) & (row < pcap), row, pcap)
+            out_d = out_d.at[safe].set(best_d, mode="drop")
+            out_i = out_i.at[safe].set(gl, mode="drop")
+            out_cert = out_cert.at[safe].set(cert, mode="drop")
+            return (out_d, out_i, out_cert), None
+
+        (out_d, out_i, out_cert), _ = jax.lax.scan(
+            step, (out_d, out_i, out_cert), (own, cand, blo, bhi))
+        return out_i[None], out_d[None], out_cert[None]
+
+    return device_fn
+
+
+@dataclasses.dataclass
+class ShardedKnnProblem:
+    """Multi-chip analog of api.KnnProblem: one prepared problem over a mesh.
+
+    The reference has no counterpart -- this is the "sharded 10M points over
+    v5e-8 ICI" capability from BASELINE.json.configs.
+    """
+
+    grid: GridHash
+    config: KnnConfig
+    plan: ShardedPlan
+    mesh: Mesh
+    _fn: Optional[object] = dataclasses.field(default=None, repr=False)
+
+    @classmethod
+    def prepare(cls, points, n_devices: Optional[int] = None,
+                config: Optional[KnnConfig] = None,
+                mesh: Optional[Mesh] = None,
+                dim: Optional[int] = None) -> "ShardedKnnProblem":
+        config = config or KnnConfig()
+        if mesh is None:
+            n_devices = n_devices or len(jax.devices())
+            mesh = jax.make_mesh((n_devices,), ("z",))
+        ndev = mesh.devices.size
+        grid = build_grid(np.asarray(points, np.float32), dim=dim,
+                          density=config.density)
+        plan = build_sharded_plan(grid, config, ndev)
+        return cls(grid=grid, config=config, plan=plan, mesh=mesh)
+
+    def solve(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run the sharded solve.  Returns (neighbors_original_ids (n, k),
+        dists_sq (n, k), certified (n,)) on the host, exact (uncertified
+        queries resolved against the global array)."""
+        plan, cfg = self.plan, self.config
+        if self._fn is None:
+            # built once per problem so repeated solves reuse the compile cache
+            spec_tree = (P("z"),) * 13
+            self._fn = jax.jit(jax.shard_map(
+                _make_device_solve(plan, cfg, self.grid.domain),
+                mesh=self.mesh, in_specs=spec_tree,
+                out_specs=(P("z"), P("z"), P("z"))))
+        out_i, out_d, out_cert = self._fn(
+            plan.local_pts, plan.local_counts, plan.local_base,
+            plan.bot_pts, plan.bot_counts, plan.bot_base,
+            plan.top_pts, plan.top_counts, plan.top_base,
+            plan.own_cells, plan.cand_cells, plan.box_lo, plan.box_hi)
+        out_i = np.asarray(jax.device_get(out_i))
+        out_d = np.asarray(jax.device_get(out_d))
+        out_cert = np.asarray(jax.device_get(out_cert))
+
+        n, k = self.grid.n_points, cfg.k
+        nbr_sorted = np.full((n, k), INVALID_ID, np.int32)
+        d2 = np.full((n, k), np.inf, np.float32)
+        cert = np.zeros((n,), bool)
+        base = plan.local_base.ravel()
+        nloc = plan.n_local.ravel()
+        for d in range(plan.ndev):
+            m = int(nloc[d])
+            if m == 0:
+                continue
+            rows = slice(int(base[d]), int(base[d]) + m)
+            nbr_sorted[rows] = out_i[d, :m]
+            d2[rows] = out_d[d, :m]
+            cert[rows] = out_cert[d, :m]
+
+        if cfg.fallback == "brute" and not cert.all():
+            from ..api import _pad_pow2
+            bad = np.nonzero(~cert)[0].astype(np.int32)
+            q_idx = _pad_pow2(bad, fill=-1)
+            b_ids, b_d2 = brute_force_by_index(
+                self.grid.points, jnp.asarray(q_idx), k, cfg.exclude_self)
+            b_ids, b_d2 = np.asarray(b_ids), np.asarray(b_d2)
+            nbr_sorted[bad] = b_ids[: bad.size]
+            d2[bad] = b_d2[: bad.size]
+            cert[bad] = True
+
+        perm = np.asarray(jax.device_get(self.grid.permutation))
+        valid = nbr_sorted >= 0
+        nbr_orig_vals = np.where(valid, perm[np.clip(nbr_sorted, 0, n - 1)],
+                                 INVALID_ID)
+        neighbors = np.empty_like(nbr_orig_vals)
+        neighbors[perm] = nbr_orig_vals
+        d2_out = np.empty_like(d2)
+        d2_out[perm] = d2
+        cert_out = np.empty_like(cert)
+        cert_out[perm] = cert
+        return neighbors, d2_out, cert_out

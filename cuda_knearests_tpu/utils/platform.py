@@ -1,0 +1,21 @@
+"""Platform-selection guard.
+
+Some environments install a site hook that registers an accelerator backend and
+widens ``jax_platforms`` behind the user's back, which both overrides an
+explicit ``JAX_PLATFORMS=cpu`` and can hang backend init when the accelerator
+transport is down.  ``honor_jax_platforms_env()`` restores the standard
+semantics: if the user set ``JAX_PLATFORMS``, that is what jax uses.  Call it
+at entry-point start, before the first backend use.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)

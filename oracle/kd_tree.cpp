@@ -1,0 +1,242 @@
+// Exact k-nearest-neighbor oracle: a flat, preorder-laid-out kd-tree over 3D
+// points, with an OpenMP-parallel batch query API exported through a C ABI for
+// ctypes binding.
+//
+// Role: the CPU correctness oracle and CPU performance baseline of the
+// framework -- the native counterpart of the reference's kd-tree
+// (/root/reference/kd_tree.h, kd_tree.cpp; component C9 in SURVEY.md), used by
+// the differential test harness exactly the way the reference's test uses its
+// tree (/root/reference/test_knearests.cu:194-232).
+//
+// This is a ground-up implementation, not a port.  Design differences from the
+// reference (which uses an implicit binary-heap node numbering, in-place
+// shrinking bounding boxes, and an insertion-sorted result list):
+//   * nodes are laid out in preorder in one flat array (left child is always
+//     node+1; only the right-child index is stored) -- cache-friendly DFS;
+//   * pruning uses the classic incremental squared-distance-to-splitting-plane
+//     bound rather than full bbox maintenance;
+//   * results accumulate in a bounded binary max-heap, heapsorted ascending at
+//     the end;
+//   * the tree owns a copy of the points (the reference aliases caller memory,
+//     kd_tree.cpp:80-111 -- a lifetime footgun we do not reproduce).
+//
+// Query semantics match the reference oracle: the query point itself is NOT
+// excluded (the reference test asks for k+1 and drops the self hit,
+// test_knearests.cu:205-211); callers may pass an explicit exclude id instead.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+
+constexpr int kLeafSize = 16;  // points per leaf; same order as the reference's
+                               // MAX_LEAF_SIZE (kd_tree.h:42) -- a sweet spot
+                               // for 3D scans, re-validated in tests.
+
+struct Node {
+  // Internal node: split plane `value` on axis `axis`, right child at `right`.
+  // Leaf: axis == -1 and [begin, end) indexes into the permutation array.
+  float value = 0.f;
+  int32_t axis = -1;
+  int32_t right = -1;
+  int32_t begin = 0;
+  int32_t end = 0;
+};
+
+struct Tree {
+  std::vector<float> pts;      // (n, 3) owned copy, original order
+  std::vector<int32_t> perm;   // build permutation: tree order -> original id
+  std::vector<Node> nodes;     // preorder: left(i) == i + 1
+  int64_t n = 0;
+};
+
+// Bounded max-heap of (d2, id) pairs: the k current-best candidates with the
+// worst at the root, so a better candidate replaces the root in O(log k).
+struct BestK {
+  float* d2;
+  int32_t* id;
+  int k;
+  int size = 0;
+
+  inline float worst() const {
+    return size < k ? std::numeric_limits<float>::infinity() : d2[0];
+  }
+
+  inline void push(float d, int32_t i) {
+    if (size < k) {
+      int c = size++;
+      d2[c] = d; id[c] = i;
+      while (c > 0) {                       // sift up
+        int p = (c - 1) >> 1;
+        if (d2[p] >= d2[c]) break;
+        std::swap(d2[p], d2[c]); std::swap(id[p], id[c]);
+        c = p;
+      }
+    } else if (d < d2[0]) {
+      d2[0] = d; id[0] = i;
+      int p = 0;                            // sift down
+      for (;;) {
+        int l = 2 * p + 1, r = l + 1, m = p;
+        if (l < k && d2[l] > d2[m]) m = l;
+        if (r < k && d2[r] > d2[m]) m = r;
+        if (m == p) break;
+        std::swap(d2[p], d2[m]); std::swap(id[p], id[m]);
+        p = m;
+      }
+    }
+  }
+
+  // In-place heapsort: repeatedly move the current worst to the tail, leaving
+  // the array ascending (nearest first), then pad the unused tail.
+  void sort_ascending() {
+    int s = size;
+    while (s > 1) {
+      --s;
+      std::swap(d2[0], d2[s]); std::swap(id[0], id[s]);
+      int p = 0;
+      for (;;) {
+        int l = 2 * p + 1, r = l + 1, m = p;
+        if (l < s && d2[l] > d2[m]) m = l;
+        if (r < s && d2[r] > d2[m]) m = r;
+        if (m == p) break;
+        std::swap(d2[p], d2[m]); std::swap(id[p], id[m]);
+        p = m;
+      }
+    }
+    for (int i = size; i < k; ++i) {
+      d2[i] = std::numeric_limits<float>::infinity();
+      id[i] = -1;
+    }
+  }
+};
+
+inline float sq(float x) { return x * x; }
+
+// Widest-spread axis over pts[perm[b..e)] -- same splitting heuristic family as
+// the reference (kd_tree.cpp:149-166) and ANN, computed directly.
+int widest_axis(const Tree& t, int32_t b, int32_t e) {
+  float lo[3] = {+INFINITY, +INFINITY, +INFINITY};
+  float hi[3] = {-INFINITY, -INFINITY, -INFINITY};
+  for (int32_t i = b; i < e; ++i) {
+    const float* p = &t.pts[3 * (size_t)t.perm[i]];
+    for (int a = 0; a < 3; ++a) {
+      lo[a] = std::min(lo[a], p[a]);
+      hi[a] = std::max(hi[a], p[a]);
+    }
+  }
+  int best = 0;
+  float spread = hi[0] - lo[0];
+  for (int a = 1; a < 3; ++a)
+    if (hi[a] - lo[a] > spread) { spread = hi[a] - lo[a]; best = a; }
+  return best;
+}
+
+// Recursive preorder build over perm[b..e).  Returns the node's index.
+int32_t build_node(Tree& t, int32_t b, int32_t e) {
+  int32_t me = (int32_t)t.nodes.size();
+  t.nodes.emplace_back();
+  if (e - b <= kLeafSize) {
+    t.nodes[me].axis = -1;
+    t.nodes[me].begin = b;
+    t.nodes[me].end = e;
+    return me;
+  }
+  int axis = widest_axis(t, b, e);
+  int32_t mid = b + (e - b) / 2;
+  std::nth_element(t.perm.begin() + b, t.perm.begin() + mid,
+                   t.perm.begin() + e, [&](int32_t x, int32_t y) {
+                     return t.pts[3 * (size_t)x + axis] <
+                            t.pts[3 * (size_t)y + axis];
+                   });
+  float split = t.pts[3 * (size_t)t.perm[mid] + axis];
+  t.nodes[me].axis = axis;
+  t.nodes[me].value = split;
+  build_node(t, b, mid);                       // left = me + 1 by preorder
+  t.nodes[me].right = build_node(t, mid, e);
+  return me;
+}
+
+// DFS with incremental lower-bound pruning.  `lb` is a running lower bound on
+// the squared distance from q to the far half-space along the path; `off` holds
+// the per-axis contribution currently folded into lb.
+void query_node(const Tree& t, int32_t node, const float* q, float lb,
+                float* off, BestK& best, int32_t exclude) {
+  const Node& nd = t.nodes[node];
+  if (nd.axis < 0) {
+    for (int32_t i = nd.begin; i < nd.end; ++i) {
+      int32_t id = t.perm[i];
+      if (id == exclude) continue;
+      const float* p = &t.pts[3 * (size_t)id];
+      // x,y,z accumulation order: identical arithmetic to the device path
+      // (ops/solve.py _pair_d2 'diff') so differential tests can demand
+      // exact agreement.
+      float d = sq(q[0] - p[0]) + sq(q[1] - p[1]) + sq(q[2] - p[2]);
+      if (d < best.worst()) best.push(d, id);
+    }
+    return;
+  }
+  float diff = q[nd.axis] - nd.value;
+  int32_t near = (diff < 0.f) ? node + 1 : nd.right;
+  int32_t far = (diff < 0.f) ? nd.right : node + 1;
+  query_node(t, near, q, lb, off, best, exclude);
+  float new_lb = lb - off[nd.axis] + sq(diff);
+  if (new_lb < best.worst()) {
+    float saved = off[nd.axis];
+    off[nd.axis] = sq(diff);
+    query_node(t, far, q, new_lb, off, best, exclude);
+    off[nd.axis] = saved;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kdt_build(const float* pts, int64_t n) {
+  Tree* t = new Tree();
+  t->n = n;
+  t->pts.assign(pts, pts + 3 * (size_t)n);
+  t->perm.resize((size_t)n);
+  for (int64_t i = 0; i < n; ++i) t->perm[(size_t)i] = (int32_t)i;
+  t->nodes.reserve((size_t)(n / (kLeafSize / 2) + 4));
+  if (n > 0) build_node(*t, 0, (int32_t)n);
+  return t;
+}
+
+void kdt_free(void* tree) { delete static_cast<Tree*>(tree); }
+
+int64_t kdt_num_nodes(const void* tree) {
+  return (int64_t) static_cast<const Tree*>(tree)->nodes.size();
+}
+
+// Batch k-NN: for each query row, the k nearest tree points, ascending.
+// exclude_ids may be null; exclude_ids[j] >= 0 drops that original id from
+// query j's result (used for all-points self-exclusion).  Unfilled slots get
+// id -1 / d2 +inf.  OpenMP-parallel over queries, mirroring the reference
+// test's host parallelism (test_knearests.cu:203).
+void kdt_knn(const void* tree, const float* queries, int64_t nq, int32_t k,
+             const int32_t* exclude_ids, int32_t* out_ids, float* out_d2) {
+  const Tree& t = *static_cast<const Tree*>(tree);
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 64)
+#endif
+  for (int64_t j = 0; j < nq; ++j) {
+    BestK best{out_d2 + (size_t)j * k, out_ids + (size_t)j * k, k, 0};
+    if (t.n > 0) {
+      float off[3] = {0.f, 0.f, 0.f};
+      int32_t excl = exclude_ids ? exclude_ids[j] : -1;
+      query_node(t, 0, queries + 3 * (size_t)j, 0.f, off, best, excl);
+    }
+    best.sort_ascending();
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,61 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip sharding is tested without hardware via XLA host-device emulation
+(``--xla_force_host_platform_device_count``) -- a capability the reference lacks
+entirely (its only test binary requires a physical GPU, SURVEY.md section 4).
+The flags must be set before jax initializes, hence here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize may pre-register a hardware TPU backend and
+# widen jax_platforms behind our back; tests must run on the emulated CPU mesh
+# regardless (and not hang if the hardware tunnel is down), so force the
+# platform again at config level -- this wins because it runs after any
+# site-level registration but before first backend use.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)
+
+
+@pytest.fixture(scope="session")
+def uniform_10k():
+    from cuda_knearests_tpu.io import generate_uniform
+    return generate_uniform(10_000, seed=42)
+
+
+@pytest.fixture(scope="session")
+def blue_8k():
+    from cuda_knearests_tpu.io import generate_blue_noise
+    return generate_blue_noise(8_000, seed=17)
+
+
+@pytest.fixture(scope="session")
+def pts20k():
+    """The reference's one shipped fixture, normalized (pts20K.xyz, 20,626 pts)."""
+    from cuda_knearests_tpu.io import get_dataset
+    return get_dataset("pts20K.xyz")
+
+
+def brute_knn_np(points: np.ndarray, queries_idx: np.ndarray, k: int) -> np.ndarray:
+    """Reference-free numpy brute force (self excluded by index): (m, k) ids."""
+    out = np.empty((len(queries_idx), k), np.int64)
+    for row, qi in enumerate(queries_idx):
+        d2 = ((points[qi] - points) ** 2).sum(-1)
+        d2[qi] = np.inf
+        out[row] = np.argsort(d2, kind="stable")[:k]
+    return out

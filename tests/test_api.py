@@ -1,0 +1,68 @@
+"""API-surface tests (C1): lifecycle, accessors, stats, degenerate inputs the
+reference rejects outright (it exits for N < ~12K, knearests.cu:254-258)."""
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem, knn
+
+
+def test_lifecycle_and_accessors(blue_8k):
+    p = KnnProblem.prepare(blue_8k, KnnConfig(k=6))
+    with pytest.raises(RuntimeError):
+        p.get_knearests()  # solve() not called yet
+    p.solve()
+    assert p.get_points().shape == (len(blue_8k), 3)
+    assert p.get_permutation().shape == (len(blue_8k),)
+    assert p.get_knearests().shape == (len(blue_8k), 6)
+    assert p.get_knearests_original().shape == (len(blue_8k), 6)
+    assert p.get_dists_sq().shape == (len(blue_8k), 6)
+
+
+def test_stats_shape(blue_8k, capsys):
+    p = KnnProblem.prepare(blue_8k, KnnConfig(k=6))
+    p.solve()
+    s = p.print_stats()
+    out = capsys.readouterr().out
+    assert "points per cell" in out
+    assert s["occupancy"]["num_points"] == len(blue_8k)
+    assert abs(s["occupancy"]["avg_per_cell"] - 3.1) < 1.5
+    assert s["certified_fraction"] == 1.0
+    assert s["device_bytes"] > 0
+
+
+def test_small_n_handled():
+    """The reference exits for small N (knearests.cu:254-258 'does not support
+    low number of input points'); this framework must not."""
+    pts = np.random.default_rng(0).random((7, 3)).astype(np.float32) * 1000
+    nbrs = knn(pts, k=10)
+    assert nbrs.shape == (7, 10)
+    assert (np.sort(nbrs[:, :6], axis=1) >= 0).all()
+    assert (nbrs[:, 6:] == -1).all()  # only 6 possible neighbors exist
+
+
+def test_single_point():
+    nbrs = knn(np.array([[500.0, 500.0, 500.0]], np.float32), k=3)
+    assert (nbrs == -1).all()
+
+
+def test_identical_points():
+    pts = np.full((20, 3), 321.0, np.float32)
+    nbrs = knn(pts, k=4)
+    for r in range(20):
+        row = nbrs[r]
+        assert r not in row.tolist()
+        assert len(set(row.tolist())) == 4
+
+
+def test_explicit_grid_dim(uniform_10k):
+    p = KnnProblem.prepare(uniform_10k, KnnConfig(k=5), dim=9)
+    assert p.grid.dim == 9
+    p.solve()
+    assert np.asarray(p.result.certified).all()
+
+
+def test_k_one(uniform_10k):
+    nbrs = knn(uniform_10k[:3000], k=1)
+    assert nbrs.shape == (3000, 1)
+    assert (nbrs >= 0).all()

@@ -1,0 +1,86 @@
+"""The flagship differential test: TPU engine vs exact C++ kd-tree oracle on the
+reference's shipped fixture -- the re-expression of the reference's entire test
+program (/root/reference/test_knearests.cu:117-235) as described in SURVEY.md
+section 4: permutation sanity, duplicate check, and exact per-point neighbor-set
+agreement with the oracle."""
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.oracle import KdTreeOracle
+
+
+@pytest.fixture(scope="module")
+def solved_20k(pts20k):
+    problem = KnnProblem.prepare(pts20k, KnnConfig(k=10))
+    problem.solve()
+    return problem
+
+
+def test_permutation_bijection(solved_20k):
+    # reference: sort + adjacency assert (test_knearests.cu:162-168)
+    perm = solved_20k.get_permutation()
+    np.testing.assert_array_equal(np.sort(perm), np.arange(len(perm)))
+
+
+def test_no_duplicate_neighbors(solved_20k):
+    # reference: per-point std::set scan (test_knearests.cu:174-191)
+    nbrs = solved_20k.get_knearests_original()
+    n, k = nbrs.shape
+    valid = nbrs >= 0
+    assert valid.all()
+    sorted_rows = np.sort(nbrs, axis=1)
+    assert (np.diff(sorted_rows, axis=1) > 0).all()
+
+
+def test_exact_match_vs_oracle(solved_20k, pts20k):
+    """The core check (reference: test_knearests.cu:215-232): per-point sorted
+    neighbor-id lists must agree elementwise with the exact oracle.
+
+    One refinement over the reference: when the k-th and (k+1)-th candidate are
+    *exactly* tied in f32 (it happens ~3 times in 20,626 points on this fixture),
+    either id is a correct answer -- the reference's all-or-nothing assert is
+    only valid on tie-free data (SURVEY.md section 7 "hard parts").  Ids may
+    differ solely within such exact tie groups at the k-th distance.
+    """
+    nbrs = solved_20k.get_knearests_original()
+    oracle = KdTreeOracle(pts20k)
+    ref_ids, ref_d2 = oracle.knn_all_points(k=10)
+    got = np.sort(nbrs, axis=1)
+    ref = np.sort(ref_ids, axis=1)
+    mismatch = np.nonzero((got != ref).any(axis=1))[0]
+    hard_fail = []
+    for i in mismatch:
+        diff_ids = set(got[i].tolist()) ^ set(ref[i].tolist())
+        kth = float(ref_d2[i, -1])
+        d2 = ((pts20k[list(diff_ids)].astype(np.float64)
+               - pts20k[i].astype(np.float64)) ** 2).sum(-1)
+        # tie window: a few f32 ulps around the k-th distance -- XLA may fuse
+        # (FMA) the distance arithmetic, legitimately flipping 1-ulp orderings
+        if not np.allclose(d2, kth, rtol=2e-6, atol=0.0):
+            hard_fail.append(int(i))
+    if hard_fail:
+        i = hard_fail[0]
+        raise AssertionError(
+            f"{len(hard_fail)} points disagree beyond exact ties; first at "
+            f"point {i}: engine={got[i].tolist()} oracle={ref[i].tolist()} "
+            f"oracle_d2={ref_d2[i].tolist()}")
+    # ties must stay rare -- a real engine bug would blow this up
+    assert mismatch.size <= 10
+
+
+def test_distances_match_oracle(solved_20k, pts20k):
+    """Same arithmetic on both sides ('diff' path) -> distances agree to float
+    exactness, not just id sets."""
+    d2 = solved_20k.get_dists_sq()
+    perm = solved_20k.get_permutation()
+    d2_orig = np.empty_like(d2)
+    d2_orig[perm] = d2
+    oracle = KdTreeOracle(pts20k)
+    _, ref_d2 = oracle.knn_all_points(k=10)
+    np.testing.assert_allclose(d2_orig, ref_d2, rtol=1e-6, atol=1e-3)
+
+
+def test_certified_complete(solved_20k):
+    assert np.asarray(solved_20k.result.certified).all()

@@ -1,0 +1,80 @@
+"""Grid-build invariants (C2): the unit-test split of the reference's monolithic
+end-to-end check, per SURVEY.md section 4 -- CSR offsets sum to n, permutation
+bijection, cell-id correctness, and the determinism the reference lacks."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_knearests_tpu import build_grid
+from cuda_knearests_tpu.config import DOMAIN_SIZE
+from cuda_knearests_tpu.ops.gridhash import (cell_coords, cell_ids, linearize,
+                                             unpermute_neighbors)
+
+
+def _np_cell_ids(pts, dim, domain=DOMAIN_SIZE):
+    c = np.clip((pts * (dim / domain)).astype(np.int64), 0, dim - 1)
+    return c[:, 0] + dim * (c[:, 1] + dim * c[:, 2])
+
+
+def test_cell_ids_match_numpy(uniform_10k):
+    dim = 13
+    got = np.asarray(cell_ids(jnp.asarray(uniform_10k), dim))
+    np.testing.assert_array_equal(got, _np_cell_ids(uniform_10k, dim))
+
+
+def test_cell_coords_clamped():
+    pts = jnp.array([[0.0, 0.0, 0.0], [1000.0, 1000.0, 1000.0],
+                     [999.999, 500.0, 0.001]])
+    c = np.asarray(cell_coords(pts, 10))
+    assert c.min() >= 0 and c.max() <= 9
+    assert tuple(c[1]) == (9, 9, 9)  # exact-boundary point clamps into the grid
+
+
+def test_csr_invariants(uniform_10k):
+    g = build_grid(uniform_10k)
+    counts = np.asarray(g.cell_counts)
+    starts = np.asarray(g.cell_starts)
+    perm = np.asarray(g.permutation)
+    assert counts.sum() == 10_000
+    np.testing.assert_array_equal(starts, np.cumsum(counts) - counts)
+    # permutation is a bijection on 0..n-1 (reference: test_knearests.cu:162-168)
+    np.testing.assert_array_equal(np.sort(perm), np.arange(10_000))
+    # sorted points really are the original points under the permutation
+    np.testing.assert_array_equal(np.asarray(g.points), uniform_10k[perm])
+    # every cell segment holds exactly the points whose cell id is that cell
+    cids_sorted = _np_cell_ids(np.asarray(g.points), g.dim)
+    assert (np.diff(cids_sorted) >= 0).all()
+    seg_ids = np.repeat(np.arange(g.n_cells), counts)
+    np.testing.assert_array_equal(cids_sorted, seg_ids)
+
+
+def test_build_deterministic_and_stable(uniform_10k):
+    g1 = build_grid(uniform_10k)
+    g2 = build_grid(uniform_10k)
+    np.testing.assert_array_equal(np.asarray(g1.permutation),
+                                  np.asarray(g2.permutation))
+    # stability: same-cell points keep input order (fixes the reference's
+    # nondeterministic `reserve`, knearests.cu:40-48)
+    perm = np.asarray(g1.permutation)
+    cids = _np_cell_ids(uniform_10k, g1.dim)
+    same_cell = cids[perm][:-1] == cids[perm][1:]
+    assert (perm[:-1][same_cell] < perm[1:][same_cell]).all()
+
+
+def test_unpermute_roundtrip(uniform_10k):
+    g = build_grid(uniform_10k)
+    n = g.n_points
+    # neighbor table in sorted space whose entries are "my own sorted index"
+    nbr_sorted = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, 4))
+    out = np.asarray(unpermute_neighbors(g, nbr_sorted))
+    np.testing.assert_array_equal(out, np.arange(n)[:, None] * np.ones((1, 4), int))
+    # sentinel passthrough
+    nbr = nbr_sorted.at[:, 0].set(-1)
+    out = np.asarray(unpermute_neighbors(g, nbr))
+    assert (out[:, 0] == -1).all()
+
+
+def test_linearize_x_fastest():
+    c = jnp.array([[1, 0, 0], [0, 1, 0], [0, 0, 1]])
+    ids = np.asarray(linearize(c, 7))
+    np.testing.assert_array_equal(ids, [1, 7, 49])

@@ -1,0 +1,67 @@
+"""I/O layer tests (C11/C15): xyz format, normalization contract, generators."""
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import DOMAIN_SIZE
+from cuda_knearests_tpu.io import (bbox, generate_blue_noise, generate_uniform,
+                                   load_xyz, normalize_points, save_xyz)
+
+
+def test_xyz_roundtrip(tmp_path, rng):
+    pts = rng.random((257, 3)).astype(np.float32) * 123.0
+    path = str(tmp_path / "pts.xyz")
+    save_xyz(path, pts)
+    back = load_xyz(path)
+    assert back.shape == (257, 3)
+    np.testing.assert_allclose(back, pts, rtol=1e-6)
+
+
+def test_xyz_header_mismatch(tmp_path):
+    path = str(tmp_path / "bad.xyz")
+    with open(path, "w") as f:
+        f.write("5\n0 0 0\n1 1 1\n")
+    with pytest.raises(ValueError):
+        load_xyz(path)
+
+
+def test_normalize_domain_contract(rng):
+    pts = rng.random((5000, 3)).astype(np.float32) * [3.0, 70.0, 1.0] + [5, -9, 2]
+    out = normalize_points(pts)
+    assert out.min() >= 0.0 and out.max() <= DOMAIN_SIZE
+    # longest side maps to ~domain, aspect preserved (test_knearests.cu:65-78);
+    # compare raw point spans (bbox() pads, which would distort short axes)
+    spans_in = pts.max(0) - pts.min(0)
+    spans_out = out.max(0) - out.min(0)
+    ratio = spans_out / spans_in
+    np.testing.assert_allclose(ratio, ratio[np.argmax(spans_in)], rtol=1e-3)
+
+
+def test_generators_shapes_and_domain():
+    u = generate_uniform(3000, seed=1)
+    b = generate_blue_noise(3000, seed=1)
+    for pts in (u, b):
+        assert pts.shape == (3000, 3) and pts.dtype == np.float32
+        assert pts.min() >= 0.0 and pts.max() <= DOMAIN_SIZE
+
+
+def test_blue_noise_is_more_even_than_uniform():
+    """Blue noise should concentrate the occupancy histogram (smaller variance
+    of points-per-cell than i.i.d. uniform)."""
+    from cuda_knearests_tpu.ops.gridhash import cell_ids
+    import jax.numpy as jnp
+
+    n, dim = 20_000, 18
+    var = {}
+    for name, pts in (("u", generate_uniform(n, seed=5)),
+                      ("b", generate_blue_noise(n, seed=5))):
+        cid = np.asarray(cell_ids(jnp.asarray(pts), dim))
+        counts = np.bincount(cid, minlength=dim ** 3)
+        var[name] = counts.var()
+    assert var["b"] < 0.7 * var["u"]
+
+
+def test_generators_deterministic():
+    a = generate_blue_noise(1000, seed=9)
+    b = generate_blue_noise(1000, seed=9)
+    np.testing.assert_array_equal(a, b)

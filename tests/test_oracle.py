@@ -1,0 +1,69 @@
+"""Oracle tests (C9): native kd-tree vs numpy brute force, semantics parity."""
+
+import numpy as np
+
+from cuda_knearests_tpu.oracle import KdTreeOracle, native_available
+from conftest import brute_knn_np
+
+
+def test_native_builds():
+    assert native_available(), "C++ oracle failed to build (make -C oracle)"
+
+
+def test_oracle_vs_numpy(uniform_10k, rng):
+    o = KdTreeOracle(uniform_10k)
+    q = rng.integers(0, len(uniform_10k), 128)
+    ids, d2 = o.knn(uniform_10k[q], k=9,
+                    exclude_ids=q.astype(np.int32))
+    ref = brute_knn_np(uniform_10k, q, 9)
+    for r in range(len(q)):
+        assert set(ids[r].tolist()) == set(ref[r].tolist())
+    assert (np.diff(d2, axis=1) >= 0).all()
+
+
+def test_oracle_self_not_excluded_by_default(uniform_10k):
+    """Reference parity: oracle reports the query itself at distance 0 unless
+    excluded (the reference test asks k+1 and drops it,
+    test_knearests.cu:205-211)."""
+    o = KdTreeOracle(uniform_10k)
+    ids, d2 = o.knn(uniform_10k[:16], k=3)
+    assert (ids[:, 0] == np.arange(16)).all()
+    assert (d2[:, 0] == 0.0).all()
+
+
+def test_oracle_all_points(blue_8k, rng):
+    o = KdTreeOracle(blue_8k)
+    ids, _ = o.knn_all_points(k=7)
+    q = rng.integers(0, len(blue_8k), 64)
+    ref = brute_knn_np(blue_8k, q, 7)
+    for r, qi in enumerate(q):
+        assert set(ids[qi].tolist()) == set(ref[r].tolist())
+
+
+def test_oracle_padding_when_n_lt_k(rng):
+    pts = (rng.random((4, 3)) * 1000).astype(np.float32)
+    o = KdTreeOracle(pts)
+    ids, d2 = o.knn(pts, k=6, exclude_ids=np.arange(4, dtype=np.int32))
+    assert (ids[:, 3:] == -1).all()
+    assert np.isinf(d2[:, 3:]).all()
+
+
+def test_oracle_duplicate_coordinates():
+    pts = np.full((5, 3), 100.0, np.float32)
+    o = KdTreeOracle(pts)
+    ids, d2 = o.knn(pts, k=4, exclude_ids=np.arange(5, dtype=np.int32))
+    assert (d2[:, :4] == 0.0).all()
+    for r in range(5):
+        assert r not in ids[r].tolist()
+
+
+def test_numpy_fallback_agrees(uniform_10k, rng):
+    """The pure-numpy fallback must match the native path (same semantics)."""
+    o = KdTreeOracle(uniform_10k[:2000])
+    q = rng.integers(0, 2000, 32)
+    n_ids, n_d2 = o.knn(uniform_10k[q], k=5, exclude_ids=q.astype(np.int32))
+    b_ids, b_d2 = o._brute(uniform_10k[q].astype(np.float32), 5,
+                           q.astype(np.int32))
+    for r in range(32):
+        assert set(n_ids[r].tolist()) == set(b_ids[r].tolist())
+    np.testing.assert_allclose(n_d2, b_d2, rtol=1e-6)

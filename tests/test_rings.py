@@ -1,0 +1,66 @@
+"""Ring schedule tests (C3): shell structure, bound monotonicity and validity."""
+
+import numpy as np
+
+from cuda_knearests_tpu.ops.rings import (box_margin_bound_sq, dilated_box,
+                                          ring_lower_bounds_sq, ring_schedule)
+
+
+def test_schedule_counts():
+    for nmax in (1, 2, 4, 16):
+        s = ring_schedule(nmax)
+        assert s.offsets.shape == ((2 * nmax - 1) ** 3, 3)
+        # ring r has (2r+1)^3 - (2r-1)^3 cells; ring 0 is the center cell
+        sizes = np.diff(s.ring_start)
+        expect = [1] + [(2 * r + 1) ** 3 - (2 * r - 1) ** 3 for r in range(1, nmax)]
+        np.testing.assert_array_equal(sizes, expect)
+        # reference parity: nmax=16 -> 29,791 offsets (knearests.cu:288)
+    assert ring_schedule(16).offsets.shape[0] == 29_791
+
+
+def test_schedule_ring_membership_and_order():
+    s = ring_schedule(5)
+    chan = np.abs(s.offsets).max(axis=1)
+    np.testing.assert_array_equal(chan, s.ring_of)
+    assert (np.diff(s.ring_of) >= 0).all()  # ring-major order
+
+
+def test_lower_bounds_valid_and_monotone():
+    w = 37.5
+    nmax = 6
+    lb = ring_lower_bounds_sq(nmax, w)
+    assert (np.diff(lb) >= 0).all()
+    assert lb[0] == 0.0 and lb[1] == 0.0
+    # validity: a point anywhere in the center cell vs any point in a ring-r
+    # cell is at least sqrt(lb[r]) away
+    rng = np.random.default_rng(0)
+    s = ring_schedule(nmax)
+    for _ in range(200):
+        q = rng.random(3) * w  # in center cell [0,w)^3
+        i = rng.integers(0, len(s.offsets))
+        cell = s.offsets[i]
+        p = (cell + rng.random(3)) * w
+        assert ((q - p) ** 2).sum() >= lb[s.ring_of[i]] - 1e-4
+
+
+def test_box_margin_bound():
+    domain = 1000.0
+    lo = np.array([100.0, 100.0, 100.0])
+    hi = np.array([300.0, 300.0, 300.0])
+    q = np.array([[150.0, 200.0, 250.0]])
+    m2 = box_margin_bound_sq(q, lo, hi, domain)
+    assert m2[0] == 50.0 ** 2  # closest face: x at 100
+    # domain-clamped sides are unconstraining
+    lo2 = np.array([0.0, 100.0, 100.0])
+    q2 = np.array([[10.0, 200.0, 200.0]])
+    m2b = box_margin_bound_sq(q2, lo2, hi, domain)
+    assert m2b[0] == 100.0 ** 2  # x-low side ignored; y/z margins = 100
+    # fully-open box -> infinite margin
+    m2c = box_margin_bound_sq(q2, np.zeros(3), np.full(3, domain), domain)
+    assert np.isinf(m2c[0])
+
+
+def test_dilated_box_clamps():
+    lo, hi = dilated_box((0, 1, 2), supercell=4, radius=2, dim=10)
+    np.testing.assert_array_equal(lo, [0, 2, 6])
+    np.testing.assert_array_equal(hi, [6, 10, 10])

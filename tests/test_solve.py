@@ -1,0 +1,122 @@
+"""Solver tests (C4): packing, exactness vs brute force, honest certificates,
+fallback behavior, and the fast 'dot' distance path."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_knearests_tpu import KnnConfig, build_grid, build_plan, solve
+from cuda_knearests_tpu.ops.solve import brute_force_by_index, pack_cells
+from conftest import brute_knn_np
+
+
+def test_pack_cells_matches_numpy(uniform_10k):
+    g = build_grid(uniform_10k)
+    counts = np.asarray(g.cell_counts)
+    starts = np.asarray(g.cell_starts)
+    rng = np.random.default_rng(0)
+    cells = rng.integers(0, g.n_cells, (6, 9)).astype(np.int32)
+    cells[0, 3:] = -1  # padded row
+    cap = int(counts[cells.clip(0)].sum(1).max()) + 4
+    idx, ok = pack_cells(jnp.asarray(cells), g.cell_starts, g.cell_counts, cap)
+    idx, ok = np.asarray(idx), np.asarray(ok)
+    for r in range(6):
+        expect = np.concatenate([
+            np.arange(starts[c], starts[c] + counts[c])
+            for c in cells[r] if c >= 0]) if (cells[r] >= 0).any() else np.empty(0, int)
+        assert ok[r].sum() == len(expect)
+        np.testing.assert_array_equal(idx[r][ok[r]], expect)
+
+
+def _solve_original_ids(points, cfg):
+    from cuda_knearests_tpu import KnnProblem
+    p = KnnProblem.prepare(points, cfg)
+    p.solve()
+    return p, p.get_knearests_original()
+
+
+def test_exact_vs_brute_uniform(uniform_10k, rng):
+    p, nbrs = _solve_original_ids(uniform_10k, KnnConfig(k=10))
+    q = rng.integers(0, len(uniform_10k), 64)
+    ref = brute_knn_np(uniform_10k, q, 10)
+    for row, qi in enumerate(q):
+        assert set(ref[row].tolist()) == set(nbrs[qi].tolist())
+    assert np.asarray(p.result.certified).all()
+
+
+def test_exact_vs_brute_blue(blue_8k, rng):
+    p, nbrs = _solve_original_ids(blue_8k, KnnConfig(k=20))
+    q = rng.integers(0, len(blue_8k), 48)
+    ref = brute_knn_np(blue_8k, q, 20)
+    for row, qi in enumerate(q):
+        assert set(ref[row].tolist()) == set(nbrs[qi].tolist())
+
+
+def test_certificates_are_honest(uniform_10k, rng):
+    """With a deliberately tiny ring radius and no fallback, certified queries
+    must still be exactly right (the certificate may be conservative, never
+    wrong)."""
+    cfg = KnnConfig(k=12, ring_radius=1, fallback="none")
+    g = build_grid(uniform_10k)
+    res = solve(g, cfg)
+    cert = np.asarray(res.certified)
+    assert 0.0 < cert.mean() < 1.0  # radius 1 cannot certify everything at k=12
+    perm = np.asarray(g.permutation)
+    nbr_sorted = np.asarray(res.neighbors)
+    certified_sorted_idx = np.nonzero(cert[...])[0]
+    pick = rng.choice(certified_sorted_idx, 40, replace=False)
+    for si in pick:
+        orig = perm[si]
+        ref = brute_knn_np(uniform_10k, [orig], 12)[0]
+        got = perm[nbr_sorted[si]]
+        assert set(got.tolist()) == set(ref.tolist())
+
+
+def test_fallback_resolves_everything(uniform_10k, rng):
+    cfg = KnnConfig(k=12, ring_radius=1, fallback="brute")
+    from cuda_knearests_tpu import KnnProblem
+    p = KnnProblem.prepare(uniform_10k, cfg)
+    res = p.solve()
+    assert np.asarray(res.certified).all()
+    nbrs = p.get_knearests_original()
+    q = rng.integers(0, len(uniform_10k), 48)
+    ref = brute_knn_np(uniform_10k, q, 12)
+    for row, qi in enumerate(q):
+        assert set(ref[row].tolist()) == set(nbrs[qi].tolist())
+
+
+def test_dot_distance_path(uniform_10k, rng):
+    """MXU fast path: recall vs exact must be essentially perfect on
+    well-separated data."""
+    _, nbrs_dot = _solve_original_ids(uniform_10k, KnnConfig(k=10, dist_method="dot"))
+    q = rng.integers(0, len(uniform_10k), 64)
+    ref = brute_knn_np(uniform_10k, q, 10)
+    hits = sum(len(set(ref[r].tolist()) & set(nbrs_dot[qi].tolist()))
+               for r, qi in enumerate(q))
+    assert hits / (64 * 10) >= 0.995
+
+
+def test_brute_force_by_index(uniform_10k):
+    g = build_grid(uniform_10k)
+    q_idx = jnp.asarray(np.array([0, 5, 99, -1], np.int32))
+    ids, d2 = brute_force_by_index(g.points, q_idx, k=6)
+    ids, d2 = np.asarray(ids), np.asarray(d2)
+    assert (ids[3] == -1).all() and np.isinf(d2[3]).all()
+    pts = np.asarray(g.points)
+    for r, qi in enumerate([0, 5, 99]):
+        ref = brute_knn_np(pts, [qi], 6)[0]
+        np.testing.assert_array_equal(ids[r], ref)
+    assert (np.diff(d2[:3], axis=1) >= 0).all()
+
+
+def test_results_ascending_and_no_duplicates(blue_8k):
+    from cuda_knearests_tpu import KnnProblem
+    p = KnnProblem.prepare(blue_8k, KnnConfig(k=15))
+    p.solve()
+    d2 = p.get_dists_sq()
+    assert (np.diff(d2, axis=1) >= 0).all()
+    nbrs = p.get_knearests()
+    for r in range(0, len(nbrs), 257):  # duplicate check (test_knearests.cu:174-191)
+        row = nbrs[r][nbrs[r] >= 0]
+        assert len(set(row.tolist())) == len(row)
