@@ -51,6 +51,7 @@ class KnnProblem:
     config: KnnConfig
     plan: Optional[SolvePlan] = None
     result: Optional[KnnResult] = None
+    pack: Optional[object] = None  # cached PallasPack (pallas backend only)
 
     @classmethod
     def prepare(cls, points, config: KnnConfig | None = None,
@@ -69,17 +70,25 @@ class KnnProblem:
     def solve(self) -> KnnResult:
         """Run the grid solve, then resolve uncertified queries exactly
         (reference analog: kn_solve, knearests.cu:348-392)."""
-        res = solve(self.grid, self.config, self.plan)
+        from .ops.solve import prepare_pack
+
+        if self.plan is None:
+            self.plan = build_plan(self.grid, self.config)
+        if self.pack is None:
+            self.pack = prepare_pack(self.grid, self.config, self.plan)
+        res = solve(self.grid, self.config, self.plan, self.pack)
         if self.config.fallback == "brute":
             res = self._resolve_uncertified(res)
         self.result = res
         return res
 
     def _resolve_uncertified(self, res: KnnResult) -> KnnResult:
+        # Scalar readback first: certification is ~always total, so the common
+        # path costs an 8-byte transfer, not the full (n,) mask.
+        if int(jax.device_get(jax.numpy.sum(~res.certified))) == 0:
+            return res
         cert = np.asarray(jax.device_get(res.certified))
         bad = np.nonzero(~cert)[0].astype(np.int32)
-        if bad.size == 0:
-            return res
         # Pad to a power of two so repeated solves reuse a handful of compiles.
         q_idx = _pad_pow2(bad, fill=-1)
         b_ids, b_d2 = brute_force_by_index(

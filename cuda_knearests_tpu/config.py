@@ -76,6 +76,9 @@ class KnnConfig:
         coordinate duplicates of the query are still reported.
       fallback: resolve uncertified queries exactly by tiled brute force ('brute'),
         or leave them best-effort ('none').
+      backend: 'pallas' = fused VMEM kernel (ops/pallas_solve.py), 'xla' = pure
+        XLA supercell scan (ops/solve.py), 'auto' = pallas on TPU when the tile
+        fits VMEM, else xla.
       interpret: run Pallas kernels in interpreter mode (CPU testing).
     """
 
@@ -87,6 +90,7 @@ class KnnConfig:
     dist_method: str = "diff"
     exclude_self: bool = True
     fallback: str = "brute"
+    backend: str = "auto"
     interpret: bool = False
 
     def resolved_ring_radius(self) -> int:

@@ -1,0 +1,241 @@
+"""Pallas TPU kernel for the supercell kNN solve (the hot path).
+
+Reference parity (C4, /root/reference/knearests.cu:93-148): the reference's CUDA
+search kernel keeps a per-thread k-max-heap in block shared memory while scanning
+ring candidates.  The XLA path (solve.py) replaces the heap with ``lax.top_k``,
+but XLA lowers that to a full stable sort of the (batch, Q, C) distance tensor --
+the sort, not the distance arithmetic, dominates the solve and spills multi-GB
+temporaries to HBM.
+
+This kernel is the VMEM-native redesign: one Pallas program per supercell
+
+  1. loads the supercell's padded query block (Q, 3) and per-axis candidate
+     lane blocks (1, C) into VMEM,
+  2. computes the full (Q, C) squared-distance tile on the VPU with the same
+     x,y,z accumulation order as the reference (knearests.cu:125),
+  3. extracts the k nearest by k unrolled min-and-mask passes over the
+     VMEM-resident tile (the shared-memory-heap analog: O(k*C) VPU work, zero
+     HBM traffic for the distance tile),
+  4. writes ascending (k, Q) distances and stored-point ids.
+
+The candidate/query *indexing* (CSR slot packing and coordinate gathers) is
+static per problem, so it lives in :class:`PallasPack`, built once at prepare
+time -- the analog of the reference precomputing its offset tables in
+kn_prepare (knearests.cu:254-300) so kn_solve is one kernel launch.  Steady-
+state solve = kernel + certificate + un-pad scatter, nothing else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .gridhash import GridHash
+from .solve import (KnnResult, SolvePlan, _margin_sq, build_plan, pack_cells)
+from .topk import INVALID_ID
+
+# Sentinels for padded query/candidate id lanes.  Distinct negatives so a padded
+# query never "self-excludes" a padded candidate.
+_PAD_Q = -2
+_PAD_C = -3
+
+_BIG_ID = 2**31 - 1
+
+# Conservative per-program VMEM budget (bytes) for choosing this path.
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("q", "cx", "cy", "cz", "qid3", "cid3", "q_idx", "q_ok",
+                 "lo", "hi"),
+    meta_fields=("qcap", "ccap", "s_total"),
+)
+@dataclasses.dataclass(frozen=True)
+class PallasPack:
+    """Static per-problem kernel inputs: packed CSR slots + gathered coords.
+
+    q:        (S, qcap, 3) f32 query coords per supercell (pad rows garbage).
+    cx/cy/cz: (S, 1, ccap) f32 candidate coords, one lane block per axis.
+    qid3:     (S, 1, qcap) i32 stored-point id per query slot (_PAD_Q pads).
+    cid3:     (S, 1, ccap) i32 stored-point id per candidate slot (_PAD_C pads).
+    q_idx/q_ok: (S, qcap) scatter targets / validity for the epilogue.
+    lo/hi:    (S, 3) f32 dilated-box corners for the completeness certificate.
+    """
+
+    q: jax.Array
+    cx: jax.Array
+    cy: jax.Array
+    cz: jax.Array
+    qid3: jax.Array
+    cid3: jax.Array
+    q_idx: jax.Array
+    q_ok: jax.Array
+    lo: jax.Array
+    hi: jax.Array
+    qcap: int
+    ccap: int
+    s_total: int
+
+
+def _kernel(q_ref, cx_ref, cy_ref, cz_ref, qid_ref, cid_ref,
+            out_d_ref, out_i_ref, *, k: int, exclude_self: bool):
+    """One supercell: (Q,3) queries x (1,C) candidate axes -> ascending (k,Q)
+    best distances and stored-point ids.
+
+    Padded candidate lanes carry garbage coordinates; they are masked here by
+    their _PAD_C id (cheaper than a FAR-coordinate fill pass over HBM).  The
+    k-pass min-and-mask is the reference heap's functional twin: pass i finds
+    the i-th nearest and masks it out of the tile.  The winner's id is
+    extracted by a masked min over the candidate-id lanes -- cid is ascending
+    over slots, so ties resolve to the lowest slot, exactly like a stable sort.
+    """
+    d2 = None
+    # same x,y,z accumulation order as knearests.cu:125
+    for ax, c_ref in enumerate((cx_ref, cy_ref, cz_ref)):
+        qa = q_ref[0, :, ax].reshape(-1, 1)   # (Q, 1)
+        ca = c_ref[0, 0, :].reshape(1, -1)    # (1, C)
+        diff = qa - ca
+        d2 = diff * diff if d2 is None else d2 + diff * diff
+    ci = cid_ref[0, 0, :].reshape(1, -1)
+    drop = ci == _PAD_C
+    if exclude_self:
+        # skip self by storage index (knearests.cu:123): coordinate duplicates
+        # of the query are still reported.
+        qi = qid_ref[0, 0, :].reshape(-1, 1)
+        drop = drop | (qi == ci)
+    d2 = jnp.where(drop, jnp.inf, d2)
+    for i in range(k):
+        m = jnp.min(d2, axis=1)
+        sel = d2 == m[:, None]
+        bid = jnp.min(jnp.where(sel, ci, _BIG_ID), axis=1)
+        out_d_ref[0, i, :] = m
+        out_i_ref[0, i, :] = bid
+        if i + 1 < k:
+            d2 = jnp.where(sel & (ci == bid[:, None]), jnp.inf, d2)
+
+
+def vmem_bytes_estimate(qcap: int, ccap: int, k: int) -> int:
+    """Rough per-program VMEM need: d2 tile + in/out blocks (f32/i32 = 4B),
+    with lane/sublane padding accounted."""
+    q_pad = -(-qcap // 128) * 128
+    k_pad = -(-k // 8) * 8
+    tile = q_pad * ccap                       # d2 (+ the masked copy is fused)
+    inputs = q_pad * 128 + 8 * ccap + q_pad + ccap
+    outputs = 2 * k_pad * q_pad
+    return 4 * (2 * tile + inputs + outputs)
+
+
+def pallas_fits(qcap: int, ccap: int, k: int) -> bool:
+    return vmem_bytes_estimate(qcap, ccap, k) <= _VMEM_BUDGET
+
+
+@jax.jit
+def build_pack(points: jax.Array, starts: jax.Array, counts: jax.Array,
+               plan: SolvePlan) -> PallasPack:
+    """Pack CSR slots and gather all kernel inputs (once per problem)."""
+    s_total = plan.n_chunks * plan.batch
+    own = plan.own_cells.reshape(s_total, -1)
+    cand = plan.cand_cells.reshape(s_total, -1)
+    qcap = -(-plan.qcap // 128) * 128  # queries sit on the lane axis of outputs
+    ccap = plan.ccap
+
+    q_idx, q_ok = pack_cells(own, starts, counts, qcap)
+    c_idx, c_ok = pack_cells(cand, starts, counts, ccap)
+    q = jnp.take(points, q_idx, axis=0)
+    # Candidate coordinates one axis at a time as (S, 1, C): the lane axis (C)
+    # never moves -- no 100-MB-scale transpose pass -- and each fits the TPU
+    # block-shape rules.
+    axes = points.T  # (3, n)
+    cx, cy, cz = (jnp.take(axes[ax], c_idx, axis=0).reshape(s_total, 1, ccap)
+                  for ax in range(3))
+    qid3 = jnp.where(q_ok, q_idx, _PAD_Q).astype(jnp.int32).reshape(
+        s_total, 1, qcap)
+    cid3 = jnp.where(c_ok, c_idx, _PAD_C).astype(jnp.int32).reshape(
+        s_total, 1, ccap)
+    return PallasPack(
+        q=q, cx=cx, cy=cy, cz=cz, qid3=qid3, cid3=cid3,
+        q_idx=q_idx, q_ok=q_ok,
+        lo=plan.box_lo.reshape(s_total, 3), hi=plan.box_hi.reshape(s_total, 3),
+        qcap=int(qcap), ccap=int(ccap), s_total=int(s_total))
+
+
+@functools.partial(jax.jit, static_argnames=("n", "k", "exclude_self", "domain",
+                                             "interpret"))
+def _solve_packed(pack: PallasPack, n: int, k: int, exclude_self: bool,
+                  domain: float, interpret: bool = False):
+    """Steady-state solve: kernel launch + certificates + un-pad scatter.
+    Returns ((n,k) ids, (n,k) d2, (n,) certified), sorted indexing."""
+    s_total, qcap, ccap = pack.s_total, pack.qcap, pack.ccap
+
+    out_d, out_i = pl.pallas_call(
+        functools.partial(_kernel, k=k, exclude_self=exclude_self),
+        grid=(s_total,),
+        in_specs=[
+            pl.BlockSpec((1, qcap, 3), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k, qcap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k, qcap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s_total, k, qcap), jnp.float32),
+            jax.ShapeDtypeStruct((s_total, k, qcap), jnp.int32),
+        ],
+        interpret=interpret,
+    )(pack.q, pack.cx, pack.cy, pack.cz, pack.qid3, pack.cid3)
+
+    best_d = out_d.transpose(0, 2, 1)                      # (S, Q, k) ascending
+    best_i = out_i.transpose(0, 2, 1)
+    ok = jnp.isfinite(best_d)
+    best_i = jnp.where(ok, best_i, INVALID_ID)
+    best_d = jnp.where(ok, best_d, jnp.inf)
+
+    kth = best_d[..., k - 1]
+    cert = pack.q_ok & (kth <= _margin_sq(pack.q, pack.lo, pack.hi, domain))
+
+    out_d_full = jnp.full((n, k), jnp.inf, jnp.float32)
+    out_i_full = jnp.full((n, k), INVALID_ID, jnp.int32)
+    out_cert = jnp.zeros((n,), bool)
+    safe = jnp.where(pack.q_ok, pack.q_idx, n)  # n = out of bounds -> dropped
+    out_d_full = out_d_full.at[safe].set(best_d, mode="drop")
+    out_i_full = out_i_full.at[safe].set(best_i, mode="drop")
+    out_cert = out_cert.at[safe].set(cert, mode="drop")
+    return out_i_full, out_d_full, out_cert
+
+
+def solve_pallas(grid: GridHash, cfg, plan: SolvePlan | None = None,
+                 pack: PallasPack | None = None) -> KnnResult:
+    """Grid-accelerated all-points kNN via the fused Pallas kernel.  Same
+    contract as solve.solve (sorted indexing, uncertified rows left for the
+    api-level exact fallback).  Pass a prebuilt ``pack`` for steady-state
+    repeat solves (api.KnnProblem caches one)."""
+    if plan is None:
+        plan = build_plan(grid, cfg)
+    if not pallas_fits(plan.qcap, plan.ccap, cfg.k):
+        raise ValueError(
+            f"supercell tile qcap={plan.qcap} x ccap={plan.ccap} exceeds the "
+            f"VMEM budget; use a smaller config.supercell or backend='xla'")
+    if pack is None:
+        pack = build_pack(grid.points, grid.cell_starts, grid.cell_counts, plan)
+    nbr, d2, cert = _solve_packed(pack, grid.n_points, cfg.k, cfg.exclude_self,
+                                  grid.domain, cfg.interpret)
+    return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert)

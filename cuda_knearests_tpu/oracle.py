@@ -22,14 +22,16 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _ORACLE_DIR = os.path.join(_REPO_ROOT, "oracle")
 _LIB_PATH = os.path.join(_ORACLE_DIR, "liboracle.so")
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
+# None = not attempted; False = attempted and failed (don't re-run make);
+# CDLL = loaded.
+_lib: "ctypes.CDLL | bool | None" = None
 
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib
     with _lock:
         if _lib is not None:
-            return _lib
+            return _lib or None
         try:
             if not os.path.exists(_LIB_PATH):
                 subprocess.run(["make", "-C", _ORACLE_DIR, "-s"], check=True,
@@ -47,6 +49,8 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float)]
         except Exception:
             # stale/wrong-arch .so or no toolchain: fall back to numpy brute
+            # (cached so a failing `make` isn't re-spawned per oracle)
+            _lib = False
             return None
         _lib = lib
         return _lib

@@ -1,0 +1,39 @@
+"""Tuning sweep on the real chip: solve time vs config knobs (dev tool)."""
+import itertools
+import sys
+import time
+
+import jax
+import numpy as np
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.io import get_dataset
+from cuda_knearests_tpu.utils.stopwatch import block
+
+name = sys.argv[1] if len(sys.argv) > 1 else "900k_blue_cube.xyz"
+k = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+points = get_dataset(name)
+n = points.shape[0]
+print(f"{name}: n={n} k={k} devices={jax.devices()}")
+
+for method, sc, batch in itertools.product(["diff", "dot"], [4, 6, 8], [64, 256]):
+    cfg = KnnConfig(k=k, dist_method=method, supercell=sc, sc_batch=batch)
+    try:
+        t0 = time.perf_counter()
+        problem = KnnProblem.prepare(points, cfg)
+        prep_s = time.perf_counter() - t0
+        res = problem.solve()
+        block((res.neighbors, res.dists_sq))  # compile+run
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            res = problem.solve()
+            block((res.neighbors, res.dists_sq))
+            times.append(time.perf_counter() - t0)
+        s = min(times)
+        print(f"method={method} sc={sc} batch={batch}: solve={s*1e3:8.1f} ms "
+              f"qps={n/s:10.0f} prep={prep_s*1e3:6.0f} ms "
+              f"qcap={problem.plan.qcap} ccap={problem.plan.ccap} "
+              f"chunks={problem.plan.n_chunks} cert={float(np.asarray(res.certified).mean()):.4f}")
+    except Exception as e:  # noqa: BLE001
+        print(f"method={method} sc={sc} batch={batch}: FAILED {type(e).__name__}: {e}")

@@ -1,0 +1,86 @@
+"""Microbench: exact top-k strategies over a (B, Q, C) distance tile (dev tool)."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, Q, C, K = 64, 232, 1664, 10
+rng = np.random.default_rng(0)
+d2 = jnp.asarray(rng.random((B, Q, C), dtype=np.float32))
+ids = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32)[None, None, :], (B, Q, C))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def via_topk(d2, ids, k):
+    neg, slot = jax.lax.top_k(-d2, k)
+    return -neg, jnp.take_along_axis(ids, slot, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def via_kpass(d2, ids, k):
+    iota = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 2)
+
+    def body(carry, _):
+        d2 = carry
+        arg = jnp.argmin(d2, axis=-1)
+        val = jnp.take_along_axis(d2, arg[..., None], axis=-1)[..., 0]
+        d2 = jnp.where(iota == arg[..., None], jnp.inf, d2)
+        return d2, (val, arg)
+
+    _, (vals, args) = jax.lax.scan(body, d2, None, length=k)
+    vals = jnp.moveaxis(vals, 0, -1)
+    args = jnp.moveaxis(args, 0, -1)
+    return vals, jnp.take_along_axis(ids, args, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def via_approx(d2, ids, k):
+    val, arg = jax.lax.approx_min_k(d2, k, recall_target=0.999)
+    return val, jnp.take_along_axis(ids, arg, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def via_approx_exact(d2, ids, k):
+    val, arg = jax.lax.approx_min_k(
+        d2, k, recall_target=1.0, reduction_input_size_override=C)
+    return val, jnp.take_along_axis(ids, arg, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def via_twolevel(d2, ids, k):
+    # stage 1: top-k within each 128-lane tile via small sorts; stage 2: top-k of winners
+    t = 128
+    n_t = C // t
+    d2r = d2.reshape(B, Q, n_t, t)
+    neg, slot = jax.lax.top_k(-d2r, k)              # (B,Q,n_t,k)
+    cand_d = (-neg).reshape(B, Q, n_t * k)
+    base = (jnp.arange(n_t, dtype=jnp.int32) * t)[None, None, :, None]
+    cand_i = (slot + base).reshape(B, Q, n_t * k)
+    neg2, slot2 = jax.lax.top_k(-cand_d, k)
+    best_i = jnp.take_along_axis(cand_i, slot2, axis=-1)
+    return -neg2, jnp.take_along_axis(ids.reshape(B, Q, C), best_i, axis=-1)
+
+
+ref_d, ref_i = None, None
+for name, fn in [("top_k", via_topk), ("kpass", via_kpass),
+                 ("approx.999", via_approx), ("approx_exact", via_approx_exact),
+                 ("twolevel", via_twolevel)]:
+    try:
+        out = fn(d2, ids, K)
+        jax.block_until_ready(out)
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = fn(d2, ids, K)
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+        if ref_d is None:
+            ref_d, ref_i = np.asarray(out[0]), np.asarray(out[1])
+            match = 1.0
+        else:
+            match = float((np.sort(np.asarray(out[1]), -1) == np.sort(ref_i, -1)).mean())
+        print(f"{name:14s}: {min(times)*1e3:8.2f} ms  id-match={match:.6f}")
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:14s}: FAILED {type(e).__name__}: {str(e)[:200]}")

@@ -1,0 +1,78 @@
+"""Pallas kernel path: interpret-mode equivalence with the XLA path.
+
+The fused kernel must reproduce the XLA supercell scan bit-for-bit (same diff
+arithmetic, same ascending order, same lowest-slot tie-break), so these run the
+two backends side by side on the emulated CPU platform (conftest).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.io import generate_blue_noise, generate_uniform
+from cuda_knearests_tpu.ops.pallas_solve import pallas_fits, vmem_bytes_estimate
+
+XLA = KnnConfig(k=8, backend="xla")
+PAL = KnnConfig(k=8, backend="pallas", interpret=True)
+
+
+def _solve_pair(points, cfg_a=XLA, cfg_b=PAL):
+    pa = KnnProblem.prepare(points, cfg_a)
+    pb = KnnProblem.prepare(points, cfg_b)
+    ra, rb = pa.solve(), pb.solve()
+    return pa, pb, ra, rb
+
+
+@pytest.mark.parametrize("gen,n", [(generate_uniform, 9000),
+                                   (generate_blue_noise, 7000)])
+def test_pallas_matches_xla(gen, n):
+    points = gen(n, seed=5)
+    pa, pb, ra, rb = _solve_pair(points)
+    np.testing.assert_array_equal(np.asarray(ra.neighbors),
+                                  np.asarray(rb.neighbors))
+    np.testing.assert_array_equal(np.asarray(ra.dists_sq),
+                                  np.asarray(rb.dists_sq))
+    np.testing.assert_array_equal(np.asarray(ra.certified),
+                                  np.asarray(rb.certified))
+
+
+def test_pallas_pack_is_cached_and_reused():
+    points = generate_uniform(6000, seed=9)
+    p = KnnProblem.prepare(points, PAL)
+    r1 = p.solve()
+    pack = p.pack
+    assert pack is not None
+    r2 = p.solve()
+    assert p.pack is pack  # reused, not rebuilt
+    np.testing.assert_array_equal(np.asarray(r1.neighbors),
+                                  np.asarray(r2.neighbors))
+
+
+def test_pallas_with_duplicate_points():
+    # coordinate duplicates of a query are reported, self (by index) is not
+    points = generate_uniform(5000, seed=11)
+    points[100] = points[7]
+    points[101] = points[7]
+    _, pb, _, rb = _solve_pair(points)
+    nbrs = pb.get_knearests_original()
+    assert 100 in set(nbrs[7].tolist()) and 101 in set(nbrs[7].tolist())
+    for qi in (7, 100, 101):
+        assert qi not in set(nbrs[qi].tolist())
+
+
+def test_pallas_include_self():
+    points = generate_uniform(5000, seed=12)
+    cfg = dataclasses.replace(PAL, exclude_self=False)
+    p = KnnProblem.prepare(points, cfg)
+    p.solve()
+    nbrs = p.get_knearests_original()
+    # with self included, every point's nearest neighbor is itself (dist 0)
+    assert (nbrs[:, 0] == np.arange(len(points))).all()
+
+
+def test_vmem_estimate_monotone_and_gate():
+    assert vmem_bytes_estimate(256, 1664, 10) < vmem_bytes_estimate(256, 3328, 10)
+    assert pallas_fits(256, 1664, 10)
+    assert not pallas_fits(2048, 8192, 50)
