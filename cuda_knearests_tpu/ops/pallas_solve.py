@@ -135,6 +135,76 @@ def pallas_fits(qcap: int, ccap: int, k: int) -> bool:
     return vmem_bytes_estimate(qcap, ccap, k) <= _VMEM_BUDGET
 
 
+def _pallas_topk(q, cx, cy, cz, qid3, cid3, qcap: int, ccap: int, k: int,
+                 exclude_self: bool, interpret: bool, vma=None):
+    """Launch the kernel over a flat supercell grid.  Returns ((S,k,Q) dists,
+    (S,k,Q) ids) -- raw, untransposed.  ``vma`` marks outputs as varying over
+    mesh axes when called inside a shard_map (e.g. frozenset({'z'}))."""
+    s_total = q.shape[0]
+    out_kw = {} if vma is None else {"vma": frozenset(vma)}
+    return pl.pallas_call(
+        functools.partial(_kernel, k=k, exclude_self=exclude_self),
+        grid=(s_total,),
+        in_specs=[
+            pl.BlockSpec((1, qcap, 3), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k, qcap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k, qcap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s_total, k, qcap), jnp.float32, **out_kw),
+            jax.ShapeDtypeStruct((s_total, k, qcap), jnp.int32, **out_kw),
+        ],
+        interpret=interpret,
+    )(q, cx, cy, cz, qid3, cid3)
+
+
+def packed_best(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                own: jax.Array, cand: jax.Array, lo: jax.Array, hi: jax.Array,
+                qcap: int, ccap: int, k: int, exclude_self: bool, domain: float,
+                interpret: bool = False, vma=None):
+    """Pallas twin of solve.chunk_best over a flat (S, ...) supercell schedule:
+    pack, gather, kernel, certify.  Works on any (points, CSR) triplet --
+    including the halo-extended local arrays inside the sharded shard_map
+    (parallel/sharded.py).  Returns (q_idx, q_ok, (S,Q,k) dists ascending,
+    (S,Q,k) ids into `points`, (S,Q) certificates)."""
+    s_total = own.shape[0]
+    qcap = -(-qcap // 128) * 128
+    q_idx, q_ok = pack_cells(own, starts, counts, qcap)
+    c_idx, c_ok = pack_cells(cand, starts, counts, ccap)
+    q = jnp.take(points, q_idx, axis=0)
+    axes = points.T
+    cx, cy, cz = (jnp.take(axes[ax], c_idx, axis=0).reshape(s_total, 1, ccap)
+                  for ax in range(3))
+    qid3 = jnp.where(q_ok, q_idx, _PAD_Q).astype(jnp.int32).reshape(
+        s_total, 1, qcap)
+    cid3 = jnp.where(c_ok, c_idx, _PAD_C).astype(jnp.int32).reshape(
+        s_total, 1, ccap)
+    out_d, out_i = _pallas_topk(q, cx, cy, cz, qid3, cid3, qcap, ccap, k,
+                                exclude_self, interpret, vma)
+    best_d = out_d.transpose(0, 2, 1)
+    best_i = out_i.transpose(0, 2, 1)
+    ok = jnp.isfinite(best_d)
+    best_i = jnp.where(ok, best_i, INVALID_ID)
+    best_d = jnp.where(ok, best_d, jnp.inf)
+    cert = q_ok & (best_d[..., k - 1] <= _margin_sq(q, lo, hi, domain))
+    return q_idx, q_ok, best_d, best_i, cert
+
+
 @jax.jit
 def build_pack(points: jax.Array, starts: jax.Array, counts: jax.Array,
                plan: SolvePlan) -> PallasPack:
@@ -171,37 +241,11 @@ def _solve_packed(pack: PallasPack, n: int, k: int, exclude_self: bool,
                   domain: float, interpret: bool = False):
     """Steady-state solve: kernel launch + certificates + un-pad scatter.
     Returns ((n,k) ids, (n,k) d2, (n,) certified), sorted indexing."""
-    s_total, qcap, ccap = pack.s_total, pack.qcap, pack.ccap
+    qcap, ccap = pack.qcap, pack.ccap
 
-    out_d, out_i = pl.pallas_call(
-        functools.partial(_kernel, k=k, exclude_self=exclude_self),
-        grid=(s_total,),
-        in_specs=[
-            pl.BlockSpec((1, qcap, 3), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, k, qcap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k, qcap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((s_total, k, qcap), jnp.float32),
-            jax.ShapeDtypeStruct((s_total, k, qcap), jnp.int32),
-        ],
-        interpret=interpret,
-    )(pack.q, pack.cx, pack.cy, pack.cz, pack.qid3, pack.cid3)
+    out_d, out_i = _pallas_topk(pack.q, pack.cx, pack.cy, pack.cz,
+                                pack.qid3, pack.cid3, qcap, ccap, k,
+                                exclude_self, interpret)
 
     best_d = out_d.transpose(0, 2, 1)                      # (S, Q, k) ascending
     best_i = out_i.transpose(0, 2, 1)

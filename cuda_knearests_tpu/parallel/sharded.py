@@ -199,8 +199,22 @@ def build_sharded_plan(grid: GridHash, cfg: KnnConfig, ndev: int,
         hcap=int(hcap))
 
 
-def _make_device_solve(plan: ShardedPlan, cfg: KnnConfig, domain: float):
-    """The per-chip program run under shard_map: halo exchange + local solve."""
+def _use_pallas(cfg: KnnConfig, qcap: int, ccap: int) -> bool:
+    """Same policy as ops.solve.resolve_backend, on the sharded plan's caps."""
+    from ..ops.pallas_solve import pallas_fits
+
+    if cfg.backend == "pallas":
+        return True
+    if cfg.backend != "auto":
+        return False
+    on_tpu = jax.devices()[0].platform == "tpu"
+    return (on_tpu or cfg.interpret) and pallas_fits(qcap, ccap, cfg.k)
+
+
+def _make_device_solve(plan: ShardedPlan, cfg: KnnConfig, domain: float,
+                       use_pallas: bool):
+    """The per-chip program run under shard_map: halo exchange + local solve
+    (fused Pallas kernel on TPU, chunked XLA scan otherwise)."""
     ndev, k = plan.ndev, cfg.k
     hcap, pcap = plan.hcap, plan.pcap
     fwd = [(i, i + 1) for i in range(ndev - 1)]   # chip d -> d+1
@@ -242,19 +256,16 @@ def _make_device_solve(plan: ShardedPlan, cfg: KnnConfig, domain: float):
         ext_counts = jnp.concatenate([lo_counts, local_counts, hi_counts])
 
         # mark the carry as device-varying over the mesh axis (each chip
-        # accumulates its own slab's outputs)
-        vary = lambda a: jax.lax.pcast(a, ("z",), to="varying")
+        # accumulates its own slab's outputs); moot when the vma checker is
+        # off (pallas branch)
+        vary = ((lambda a: a) if use_pallas
+                else (lambda a: jax.lax.pcast(a, ("z",), to="varying")))
         out_d = vary(jnp.full((pcap, k), jnp.inf, jnp.float32))
         out_i = vary(jnp.full((pcap, k), INVALID_ID, jnp.int32))
         out_cert = vary(jnp.zeros((pcap,), bool))
 
-        def step(carry, chunk):
+        def to_global_and_scatter(carry, q_idx, q_valid, best_d, best_i, cert):
             out_d, out_i, out_cert = carry
-            own_c, cand_c, lo_c, hi_c = chunk
-            q_idx, q_valid, best_d, best_i, cert = chunk_best(
-                ext_pts, ext_starts, ext_counts, own_c, cand_c, lo_c, hi_c,
-                plan.qcap, plan.ccap, k, cfg.dist_method, cfg.exclude_self,
-                domain)
             # extended index -> global sorted index
             in_lo = best_i < hcap
             in_loc = best_i < hcap + pcap
@@ -267,10 +278,30 @@ def _make_device_solve(plan: ShardedPlan, cfg: KnnConfig, domain: float):
             out_d = out_d.at[safe].set(best_d, mode="drop")
             out_i = out_i.at[safe].set(gl, mode="drop")
             out_cert = out_cert.at[safe].set(cert, mode="drop")
-            return (out_d, out_i, out_cert), None
+            return out_d, out_i, out_cert
 
-        (out_d, out_i, out_cert), _ = jax.lax.scan(
-            step, (out_d, out_i, out_cert), (own, cand, blo, bhi))
+        if use_pallas:
+            from ..ops.pallas_solve import packed_best
+
+            flat = lambda a: a.reshape((-1,) + a.shape[2:])
+            q_idx, q_valid, best_d, best_i, cert = packed_best(
+                ext_pts, ext_starts, ext_counts, flat(own), flat(cand),
+                flat(blo), flat(bhi), plan.qcap, plan.ccap, k,
+                cfg.exclude_self, domain, cfg.interpret)
+            out_d, out_i, out_cert = to_global_and_scatter(
+                (out_d, out_i, out_cert), q_idx, q_valid, best_d, best_i, cert)
+        else:
+            def step(carry, chunk):
+                own_c, cand_c, lo_c, hi_c = chunk
+                q_idx, q_valid, best_d, best_i, cert = chunk_best(
+                    ext_pts, ext_starts, ext_counts, own_c, cand_c, lo_c, hi_c,
+                    plan.qcap, plan.ccap, k, cfg.dist_method, cfg.exclude_self,
+                    domain)
+                return to_global_and_scatter(carry, q_idx, q_valid, best_d,
+                                             best_i, cert), None
+
+            (out_d, out_i, out_cert), _ = jax.lax.scan(
+                step, (out_d, out_i, out_cert), (own, cand, blo, bhi))
         return out_i[None], out_d[None], out_cert[None]
 
     return device_fn
@@ -312,11 +343,15 @@ class ShardedKnnProblem:
         plan, cfg = self.plan, self.config
         if self._fn is None:
             # built once per problem so repeated solves reuse the compile cache
+            use_pallas = _use_pallas(cfg, plan.qcap, plan.ccap)
             spec_tree = (P("z"),) * 13
             self._fn = jax.jit(jax.shard_map(
-                _make_device_solve(plan, cfg, self.grid.domain),
+                _make_device_solve(plan, cfg, self.grid.domain, use_pallas),
                 mesh=self.mesh, in_specs=spec_tree,
-                out_specs=(P("z"), P("z"), P("z"))))
+                out_specs=(P("z"), P("z"), P("z")),
+                # pallas_call's block machinery trips the vma checker (its
+                # internal dynamic_slice mixes varying/invariant operands)
+                check_vma=not use_pallas))
         out_i, out_d, out_cert = self._fn(
             plan.local_pts, plan.local_counts, plan.local_base,
             plan.bot_pts, plan.bot_counts, plan.bot_base,

@@ -68,6 +68,20 @@ def test_sharded_boundary_queries_certified(uniform_10k):
     assert (nbrs >= 0).all()
 
 
+def test_sharded_pallas_matches_xla(blue_8k):
+    """The in-shard_map Pallas kernel (interpret mode here) must match the
+    chunked XLA scan bit-for-bit, including halo-crossing neighbors."""
+    cfg_x = KnnConfig(k=8, sc_batch=16, backend="xla")
+    cfg_p = KnnConfig(k=8, sc_batch=16, backend="pallas", interpret=True)
+    nx, dx, cx = ShardedKnnProblem.prepare(blue_8k, n_devices=8,
+                                           config=cfg_x).solve()
+    np_, dp, cp = ShardedKnnProblem.prepare(blue_8k, n_devices=8,
+                                            config=cfg_p).solve()
+    np.testing.assert_array_equal(nx, np_)
+    np.testing.assert_array_equal(dx, dp)
+    assert cx.all() and cp.all()
+
+
 def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
