@@ -65,7 +65,8 @@ def main(argv=None) -> int:
     ap.add_argument("--k", type=int, default=10)
     ap.add_argument("--density", type=float, default=3.1)
     ap.add_argument("--ring-radius", type=int, default=None)
-    ap.add_argument("--supercell", type=int, default=4)
+    ap.add_argument("--supercell", type=int, default=None,
+                    help="query-tile side in cells (default: KnnConfig default)")
     ap.add_argument("--dist", choices=("diff", "dot"), default="diff")
     ap.add_argument("--sharded", type=int, default=0, metavar="N",
                     help="solve over an N-chip mesh (slab + halo exchange)")
@@ -91,8 +92,9 @@ def main(argv=None) -> int:
     n = points.shape[0]
     print(f"loaded {n} points -> [0,1000]^3")
 
+    cfg_kw = {} if args.supercell is None else {"supercell": args.supercell}
     cfg = KnnConfig(k=args.k, density=args.density, ring_radius=args.ring_radius,
-                    supercell=args.supercell, dist_method=args.dist)
+                    dist_method=args.dist, **cfg_kw)
     summary = {"n": n, "k": args.k, "mode": "sharded" if args.sharded else "single"}
 
     # --- accelerated solve (reference "knn gpu" phase, test_knearests.cu:136) ---

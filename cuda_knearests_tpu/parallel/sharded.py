@@ -336,10 +336,16 @@ class ShardedKnnProblem:
         plan = build_sharded_plan(grid, config, ndev)
         return cls(grid=grid, config=config, plan=plan, mesh=mesh)
 
-    def solve(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Run the sharded solve.  Returns (neighbors_original_ids (n, k),
-        dists_sq (n, k), certified (n,)) on the host, exact (uncertified
-        queries resolved against the global array)."""
+    def solve_device(self):
+        """Run the sharded solve on the mesh, leaving results device-resident.
+
+        Returns (out_i, out_d, out_cert) sharded over the mesh, shaped
+        (ndev, pcap, ...): per-chip slab rows in *global sorted* neighbor
+        indexing, pad rows beyond each chip's n_local undefined.  This is the
+        steady-state hot path -- host assembly (solve()) is a separate,
+        optional phase, like the reference's kn_get_* readback
+        (/root/reference/knearests.cu:406-437).
+        """
         plan, cfg = self.plan, self.config
         if self._fn is None:
             # built once per problem so repeated solves reuse the compile cache
@@ -352,11 +358,18 @@ class ShardedKnnProblem:
                 # pallas_call's block machinery trips the vma checker (its
                 # internal dynamic_slice mixes varying/invariant operands)
                 check_vma=not use_pallas))
-        out_i, out_d, out_cert = self._fn(
+        return self._fn(
             plan.local_pts, plan.local_counts, plan.local_base,
             plan.bot_pts, plan.bot_counts, plan.bot_base,
             plan.top_pts, plan.top_counts, plan.top_base,
             plan.own_cells, plan.cand_cells, plan.box_lo, plan.box_hi)
+
+    def solve(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run the sharded solve.  Returns (neighbors_original_ids (n, k),
+        dists_sq (n, k), certified (n,)) on the host, exact (uncertified
+        queries resolved against the global array)."""
+        plan, cfg = self.plan, self.config
+        out_i, out_d, out_cert = self.solve_device()
         out_i = np.asarray(jax.device_get(out_i))
         out_d = np.asarray(jax.device_get(out_d))
         out_cert = np.asarray(jax.device_get(out_cert))
