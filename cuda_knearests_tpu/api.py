@@ -100,6 +100,39 @@ class KnnProblem:
         certified = res.certified.at[safe].set(True, mode="drop")
         return KnnResult(neighbors=neighbors, dists_sq=dists, certified=certified)
 
+    def query(self, queries, k: int | None = None):
+        """Exact kNN of arbitrary query coordinates against the stored points.
+
+        The reference's GPU engine only answers the all-points self-query; its
+        CPU oracle takes arbitrary queries (kd_tree.cpp:168-205) -- this closes
+        that asymmetry.  Queries must lie in the engine domain; the query point
+        set is independent of the stored set (no self-exclusion).  ``k``
+        defaults to (and may not exceed) the prepared config's k, which sized
+        the candidate dilation the completeness certificate relies on.
+
+        Returns ((m, k) neighbor ids in original indexing, ascending by
+        distance; (m, k) squared distances).
+        """
+        from .ops.query import query_knn
+
+        k = self.config.k if k is None else int(k)
+        if k > self.config.k:
+            raise ValueError(
+                f"k={k} exceeds the prepared k={self.config.k}; re-prepare "
+                f"with a larger config.k (it sizes the candidate dilation)")
+        if self.plan is None:
+            self.plan = build_plan(self.grid, self.config)
+        if self.pack is None:
+            from .ops.pallas_solve import build_pack
+
+            self.pack = build_pack(self.grid.points, self.grid.cell_starts,
+                                   self.grid.cell_counts, self.plan)
+        interpret = (self.config.interpret
+                     or jax.devices()[0].platform == "cpu")
+        return query_knn(self.grid, self.plan, self.pack, queries, k,
+                         self.config.supercell, interpret,
+                         self.config.fallback)
+
     # -- result extraction (reference: kn_get_*, knearests.cu:406-437) ----------
 
     def get_points(self) -> np.ndarray:

@@ -1,0 +1,74 @@
+"""External-query kNN: arbitrary query coordinates vs the stored point set.
+
+Differential bar: must match the exact oracle (which has always supported
+arbitrary queries, /root/reference/kd_tree.cpp:168-205) and numpy brute force.
+"""
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.io import generate_blue_noise, generate_uniform
+from cuda_knearests_tpu.oracle import KdTreeOracle
+
+
+@pytest.fixture(scope="module")
+def prepared():
+    points = generate_uniform(12000, seed=21)
+    return points, KnnProblem.prepare(points, KnnConfig(k=10))
+
+
+def test_query_matches_oracle(prepared, rng):
+    points, problem = prepared
+    queries = generate_blue_noise(700, seed=33)
+    nbrs, d2 = problem.query(queries, k=10)
+    oracle = KdTreeOracle(points)
+    ref_ids, ref_d2 = oracle.knn(queries, k=10)
+    for i in range(len(queries)):
+        assert set(nbrs[i].tolist()) == set(ref_ids[i].tolist()), i
+    np.testing.assert_allclose(d2, ref_d2, rtol=1e-6, atol=1e-3)
+    assert (np.diff(d2, axis=1) >= 0).all()
+
+
+def test_query_points_themselves(prepared):
+    """Querying the stored points (no self-exclusion) -> nearest is self, d2=0."""
+    points, problem = prepared
+    sub = points[::37]
+    nbrs, d2 = problem.query(sub, k=4)
+    expect = np.arange(len(points))[::37]
+    assert (nbrs[:, 0] == expect).all()
+    assert (d2[:, 0] == 0.0).all()
+
+
+def test_query_k_exceeds_prepared_raises(prepared):
+    _, problem = prepared
+    with pytest.raises(ValueError, match="exceeds the prepared k"):
+        problem.query(np.full((3, 3), 500.0, np.float32), k=11)
+
+
+def test_query_smaller_k(prepared, rng):
+    points, problem = prepared
+    queries = generate_uniform(200, seed=8)
+    nbrs, d2 = problem.query(queries, k=3)
+    assert nbrs.shape == (200, 3)
+    for i in rng.integers(0, 200, 16):
+        dd = ((queries[i] - points) ** 2).sum(-1)
+        assert set(np.argsort(dd, kind="stable")[:3]) == set(nbrs[i].tolist())
+
+
+def test_query_empty():
+    points = generate_uniform(5000, seed=1)
+    problem = KnnProblem.prepare(points, KnnConfig(k=5))
+    nbrs, d2 = problem.query(np.empty((0, 3), np.float32))
+    assert nbrs.shape == (0, 5) and d2.shape == (0, 5)
+
+
+def test_query_single_and_boundary(prepared):
+    points, problem = prepared
+    # domain corners and a single query exercise clamping + tiny-m paths
+    qs = np.array([[0.0, 0.0, 0.0], [999.9, 999.9, 999.9], [500.0, 0.0, 999.0]],
+                  np.float32)
+    nbrs, d2 = problem.query(qs, k=10)
+    for i in range(len(qs)):
+        dd = ((qs[i] - points) ** 2).sum(-1)
+        assert set(np.argsort(dd, kind="stable")[:10]) == set(nbrs[i].tolist())

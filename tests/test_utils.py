@@ -1,0 +1,83 @@
+"""Cross-cutting utilities: stats, memory staging, timers, determinism.
+
+Reference parity: C5/C6/C7/C12 (SURVEY.md section 2.1) -- plus the determinism
+property the reference lacks (its atomicAdd segment allocator makes point
+storage order nondeterministic across runs, knearests.cu:40-48)."""
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.ops.gridhash import build_grid
+from cuda_knearests_tpu.utils import stats
+from cuda_knearests_tpu.utils.memory import (DeviceMemoryError, from_device,
+                                             nbytes, to_device)
+from cuda_knearests_tpu.utils.stopwatch import Stopwatch, timed
+
+
+def test_grid_build_deterministic(uniform_10k):
+    g1 = build_grid(uniform_10k)
+    g2 = build_grid(uniform_10k)
+    np.testing.assert_array_equal(np.asarray(g1.permutation),
+                                  np.asarray(g2.permutation))
+    np.testing.assert_array_equal(np.asarray(g1.points), np.asarray(g2.points))
+    np.testing.assert_array_equal(np.asarray(g1.cell_starts),
+                                  np.asarray(g2.cell_starts))
+
+
+def test_solve_deterministic(blue_8k):
+    cfg = KnnConfig(k=7)
+    r1 = KnnProblem.prepare(blue_8k, cfg).solve()
+    r2 = KnnProblem.prepare(blue_8k, cfg).solve()
+    np.testing.assert_array_equal(np.asarray(r1.neighbors),
+                                  np.asarray(r2.neighbors))
+    np.testing.assert_array_equal(np.asarray(r1.dists_sq),
+                                  np.asarray(r2.dists_sq))
+
+
+def test_occupancy_stats_totals(uniform_10k):
+    g = build_grid(uniform_10k)
+    occ = stats.occupancy_stats(np.asarray(g.cell_counts))
+    assert occ["num_points"] == len(uniform_10k)
+    assert occ["num_cells"] == g.dim ** 3
+    assert sum(v * f for v, f in occ["histogram"].items()) == len(uniform_10k)
+    assert occ["min_per_cell"] <= occ["avg_per_cell"] <= occ["max_per_cell"]
+
+
+def test_problem_stats_roundtrip(uniform_10k):
+    p = KnnProblem.prepare(uniform_10k, KnnConfig(k=5))
+    p.solve()
+    s = p.stats()
+    assert s["n_points"] == len(uniform_10k)
+    assert s["certified_fraction"] == 1.0
+    assert s["uncertified"] == 0
+    assert s["device_bytes"] > 0
+    assert s["plan"]["qcap"] >= 1 and s["plan"]["ccap"] >= 5
+
+
+def test_memory_staging_roundtrip():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    dev = to_device(x)
+    assert nbytes(dev) == x.nbytes
+    np.testing.assert_array_equal(from_device(dev), x)
+
+
+def test_memory_staging_rejects_nonfinite():
+    with pytest.raises(DeviceMemoryError):
+        to_device(np.array([1.0, np.nan], np.float32))
+
+
+def test_stopwatch_and_timed():
+    sw = Stopwatch("phase", verbose=False)
+    assert sw.tick() >= 0.0
+    assert sw.stop() >= 0.0
+    out, t = timed(lambda a: a + 1, np.int32(1), warmup=1, iters=2)
+    assert int(out) == 2
+    assert t["min_s"] >= 0.0 and t["warmup_s"] >= 0.0
+
+
+def test_device_properties_listing():
+    from cuda_knearests_tpu.utils.devinfo import device_properties
+    props = device_properties()
+    assert len(props) == 8  # conftest forces the 8-device emulated CPU mesh
+    assert all(p["platform"] == "cpu" for p in props)
