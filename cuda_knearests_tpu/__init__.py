@@ -7,7 +7,7 @@ oracle, and -- beyond the reference -- multi-chip grid-slab sharding with ICI
 halo exchange.
 """
 
-from .api import KnnProblem, knn
+from .api import KnnProblem, knn, load_problem, save_problem
 from .config import DEFAULT_CELL_DENSITY, DEFAULT_K, DOMAIN_SIZE, KnnConfig
 from .ops.gridhash import GridHash, build_grid, cell_coords, cell_ids, \
     unpermute_neighbors
@@ -16,7 +16,8 @@ from .ops.solve import KnnResult, brute_force_by_index, build_plan, solve
 __version__ = "0.1.0"
 
 __all__ = [
-    "KnnProblem", "knn", "KnnConfig", "KnnResult", "GridHash",
+    "KnnProblem", "knn", "save_problem", "load_problem",
+    "KnnConfig", "KnnResult", "GridHash",
     "build_grid", "build_plan", "solve", "brute_force_by_index",
     "cell_coords", "cell_ids", "unpermute_neighbors",
     "DOMAIN_SIZE", "DEFAULT_K", "DEFAULT_CELL_DENSITY",

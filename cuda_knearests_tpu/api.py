@@ -55,15 +55,20 @@ class KnnProblem:
 
     @classmethod
     def prepare(cls, points, config: KnnConfig | None = None,
-                dim: int | None = None) -> "KnnProblem":
+                dim: int | None = None, validate: bool = True) -> "KnnProblem":
         """Stage points, build the spatial hash and the supercell schedule.
 
-        Like kn_prepare (knearests.cu:235-344), input points must already satisfy
-        the [0, domain]^3 contract (io.normalize_points enforces it).
+        Like kn_prepare (knearests.cu:235-344), input points must satisfy the
+        [0, domain]^3 contract (io.normalize_points enforces it) -- but where
+        the reference silently clamps out-of-range points into boundary cells
+        (knearests.cu:26-28), this fails fast with a fix pointer.
         """
+        from .io import validate_points
+
         config = config or KnnConfig()
-        grid = build_grid(np.asarray(points, np.float32), dim=dim,
-                          density=config.density)
+        points = validate_points(points) if validate else np.asarray(
+            points, np.float32)
+        grid = build_grid(points, dim=dim, density=config.density)
         plan = build_plan(grid, config)
         return cls(grid=grid, config=config, plan=plan)
 
@@ -181,3 +186,46 @@ def knn(points, k: int = 10, config: KnnConfig | None = None) -> np.ndarray:
     problem = KnnProblem.prepare(points, cfg)
     problem.solve()
     return problem.get_knearests_original()
+
+
+def save_problem(problem: KnnProblem, path: str) -> None:
+    """Checkpoint a prepared problem (grid + config) to one ``.npz``.
+
+    The reference has no persistence at all (SURVEY.md section 5
+    "Checkpoint / resume: Absent"); here a prepared spatial hash -- the
+    expensive part of prepare() at 10M+ points -- can be saved and resumed.
+    Solved results are not checkpointed (re-solving is cheap and the solve is
+    deterministic)."""
+    g = problem.grid
+    cfg = dataclasses.asdict(problem.config)
+    np.savez_compressed(
+        path,
+        points=np.asarray(jax.device_get(g.points)),
+        permutation=np.asarray(jax.device_get(g.permutation)),
+        cell_starts=np.asarray(jax.device_get(g.cell_starts)),
+        cell_counts=np.asarray(jax.device_get(g.cell_counts)),
+        dim=np.int64(g.dim), domain=np.float64(g.domain),
+        config_json=np.bytes_(
+            __import__("json").dumps(
+                {k: v for k, v in cfg.items() if v is not None}).encode()),
+    )
+
+
+def load_problem(path: str) -> KnnProblem:
+    """Resume a checkpointed problem: stages the saved grid back onto the
+    device and rebuilds the (cheap, deterministic) supercell plan."""
+    import json
+
+    from .ops.gridhash import GridHash
+
+    with np.load(path) as z:
+        cfg = KnnConfig(**json.loads(bytes(z["config_json"]).decode()))
+        counts = z["cell_counts"].astype(np.int32)
+        grid = GridHash(
+            points=jax.numpy.asarray(z["points"]),
+            permutation=jax.numpy.asarray(z["permutation"].astype(np.int32)),
+            cell_starts=jax.numpy.asarray(z["cell_starts"].astype(np.int32)),
+            cell_counts=jax.numpy.asarray(counts),
+            dim=int(z["dim"]), domain=float(z["domain"]))
+    plan = build_plan(grid, cfg, cell_counts_host=counts)
+    return KnnProblem(grid=grid, config=cfg, plan=plan)

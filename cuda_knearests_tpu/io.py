@@ -79,6 +79,30 @@ def normalize_points(points: np.ndarray, domain: float = DOMAIN_SIZE) -> np.ndar
     return np.ascontiguousarray(out.astype(np.float32))
 
 
+def validate_points(points: np.ndarray,
+                    domain: float = DOMAIN_SIZE) -> np.ndarray:
+    """Enforce the engine's input contract: (n, 3) finite f32 in [0, domain]^3.
+
+    The reference silently clamps out-of-range points into boundary cells
+    (/root/reference/knearests.cu:26-28), which quietly corrupts results; this
+    framework fails fast with a fix pointer instead.
+    """
+    points = np.asarray(points, np.float32)
+    if points.ndim != 2 or points.shape[1] != 3:
+        raise ValueError(f"points must be (n, 3), got {points.shape}")
+    if points.size:
+        if not np.isfinite(points).all():
+            raise ValueError("points contain NaN/inf; clean the input first")
+        lo, hi = float(points.min()), float(points.max())
+        if lo < 0.0 or hi > domain:
+            raise ValueError(
+                f"points span [{lo:.3g}, {hi:.3g}] but the engine domain "
+                f"contract is [0, {domain:g}]^3 -- run io.normalize_points "
+                f"first (the reference hard-codes the same contract, "
+                f"knearests.cu:21)")
+    return points
+
+
 def generate_uniform(n: int, seed: int = 0, domain: float = DOMAIN_SIZE) -> np.ndarray:
     """n i.i.d. uniform points in [0, domain]^3 (regenerates pts300K-style sets)."""
     rng = np.random.default_rng(seed)

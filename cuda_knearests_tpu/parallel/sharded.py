@@ -326,12 +326,14 @@ class ShardedKnnProblem:
                 config: Optional[KnnConfig] = None,
                 mesh: Optional[Mesh] = None,
                 dim: Optional[int] = None) -> "ShardedKnnProblem":
+        from ..io import validate_points
+
         config = config or KnnConfig()
         if mesh is None:
             n_devices = n_devices or len(jax.devices())
             mesh = jax.make_mesh((n_devices,), ("z",))
         ndev = mesh.devices.size
-        grid = build_grid(np.asarray(points, np.float32), dim=dim,
+        grid = build_grid(validate_points(points), dim=dim,
                           density=config.density)
         plan = build_sharded_plan(grid, config, ndev)
         return cls(grid=grid, config=config, plan=plan, mesh=mesh)

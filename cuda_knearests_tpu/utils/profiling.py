@@ -1,0 +1,48 @@
+"""Device-level tracing hooks.
+
+Reference parity (SURVEY.md section 5 "Tracing / profiling"): the reference
+exposes cudaEvent timers around build/solve and compiles with ``-lineinfo`` so
+nvprof/nsight can map kernels to source.  The TPU equivalents are (a) the
+Stopwatch/timed wall timers (utils/stopwatch.py -- the cudaEvent analog) and
+(b) this module: ``jax.profiler`` trace capture producing a Perfetto/
+TensorBoard-readable trace of XLA ops, Pallas kernels, and transfers -- the
+nsight analog.
+
+Usage:
+    from cuda_knearests_tpu.utils.profiling import trace
+    with trace("/tmp/knn_trace"):
+        problem.solve()
+    # then: tensorboard --logdir /tmp/knn_trace  (or load in Perfetto)
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a device trace for the enclosed block (blocks on exit so the
+    trailing async work lands inside the trace)."""
+    options = None
+    try:  # tracer options moved modules across jax versions; both optional
+        options = jax.profiler.ProfileOptions()
+        options.host_tracer_level = host_tracer_level
+    except Exception:
+        pass
+    if options is not None:
+        ctx = jax.profiler.trace(log_dir, profiler_options=options)
+    else:
+        ctx = jax.profiler.trace(log_dir)
+    with ctx:
+        yield
+        (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def annotate(name: str):
+    """Named region that shows up in profiler traces (and is free outside
+    them): ``with annotate("halo-exchange"): ...``"""
+    return jax.profiler.TraceAnnotation(name)

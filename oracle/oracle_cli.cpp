@@ -1,0 +1,124 @@
+// Native CLI for the kd-tree oracle: load an .xyz point cloud, normalize it
+// into the engine domain, build the tree, answer the all-points k-NN
+// self-query (self dropped), and print timings plus a result checksum.
+//
+// This is the native counterpart of the reference's host-side driver pieces
+// (loader + bbox + oracle phase of /root/reference/test_knearests.cu:15-80,
+// 194-214): the framework's Python CLI does the differential comparison; this
+// binary gives the same CPU-baseline measurement with zero Python in the
+// loop, e.g. for profiling the oracle itself.
+//
+// Build: make -C oracle oracle_cli
+// Usage: ./oracle_cli points.xyz [k]
+
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+extern "C" {
+void* kdt_build(const float* pts, int64_t n);
+void kdt_free(void* tree);
+int64_t kdt_num_nodes(const void* tree);
+void kdt_knn(const void* tree, const float* queries, int64_t nq, int32_t k,
+             const int32_t* exclude, int32_t* out_ids, float* out_d2);
+}
+
+namespace {
+
+constexpr double kDomain = 1000.0;  // engine contract: [0, 1000]^3
+
+double now_s() {
+  using clock = std::chrono::steady_clock;
+  return std::chrono::duration<double>(clock::now().time_since_epoch()).count();
+}
+
+// .xyz: line 1 = count, then "x y z" per line (same format the reference
+// loads, test_knearests.cu:48-62 -- parser written fresh).
+std::vector<float> load_xyz(const char* path) {
+  FILE* f = std::fopen(path, "r");
+  if (!f) { std::perror(path); std::exit(1); }
+  long long n = 0;
+  if (std::fscanf(f, "%lld", &n) != 1 || n < 0) {
+    std::fprintf(stderr, "%s: bad count header\n", path);
+    std::exit(1);
+  }
+  std::vector<float> pts(static_cast<size_t>(n) * 3);
+  for (long long i = 0; i < n * 3; ++i) {
+    if (std::fscanf(f, "%f", &pts[static_cast<size_t>(i)]) != 1) {
+      std::fprintf(stderr, "%s: truncated at value %lld (expected %lld)\n",
+                   path, i, n * 3);
+      std::exit(1);
+    }
+  }
+  std::fclose(f);
+  return pts;
+}
+
+// Aspect-preserving rescale of the padded bbox onto [0, kDomain] (the same
+// contract enforcement as io.normalize_points / test_knearests.cu:65-78).
+void normalize(std::vector<float>& pts) {
+  if (pts.empty()) return;
+  double lo[3], hi[3];
+  for (int a = 0; a < 3; ++a) { lo[a] = pts[a]; hi[a] = pts[a]; }
+  for (size_t i = 0; i < pts.size(); i += 3)
+    for (int a = 0; a < 3; ++a) {
+      lo[a] = std::min(lo[a], double(pts[i + a]));
+      hi[a] = std::max(hi[a], double(pts[i + a]));
+    }
+  double extent = 0.0;
+  for (int a = 0; a < 3; ++a) extent = std::max(extent, hi[a] - lo[a]);
+  double pad = extent * 0.001;
+  for (int a = 0; a < 3; ++a) lo[a] -= pad;
+  extent += 2.0 * pad;
+  double scale = extent > 0.0 ? kDomain / extent : 1.0;
+  for (size_t i = 0; i < pts.size(); i += 3)
+    for (int a = 0; a < 3; ++a)
+      pts[i + a] = float((double(pts[i + a]) - lo[a]) * scale);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s points.xyz [k=10]\n", argv[0]);
+    return 2;
+  }
+  const int k = argc > 2 ? std::atoi(argv[2]) : 10;
+  if (k <= 0) { std::fprintf(stderr, "bad k\n"); return 2; }
+
+  double t0 = now_s();
+  std::vector<float> pts = load_xyz(argv[1]);
+  const int64_t n = int64_t(pts.size() / 3);
+  normalize(pts);
+  std::printf("loaded %lld points in %.3f s -> [0,%g]^3\n",
+              (long long)n, now_s() - t0, kDomain);
+
+  t0 = now_s();
+  void* tree = kdt_build(pts.data(), n);
+  std::printf("kd-tree build: %.3f s (%lld nodes)\n", now_s() - t0,
+              (long long)kdt_num_nodes(tree));
+
+  std::vector<int32_t> ids(size_t(n) * k);
+  std::vector<float> d2(size_t(n) * k);
+  std::vector<int32_t> excl(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) excl[size_t(i)] = int32_t(i);
+
+  t0 = now_s();
+  kdt_knn(tree, pts.data(), n, k, excl.data(), ids.data(), d2.data());
+  double qs = now_s() - t0;
+  std::printf("knn cpu: %.3f s (%.0f queries/sec, k=%d)\n",
+              qs, double(n) / qs, k);
+
+  // order-independent checksum so runs are comparable across machines
+  uint64_t checksum = 0;
+  for (size_t i = 0; i < ids.size(); ++i)
+    checksum += uint64_t(uint32_t(ids[i])) * 2654435761u;
+  std::printf("checksum: %llu\n", (unsigned long long)checksum);
+
+  kdt_free(tree);
+  return 0;
+}

@@ -1,0 +1,66 @@
+"""Input-contract validation and problem checkpoint/resume.
+
+Both are deliberate departures from the reference: it silently clamps
+out-of-domain points into boundary cells (knearests.cu:26-28) and has no
+persistence at all (SURVEY.md section 5)."""
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import (KnnConfig, KnnProblem, load_problem,
+                                save_problem)
+from cuda_knearests_tpu.io import generate_uniform, validate_points
+
+
+def test_validate_rejects_out_of_domain():
+    bad = np.array([[0.0, 0.0, -1.0]], np.float32)
+    with pytest.raises(ValueError, match="normalize_points"):
+        KnnProblem.prepare(bad)
+    with pytest.raises(ValueError, match="normalize_points"):
+        validate_points(np.array([[0.0, 1000.5, 1.0]], np.float32))
+
+
+def test_validate_rejects_nan_and_bad_shape():
+    with pytest.raises(ValueError, match="NaN"):
+        KnnProblem.prepare(np.array([[0.0, np.nan, 1.0]], np.float32))
+    with pytest.raises(ValueError, match=r"\(n, 3\)"):
+        KnnProblem.prepare(np.zeros((4, 2), np.float32))
+
+
+def test_validate_accepts_boundary_values():
+    pts = np.array([[0.0, 0.0, 0.0], [1000.0, 1000.0, 1000.0],
+                    [500.0, 0.0, 1000.0]], np.float32)
+    assert validate_points(pts).shape == (3, 3)
+
+
+def test_checkpoint_roundtrip(tmp_path, uniform_10k):
+    cfg = KnnConfig(k=9, supercell=4, ring_radius=2)
+    p1 = KnnProblem.prepare(uniform_10k, cfg)
+    r1 = p1.solve()
+
+    path = str(tmp_path / "problem.npz")
+    save_problem(p1, path)
+    p2 = load_problem(path)
+
+    assert p2.config == cfg
+    assert p2.grid.dim == p1.grid.dim
+    np.testing.assert_array_equal(np.asarray(p2.grid.permutation),
+                                  np.asarray(p1.grid.permutation))
+    r2 = p2.solve()
+    np.testing.assert_array_equal(np.asarray(r1.neighbors),
+                                  np.asarray(r2.neighbors))
+    np.testing.assert_array_equal(p1.get_knearests_original(),
+                                  p2.get_knearests_original())
+
+
+def test_checkpoint_query_after_resume(tmp_path):
+    points = generate_uniform(8000, seed=3)
+    p1 = KnnProblem.prepare(points, KnnConfig(k=6))
+    path = str(tmp_path / "p.npz")
+    save_problem(p1, path)
+    p2 = load_problem(path)
+    queries = generate_uniform(100, seed=9)
+    nbrs, d2 = p2.query(queries)
+    for i in (0, 50, 99):
+        dd = ((queries[i] - points) ** 2).sum(-1)
+        assert set(np.argsort(dd, kind="stable")[:6]) == set(nbrs[i].tolist())
