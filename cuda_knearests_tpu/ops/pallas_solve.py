@@ -49,6 +49,9 @@ _BIG_ID = 2**31 - 1
 # Conservative per-program VMEM budget (bytes) for choosing this path.
 _VMEM_BUDGET = 12 * 1024 * 1024
 
+# k above which the extraction loop is rolled (fori_loop) instead of unrolled.
+_UNROLL_K_MAX = 64
+
 
 @functools.partial(
     jax.tree_util.register_dataclass,
@@ -110,14 +113,27 @@ def _kernel(q_ref, cx_ref, cy_ref, cz_ref, qid_ref, cid_ref,
         qi = qid_ref[0, 0, :].reshape(-1, 1)
         drop = drop | (qi == ci)
     d2 = jnp.where(drop, jnp.inf, d2)
-    for i in range(k):
-        m = jnp.min(d2, axis=1)
-        sel = d2 == m[:, None]
-        bid = jnp.min(jnp.where(sel, ci, _BIG_ID), axis=1)
-        out_d_ref[0, i, :] = m
-        out_i_ref[0, i, :] = bid
-        if i + 1 < k:
-            d2 = jnp.where(sel & (ci == bid[:, None]), jnp.inf, d2)
+    if k <= _UNROLL_K_MAX:
+        for i in range(k):
+            m = jnp.min(d2, axis=1)
+            sel = d2 == m[:, None]
+            bid = jnp.min(jnp.where(sel, ci, _BIG_ID), axis=1)
+            out_d_ref[0, i, :] = m
+            out_i_ref[0, i, :] = bid
+            if i + 1 < k:
+                d2 = jnp.where(sel & (ci == bid[:, None]), jnp.inf, d2)
+    else:
+        # large k: rolled loop keeps compile time bounded (unrolling 100+
+        # min-and-mask passes blows up Mosaic compilation)
+        def body(i, d2):
+            m = jnp.min(d2, axis=1)
+            sel = d2 == m[:, None]
+            bid = jnp.min(jnp.where(sel, ci, _BIG_ID), axis=1)
+            out_d_ref[0, pl.ds(i, 1), :] = m.reshape(1, -1)
+            out_i_ref[0, pl.ds(i, 1), :] = bid.reshape(1, -1)
+            return jnp.where(sel & (ci == bid[:, None]), jnp.inf, d2)
+
+        jax.lax.fori_loop(0, k, body, d2)
 
 
 def vmem_bytes_estimate(qcap: int, ccap: int, k: int) -> int:

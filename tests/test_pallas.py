@@ -72,6 +72,20 @@ def test_pallas_include_self():
     assert (nbrs[:, 0] == np.arange(len(points))).all()
 
 
+def test_pallas_large_k_rolled_loop():
+    """k > _UNROLL_K_MAX takes the fori_loop extraction path; still exact."""
+    points = generate_uniform(4000, seed=6)
+    cfg = dataclasses.replace(PAL, k=80)
+    p = KnnProblem.prepare(points, cfg)
+    p.solve()
+    nbrs = p.get_knearests_original()
+    rng = np.random.default_rng(0)
+    for qi in rng.integers(0, 4000, 4):
+        d2 = ((points[qi] - points) ** 2).sum(-1)
+        d2[qi] = np.inf
+        assert set(np.argsort(d2, kind="stable")[:80]) == set(nbrs[qi].tolist())
+
+
 def test_vmem_estimate_monotone_and_gate():
     assert vmem_bytes_estimate(256, 1664, 10) < vmem_bytes_estimate(256, 3328, 10)
     assert pallas_fits(256, 1664, 10)
