@@ -1,3 +1,6 @@
-from . import sharded
+from . import distributed, sharded
+from .distributed import init_distributed, z_mesh
+from .sharded import ShardedKnnProblem
 
-__all__ = ["sharded"]
+__all__ = ["sharded", "distributed", "ShardedKnnProblem", "init_distributed",
+           "z_mesh"]

@@ -1,0 +1,65 @@
+"""Multi-host bring-up helpers for the sharded path.
+
+The reference has no inter-device backend at all (single GPU; SURVEY.md
+section 2.3).  This framework's communication backend is XLA's own fabric:
+``shard_map`` + collectives ride ICI within a host's chips and DCN across
+hosts -- there is no NCCL/MPI analog to manage, only process bring-up and a
+mesh whose device order keeps neighboring z-slabs on neighboring chips.
+
+Single-host multi-chip needs none of this (``ShardedKnnProblem.prepare``
+builds its own mesh).  For a multi-host pod:
+
+    from cuda_knearests_tpu.parallel.distributed import init_distributed, z_mesh
+    init_distributed()                  # once per process, before first jax use
+    sp = ShardedKnnProblem.prepare(points, mesh=z_mesh())
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Initialize JAX's multi-process runtime (idempotent).
+
+    With no arguments, relies on the cluster environment (TPU pods
+    auto-discover); arguments pass through to ``jax.distributed.initialize``
+    for manual bring-up.  Safe to call on a single process: it becomes a
+    no-op when there is nothing to join.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except (ValueError, RuntimeError):
+        if num_processes not in (None, 1):
+            raise
+        # single-process run with no cluster env: nothing to initialize
+
+
+def z_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1-D ("z",) mesh over all (global) devices, ordered so that mesh
+    neighbors are physical neighbors where the platform exposes coordinates.
+
+    The sharded solver's only collective is a z-neighbor ``ppermute``
+    (parallel/sharded.py); ordering by (process, coords) keeps those exchanges
+    on ICI within a host and crosses DCN only at host seams.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+
+    def key(d):
+        coords = getattr(d, "coords", None)
+        if coords is not None:
+            return (d.process_index, *coords)
+        return (d.process_index, d.id)
+
+    devs.sort(key=key)
+    return Mesh(np.array(devs), ("z",))

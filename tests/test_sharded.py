@@ -82,6 +82,34 @@ def test_sharded_pallas_matches_xla(blue_8k):
     assert cx.all() and cp.all()
 
 
+def test_distributed_helpers_and_custom_mesh(blue_8k):
+    from cuda_knearests_tpu.parallel import init_distributed, z_mesh
+
+    init_distributed()  # single-process: must be a safe no-op
+    mesh = z_mesh()
+    assert mesh.devices.size == 8 and mesh.axis_names == ("z",)
+    sp = ShardedKnnProblem.prepare(blue_8k, mesh=mesh, config=KnnConfig(k=6))
+    nbrs, d2, cert = sp.solve()
+    assert cert.all() and (nbrs >= 0).all()
+
+
+def test_sharded_clustered_points():
+    """Heavily clustered data (most points in few cells) stays exact --
+    capacities are measured maxima, not averages."""
+    rng = np.random.default_rng(5)
+    cluster = 450.0 + 40.0 * rng.standard_normal((3600, 3))
+    spread = rng.random((400, 3)) * 1000.0
+    pts = np.clip(np.concatenate([cluster, spread]), 0.0, 1000.0
+                  ).astype(np.float32)
+    sp = ShardedKnnProblem.prepare(pts, n_devices=4, config=KnnConfig(k=5))
+    nbrs, d2, cert = sp.solve()
+    assert cert.all()
+    q = np.random.default_rng(0).integers(0, len(pts), 24)
+    ref = brute_knn_np(pts, q, 5)
+    for row, qi in enumerate(q):
+        assert set(ref[row].tolist()) == set(nbrs[qi].tolist())
+
+
 def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
