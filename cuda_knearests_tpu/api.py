@@ -138,6 +138,32 @@ class KnnProblem:
                          self.config.supercell, interpret,
                          self.config.fallback)
 
+    def query_radius(self, queries, radius: float,
+                     max_neighbors: int | None = None):
+        """All stored points within ``radius`` of each query (capped).
+
+        Fixed-radius search on the same grid machinery: runs the k-NN kernel
+        with k=``max_neighbors`` and masks results beyond the radius.  The
+        k-NN results are *globally* exact (completeness certificate or brute
+        fallback), so the mask is exact for any radius -- the only possible
+        incompleteness is the cap itself, flagged per query via ``truncated``.
+
+        Returns (ids (m, cap) original indexing, -1 beyond count;
+        d2 (m, cap) ascending, inf beyond; counts (m,); truncated (m,) --
+        True where exactly ``max_neighbors`` landed in range, i.e. more
+        neighbors may exist beyond the cap).
+        """
+        cap = self.config.k if max_neighbors is None else int(max_neighbors)
+        if cap > self.config.k:
+            raise ValueError(
+                f"max_neighbors={cap} exceeds the prepared k={self.config.k}")
+        ids, d2 = self.query(queries, k=cap)
+        in_range = d2 <= np.float32(radius) ** 2
+        counts = in_range.sum(axis=1).astype(np.int32)
+        truncated = counts >= cap
+        return (np.where(in_range, ids, -1), np.where(in_range, d2, np.inf),
+                counts, truncated)
+
     # -- result extraction (reference: kn_get_*, knearests.cu:406-437) ----------
 
     def get_points(self) -> np.ndarray:

@@ -63,6 +63,38 @@ def test_query_empty():
     assert nbrs.shape == (0, 5) and d2.shape == (0, 5)
 
 
+def test_query_radius_matches_numpy(prepared, rng):
+    points, problem = prepared
+    queries = generate_uniform(150, seed=55)
+    radius = 35.0
+    ids, d2, counts, truncated = problem.query_radius(queries, radius,
+                                                      max_neighbors=10)
+    for i in rng.integers(0, 150, 20):
+        dd = ((queries[i] - points) ** 2).sum(-1)
+        ref = set(np.nonzero(dd <= radius * radius)[0].tolist())
+        got = set(ids[i][ids[i] >= 0].tolist())
+        if truncated[i]:
+            assert got <= ref and len(got) == 10
+        else:
+            assert got == ref, i
+            assert counts[i] == len(ref)
+    # ascending within each row (inf tail replaced by a finite sentinel so
+    # diff never produces inf - inf = nan)
+    d2c = np.where(np.isfinite(d2), d2, np.float32(3.0e38))
+    assert (np.diff(d2c, axis=1) >= 0).all()
+
+
+def test_query_radius_cap_flag(prepared):
+    points, problem = prepared
+    # a huge radius saturates the cap for every query -> truncated everywhere
+    qs = points[:20]
+    ids, d2, counts, truncated = problem.query_radius(qs, 1500.0,
+                                                      max_neighbors=5)
+    assert truncated.all() and (counts == 5).all()
+    with pytest.raises(ValueError, match="exceeds the prepared k"):
+        problem.query_radius(qs, 10.0, max_neighbors=99)
+
+
 def test_query_single_and_boundary(prepared):
     points, problem = prepared
     # domain corners and a single query exercise clamping + tiny-m paths
