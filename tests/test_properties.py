@@ -1,0 +1,92 @@
+"""Property-based invariants (hypothesis) for the core engine.
+
+The determinism/invariant properties the reference cannot state (its grid
+build is nondeterministic, SURVEY.md section 2.2) plus selection correctness
+under adversarial inputs: duplicates, exact ties, degenerate sizes.  Shapes
+are drawn from small fixed buckets so the jit-compile universe stays bounded.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.io import normalize_points, validate_points
+from cuda_knearests_tpu.ops.gridhash import build_grid, cell_ids
+
+_SIZES = (37, 128, 500)
+_KS = (1, 5, 12)
+
+
+def _points(draw, n, quantize):
+    """Random points in-domain; quantized draws force exact duplicates/ties."""
+    scale = 10 if quantize else 100000
+    ints = draw(st.lists(st.integers(0, scale), min_size=3 * n, max_size=3 * n))
+    return (np.array(ints, np.float32).reshape(n, 3) * (1000.0 / scale))
+
+
+@settings(max_examples=12, deadline=None)
+@given(st.data())
+def test_grid_csr_invariants(data):
+    n = data.draw(st.sampled_from(_SIZES))
+    pts = _points(data.draw, n, quantize=data.draw(st.booleans()))
+    g = build_grid(pts)
+    counts = np.asarray(g.cell_counts)
+    starts = np.asarray(g.cell_starts)
+    perm = np.asarray(g.permutation)
+    assert counts.sum() == n
+    np.testing.assert_array_equal(starts, np.cumsum(counts) - counts)
+    assert np.array_equal(np.sort(perm), np.arange(n))
+    # every stored point sits inside its cell's CSR segment
+    cids_sorted = np.asarray(cell_ids(g.points, g.dim, g.domain))
+    assert (np.diff(cids_sorted) >= 0).all()
+    pos = np.arange(n)
+    assert (pos >= starts[cids_sorted]).all()
+    assert (pos < starts[cids_sorted] + counts[cids_sorted]).all()
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.data())
+def test_solve_selects_true_nearest_distances(data):
+    """Selection correctness under ties/duplicates: the sorted distance rows
+    must equal numpy's exact k smallest (ids may differ inside exact ties)."""
+    n = data.draw(st.sampled_from(_SIZES))
+    k = data.draw(st.sampled_from(_KS))
+    pts = _points(data.draw, n, quantize=data.draw(st.booleans()))
+    problem = KnnProblem.prepare(pts, KnnConfig(k=k))
+    problem.solve()
+    nbrs = problem.get_knearests_original()
+    perm = problem.get_permutation()
+    d2 = np.empty_like(problem.get_dists_sq())
+    d2[perm] = problem.get_dists_sq()
+
+    check = np.random.default_rng(0).integers(0, n, min(n, 12))
+    for qi in check:
+        dd = ((pts[qi] - pts) ** 2).sum(-1)
+        dd[qi] = np.inf
+        ref = np.sort(dd)[:k].astype(np.float32)
+        got = d2[qi]
+        valid = np.isfinite(got)
+        assert valid.sum() == min(k, n - 1)
+        np.testing.assert_allclose(got[valid], ref[: valid.sum()],
+                                   rtol=1e-6, atol=1e-2)
+        # reported ids realize the reported distances
+        ids = nbrs[qi][valid]
+        real = ((pts[ids] - pts[qi]) ** 2).sum(-1)
+        np.testing.assert_allclose(real, got[valid], rtol=1e-6, atol=1e-2)
+        assert qi not in set(ids.tolist())
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.lists(st.floats(-1e6, 1e6, allow_nan=False, width=32),
+                min_size=6, max_size=90))
+def test_normalize_always_satisfies_contract(vals):
+    pts = np.array(vals[: len(vals) // 3 * 3], np.float32).reshape(-1, 3)
+    out = normalize_points(pts)
+    validate_points(out)  # must never raise
+    ex_in = (pts.max(0) - pts.min(0)).astype(np.float64)
+    ex_out = (out.max(0) - out.min(0)).astype(np.float64)
+    if ex_in.max() > 1e-3:
+        # aspect preserved: extent ratios survive normalization
+        a = ex_in / ex_in.max()
+        b = ex_out / max(ex_out.max(), 1e-12)
+        np.testing.assert_allclose(a, b, atol=5e-3)
