@@ -193,6 +193,26 @@ class KnnProblem:
         self._require_solved()
         return np.asarray(jax.device_get(self.result.dists_sq))
 
+    def get_edges(self, symmetric: bool = False) -> np.ndarray:
+        """kNN graph as a COO edge list (E, 2) of original point ids.
+
+        The reference's neighbor tables feed a clipping-plane pipeline (its k
+        is literally named DEFAULT_NB_PLANES, params.h:4); an explicit edge
+        list is the graph-consumer form of the same product.  ``symmetric``
+        adds reverse edges and deduplicates (an undirected graph).
+        """
+        self._require_solved()
+        nbrs = self.get_knearests_original()
+        n, k = nbrs.shape
+        src = np.repeat(np.arange(n, dtype=np.int32), k)
+        dst = nbrs.reshape(-1)
+        keep = dst >= 0
+        edges = np.stack([src[keep], dst[keep]], axis=1)
+        if symmetric:
+            und = np.concatenate([edges, edges[:, ::-1]])
+            edges = np.unique(und, axis=0)
+        return edges
+
     def print_stats(self):
         """Occupancy histogram + certification + memory (reference:
         kn_print_stats, knearests.cu:440-466)."""

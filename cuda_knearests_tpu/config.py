@@ -69,8 +69,9 @@ class KnnConfig:
       sc_batch: how many supercells one jitted chunk processes (bounds peak memory).
       dist_method: 'diff' = sum((a-b)^2), identical arithmetic to the oracle and to
         the reference (knearests.cu:125) so single-chip results match exactly;
-        'dot' = |a|^2+|b|^2-2ab via batched matmul on the MXU (fast path, may
-        reorder near-ties).
+        'dot' = |a|^2+|b|^2-2ab via batched matmul (XLA backend only -- with a
+        3-wide contraction the MXU is ~2% utilized and measured slower than
+        the VPU diff path; the Pallas kernel always uses 'diff').
       exclude_self: drop the query point itself *by storage index*, matching the
         reference's ``if (ptr == point_in) continue`` (knearests.cu:123) --
         coordinate duplicates of the query are still reported.

@@ -66,3 +66,25 @@ def test_k_one(uniform_10k):
     nbrs = knn(uniform_10k[:3000], k=1)
     assert nbrs.shape == (3000, 1)
     assert (nbrs >= 0).all()
+
+
+def test_get_edges_directed_and_symmetric():
+    import numpy as np
+
+    from cuda_knearests_tpu import KnnConfig, KnnProblem
+    from cuda_knearests_tpu.io import generate_uniform
+
+    pts = generate_uniform(5000, seed=13)
+    p = KnnProblem.prepare(pts, KnnConfig(k=4))
+    p.solve()
+    edges = p.get_edges()
+    assert edges.shape == (5000 * 4, 2)
+    assert (edges[:, 0] != edges[:, 1]).all()
+    # row i's targets are exactly its neighbor list
+    nbrs = p.get_knearests_original()
+    assert set(edges[edges[:, 0] == 77][:, 1].tolist()) == set(nbrs[77].tolist())
+    sym = p.get_edges(symmetric=True)
+    # undirected closure: every edge has its reverse present
+    fwd = set(map(tuple, sym.tolist()))
+    assert all((b, a) in fwd for a, b in fwd)
+    assert len(fwd) >= len(set(map(tuple, edges.tolist())))
