@@ -127,14 +127,17 @@ class KnnProblem:
                 f"with a larger config.k (it sizes the candidate dilation)")
         if self.plan is None:
             self.plan = build_plan(self.grid, self.config)
-        if self.pack is None:
-            from .ops.pallas_solve import build_pack
+        pack = None
+        if self.config.backend != "xla":  # explicit xla -> exact brute route
+            if self.pack is None:
+                from .ops.pallas_solve import build_pack
 
-            self.pack = build_pack(self.grid.points, self.grid.cell_starts,
-                                   self.grid.cell_counts, self.plan)
+                self.pack = build_pack(self.grid.points, self.grid.cell_starts,
+                                       self.grid.cell_counts, self.plan)
+            pack = self.pack
         interpret = (self.config.interpret
                      or jax.devices()[0].platform == "cpu")
-        return query_knn(self.grid, self.plan, self.pack, queries, k,
+        return query_knn(self.grid, self.plan, pack, queries, k,
                          self.config.supercell, interpret,
                          self.config.fallback)
 

@@ -189,6 +189,35 @@ def _pallas_topk(q, cx, cy, cz, qid3, cid3, qcap: int, ccap: int, k: int,
     )(q, cx, cy, cz, qid3, cid3)
 
 
+def _pack_inputs(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                 own: jax.Array, cand: jax.Array, qcap: int, ccap: int):
+    """Shared pack-and-gather block: CSR slot packing + coordinate/id blocks
+    in kernel layout.  Single source of truth for the packing contract, used
+    by build_pack (cached single-chip) and packed_best (in-shard_map).
+
+    Returns (q_idx, q_ok, q, cx, cy, cz, qid3, cid3) with qcap rounded to the
+    output lane multiple (128)."""
+    s_total = own.shape[0]
+    qcap = -(-qcap // 128) * 128
+    q_idx, q_ok = pack_cells(own, starts, counts, qcap)
+    c_idx, c_ok = pack_cells(cand, starts, counts, ccap)
+    # Pad rows keep garbage (point-0) coords on both sides: padded candidates
+    # are masked inside the kernel by their _PAD_C id, and padded query rows
+    # are dropped by the q_ok scatter in the epilogue -- no FAR fill passes.
+    q = jnp.take(points, q_idx, axis=0)
+    # Candidate coordinates one axis at a time as (S, 1, C): the lane axis (C)
+    # never moves -- no 100-MB-scale transpose pass -- and each fits the TPU
+    # block-shape rules.
+    axes = points.T  # (3, n)
+    cx, cy, cz = (jnp.take(axes[ax], c_idx, axis=0).reshape(s_total, 1, ccap)
+                  for ax in range(3))
+    qid3 = jnp.where(q_ok, q_idx, _PAD_Q).astype(jnp.int32).reshape(
+        s_total, 1, qcap)
+    cid3 = jnp.where(c_ok, c_idx, _PAD_C).astype(jnp.int32).reshape(
+        s_total, 1, ccap)
+    return q_idx, q_ok, q, cx, cy, cz, qid3, cid3
+
+
 def packed_best(points: jax.Array, starts: jax.Array, counts: jax.Array,
                 own: jax.Array, cand: jax.Array, lo: jax.Array, hi: jax.Array,
                 qcap: int, ccap: int, k: int, exclude_self: bool, domain: float,
@@ -198,18 +227,9 @@ def packed_best(points: jax.Array, starts: jax.Array, counts: jax.Array,
     including the halo-extended local arrays inside the sharded shard_map
     (parallel/sharded.py).  Returns (q_idx, q_ok, (S,Q,k) dists ascending,
     (S,Q,k) ids into `points`, (S,Q) certificates)."""
-    s_total = own.shape[0]
-    qcap = -(-qcap // 128) * 128
-    q_idx, q_ok = pack_cells(own, starts, counts, qcap)
-    c_idx, c_ok = pack_cells(cand, starts, counts, ccap)
-    q = jnp.take(points, q_idx, axis=0)
-    axes = points.T
-    cx, cy, cz = (jnp.take(axes[ax], c_idx, axis=0).reshape(s_total, 1, ccap)
-                  for ax in range(3))
-    qid3 = jnp.where(q_ok, q_idx, _PAD_Q).astype(jnp.int32).reshape(
-        s_total, 1, qcap)
-    cid3 = jnp.where(c_ok, c_idx, _PAD_C).astype(jnp.int32).reshape(
-        s_total, 1, ccap)
+    q_idx, q_ok, q, cx, cy, cz, qid3, cid3 = _pack_inputs(
+        points, starts, counts, own, cand, qcap, ccap)
+    qcap = q.shape[1]
     out_d, out_i = _pallas_topk(q, cx, cy, cz, qid3, cid3, qcap, ccap, k,
                                 exclude_self, interpret, vma)
     best_d = out_d.transpose(0, 2, 1)
@@ -228,27 +248,13 @@ def build_pack(points: jax.Array, starts: jax.Array, counts: jax.Array,
     s_total = plan.n_chunks * plan.batch
     own = plan.own_cells.reshape(s_total, -1)
     cand = plan.cand_cells.reshape(s_total, -1)
-    qcap = -(-plan.qcap // 128) * 128  # queries sit on the lane axis of outputs
-    ccap = plan.ccap
-
-    q_idx, q_ok = pack_cells(own, starts, counts, qcap)
-    c_idx, c_ok = pack_cells(cand, starts, counts, ccap)
-    q = jnp.take(points, q_idx, axis=0)
-    # Candidate coordinates one axis at a time as (S, 1, C): the lane axis (C)
-    # never moves -- no 100-MB-scale transpose pass -- and each fits the TPU
-    # block-shape rules.
-    axes = points.T  # (3, n)
-    cx, cy, cz = (jnp.take(axes[ax], c_idx, axis=0).reshape(s_total, 1, ccap)
-                  for ax in range(3))
-    qid3 = jnp.where(q_ok, q_idx, _PAD_Q).astype(jnp.int32).reshape(
-        s_total, 1, qcap)
-    cid3 = jnp.where(c_ok, c_idx, _PAD_C).astype(jnp.int32).reshape(
-        s_total, 1, ccap)
+    q_idx, q_ok, q, cx, cy, cz, qid3, cid3 = _pack_inputs(
+        points, starts, counts, own, cand, plan.qcap, plan.ccap)
     return PallasPack(
         q=q, cx=cx, cy=cy, cz=cz, qid3=qid3, cid3=cid3,
         q_idx=q_idx, q_ok=q_ok,
         lo=plan.box_lo.reshape(s_total, 3), hi=plan.box_hi.reshape(s_total, 3),
-        qcap=int(qcap), ccap=int(ccap), s_total=int(s_total))
+        qcap=int(q.shape[1]), ccap=int(plan.ccap), s_total=int(s_total))
 
 
 @functools.partial(jax.jit, static_argnames=("n", "k", "exclude_self", "domain",

@@ -140,14 +140,30 @@ def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
                                              plan.n_chunks * plan.batch)
     starts = np.concatenate([[0], np.cumsum(sc_counts)[:-1]]).astype(np.int32)
     qs = jnp.asarray(queries[order])
-    out_i, out_d, cert = _query_packed(
-        qs, jnp.asarray(starts), jnp.asarray(sc_counts), pack, plan,
-        q2cap, k, False, grid.domain, interpret)
-    out_i = np.asarray(jax.device_get(out_i))
-    out_d = np.asarray(jax.device_get(out_d))
-    cert = np.asarray(jax.device_get(cert))
 
-    if fallback == "brute" and not cert.all():
+    # Backend gate: the kernel tile must fit VMEM *with this query set's*
+    # per-supercell capacity (clustered queries can exceed the stored-point
+    # pack's budget), and backend='xla' configs never take the kernel.  The
+    # safe route is exact tiled brute force over all queries.
+    from .pallas_solve import pallas_fits
+
+    use_kernel = pack is not None and pallas_fits(q2cap, pack.ccap, k)
+    if use_kernel:
+        out_i, out_d, cert = _query_packed(
+            qs, jnp.asarray(starts), jnp.asarray(sc_counts), pack, plan,
+            q2cap, k, False, grid.domain, interpret)
+        out_i = np.asarray(jax.device_get(out_i))
+        out_d = np.asarray(jax.device_get(out_d))
+        cert = np.asarray(jax.device_get(cert))
+    else:
+        out_i = np.full((m, k), INVALID_ID, np.int32)
+        out_d = np.full((m, k), np.inf, np.float32)
+        cert = np.zeros((m,), bool)
+
+    # Brute resolution: fallback for uncertified kernel rows, primary path
+    # when the kernel was gated off (then it ignores fallback='none' -- it is
+    # the only exact route, not a fallback).
+    if not cert.all() and (fallback == "brute" or not use_kernel):
         bad = np.nonzero(~cert)[0].astype(np.int32)
         b_i, b_d = brute_force_by_coords(grid.points, qs[bad], k)
         out_i[bad] = np.asarray(b_i)

@@ -303,17 +303,23 @@ def _solve_planned(points: jax.Array, starts: jax.Array, counts: jax.Array,
     return out_i, out_d, out_cert
 
 
-def resolve_backend(cfg: KnnConfig, plan: SolvePlan) -> str:
-    """'pallas' or 'xla' for this (config, plan).  'auto' picks the fused Pallas
-    kernel on TPU whenever the supercell tile fits the VMEM budget."""
+def pick_backend(cfg: KnnConfig, qcap: int, ccap: int) -> str:
+    """'pallas' or 'xla' for a tile of the given capacities -- the single
+    backend policy, shared by the single-chip, sharded, and external-query
+    paths.  'auto' picks the fused Pallas kernel on TPU whenever the tile
+    fits the VMEM budget."""
     if cfg.backend != "auto":
         return cfg.backend
     from .pallas_solve import pallas_fits  # local import: avoid cycle
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    if (on_tpu or cfg.interpret) and pallas_fits(plan.qcap, plan.ccap, cfg.k):
+    if (on_tpu or cfg.interpret) and pallas_fits(qcap, ccap, cfg.k):
         return "pallas"
     return "xla"
+
+
+def resolve_backend(cfg: KnnConfig, plan: SolvePlan) -> str:
+    return pick_backend(cfg, plan.qcap, plan.ccap)
 
 
 def prepare_pack(grid: GridHash, cfg: KnnConfig, plan: SolvePlan):

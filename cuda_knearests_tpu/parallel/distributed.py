@@ -30,19 +30,38 @@ def init_distributed(coordinator_address: Optional[str] = None,
 
     With no arguments, relies on the cluster environment (TPU pods
     auto-discover); arguments pass through to ``jax.distributed.initialize``
-    for manual bring-up.  Safe to call on a single process: it becomes a
-    no-op when there is nothing to join.
+    for manual bring-up.  Safe to call on a single process with no cluster
+    environment (a no-op) and safe to call twice (already-initialized is a
+    no-op).  Must run before the first JAX computation -- calling it later
+    raises rather than silently degrading to independent single-host jobs.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    defaults = (coordinator_address is None and num_processes is None
+                and process_id is None)
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
-    except (ValueError, RuntimeError):
-        if num_processes not in (None, 1):
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "already" in msg:
+            return  # second call: fine
+        if not defaults:
+            # an explicit cluster spec that failed must always surface
             raise
-        # single-process run with no cluster env: nothing to initialize
+        if "before" in msg:
+            # called after first jax use: harmless on a single process, but
+            # on a pod it would silently degrade to independent jobs -- warn
+            import warnings
+
+            warnings.warn(
+                "init_distributed() called after JAX was already in use; "
+                "multi-host bring-up skipped (call it first on pods)",
+                RuntimeWarning, stacklevel=2)
+        return  # defaults + no cluster environment: single-process run
+    except ValueError:
+        if defaults:
+            return  # no cluster environment to join: single-process run
+        raise
 
 
 def z_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:

@@ -200,15 +200,9 @@ def build_sharded_plan(grid: GridHash, cfg: KnnConfig, ndev: int,
 
 
 def _use_pallas(cfg: KnnConfig, qcap: int, ccap: int) -> bool:
-    """Same policy as ops.solve.resolve_backend, on the sharded plan's caps."""
-    from ..ops.pallas_solve import pallas_fits
+    from ..ops.solve import pick_backend
 
-    if cfg.backend == "pallas":
-        return True
-    if cfg.backend != "auto":
-        return False
-    on_tpu = jax.devices()[0].platform == "tpu"
-    return (on_tpu or cfg.interpret) and pallas_fits(qcap, ccap, cfg.k)
+    return pick_backend(cfg, qcap, ccap) == "pallas"
 
 
 def _make_device_solve(plan: ShardedPlan, cfg: KnnConfig, domain: float,
