@@ -7,7 +7,14 @@ oracle, and -- beyond the reference -- multi-chip grid-slab sharding with ICI
 halo exchange.
 """
 
-from .api import KnnProblem, knn, load_problem, save_problem
+# Restore standard JAX_PLATFORMS semantics before anything touches a backend:
+# some environments site-register an accelerator platform that overrides the
+# env var and hangs backend init when the accelerator transport is down.
+from .utils.platform import honor_jax_platforms_env as _honor
+
+_honor()
+
+from .api import KnnProblem, knn, load_problem, save_problem  # noqa: E402
 from .config import DEFAULT_CELL_DENSITY, DEFAULT_K, DOMAIN_SIZE, KnnConfig
 from .ops.gridhash import GridHash, build_grid, cell_coords, cell_ids, \
     unpermute_neighbors

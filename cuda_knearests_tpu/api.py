@@ -125,16 +125,17 @@ class KnnProblem:
             raise ValueError(
                 f"k={k} exceeds the prepared k={self.config.k}; re-prepare "
                 f"with a larger config.k (it sizes the candidate dilation)")
+        from .ops.solve import prepare_pack
+
         if self.plan is None:
             self.plan = build_plan(self.grid, self.config)
-        pack = None
-        if self.config.backend != "xla":  # explicit xla -> exact brute route
-            if self.pack is None:
-                from .ops.pallas_solve import build_pack
-
-                self.pack = build_pack(self.grid.points, self.grid.cell_starts,
-                                       self.grid.cell_counts, self.plan)
-            pack = self.pack
+        # Same backend policy as solve(): prepare_pack builds the kernel pack
+        # only when pick_backend resolves to pallas (TPU, or interpret mode,
+        # and the tile fits VMEM); otherwise it returns None and query_knn
+        # routes to the exact tiled brute-force path.
+        if self.pack is None:
+            self.pack = prepare_pack(self.grid, self.config, self.plan)
+        pack = self.pack
         interpret = (self.config.interpret
                      or jax.devices()[0].platform == "cpu")
         return query_knn(self.grid, self.plan, pack, queries, k,
@@ -237,14 +238,22 @@ def knn(points, k: int = 10, config: KnnConfig | None = None) -> np.ndarray:
     return problem.get_knearests_original()
 
 
+def _npz_path(path: str) -> str:
+    """np.savez appends '.npz' to bare paths; normalize so save/load agree."""
+    path = str(path)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def save_problem(problem: KnnProblem, path: str) -> None:
-    """Checkpoint a prepared problem (grid + config) to one ``.npz``.
+    """Checkpoint a prepared problem (grid + config) to one ``.npz``
+    ('.npz' is appended when missing, and load_problem does the same).
 
     The reference has no persistence at all (SURVEY.md section 5
     "Checkpoint / resume: Absent"); here a prepared spatial hash -- the
     expensive part of prepare() at 10M+ points -- can be saved and resumed.
     Solved results are not checkpointed (re-solving is cheap and the solve is
     deterministic)."""
+    path = _npz_path(path)
     g = problem.grid
     cfg = dataclasses.asdict(problem.config)
     np.savez_compressed(
@@ -267,7 +276,7 @@ def load_problem(path: str) -> KnnProblem:
 
     from .ops.gridhash import GridHash
 
-    with np.load(path) as z:
+    with np.load(_npz_path(path)) as z:
         cfg = KnnConfig(**json.loads(bytes(z["config_json"]).decode()))
         counts = z["cell_counts"].astype(np.int32)
         grid = GridHash(

@@ -66,7 +66,12 @@ class KnnConfig:
       supercell: query-tile side length in cells.  Queries in the same supercell
         share one gathered candidate set -- this is the TPU replacement for the
         reference's one-thread-per-point divergent traversal (knearests.cu:93-148).
-      sc_batch: how many supercells one jitted chunk processes (bounds peak memory).
+      sc_batch: how many supercells one jitted chunk processes on the XLA
+        backend's lax.scan (bounds that path's peak memory).  The pallas
+        backend instead packs the whole schedule into one kernel launch whose
+        per-program footprint is the VMEM tile -- there sc_batch only shapes
+        the schedule arrays, and peak HBM for the gathered pack grows with the
+        problem, gated by pallas_fits, not by sc_batch.
       dist_method: 'diff' = sum((a-b)^2), identical arithmetic to the oracle and to
         the reference (knearests.cu:125) so single-chip results match exactly;
         'dot' = |a|^2+|b|^2-2ab via batched matmul (XLA backend only -- with a

@@ -31,12 +31,16 @@ _FAR = 1.0e30
 
 
 def bucket_queries(queries: np.ndarray, grid: GridHash, supercell: int,
-                   s_total: int) -> Tuple[np.ndarray, np.ndarray, int]:
+                   s_total: int):
     """Host-side query bucketing: sort queries by supercell id.
 
-    Returns (order, sc_counts, q2cap): `order` sorts queries supercell-major
-    (stable), `sc_counts` is the per-supercell query count padded to the plan's
-    flat supercell axis, `q2cap` the padded per-supercell capacity.
+    Returns (order, sc_counts, sc_starts, q2cap, inv_flat, inv_sc): `order`
+    sorts queries supercell-major (stable), `sc_counts`/`sc_starts` the
+    per-supercell query count / exclusive prefix over the plan's flat
+    supercell axis, `q2cap` the padded per-supercell capacity, and
+    `inv_flat`/`inv_sc` the slot-partition inverse (sorted query row r lives
+    in flat slot inv_flat[r]; its supercell is inv_sc[r]) -- the static map
+    that makes the epilogue a gather, like PallasPack.inv_flat.
     """
     coords = np.asarray(jax.device_get(
         cell_coords(jnp.asarray(queries, jnp.float32), grid.dim, grid.domain)))
@@ -46,23 +50,29 @@ def bucket_queries(queries: np.ndarray, grid: GridHash, supercell: int,
     order = np.argsort(sid, kind="stable").astype(np.int32)
     sc_counts = np.bincount(sid, minlength=s_total).astype(np.int32)
     q2cap = _round_up(int(sc_counts.max()) if sc_counts.size else 1, 128)
-    return order, sc_counts, q2cap
+    starts = np.concatenate([[0], np.cumsum(sc_counts)[:-1]]).astype(np.int64)
+    sid_sorted = sid[order].astype(np.int64)
+    inv_flat = (sid_sorted * q2cap
+                + (np.arange(order.size) - starts[sid_sorted])).astype(np.int32)
+    return (order, sc_counts, starts.astype(np.int32), q2cap, inv_flat,
+            sid_sorted.astype(np.int32))
 
 
 @functools.partial(jax.jit, static_argnames=("q2cap", "k", "exclude_hint",
                                              "domain", "interpret"))
 def _query_packed(queries_sorted: jax.Array, sc_starts: jax.Array,
-                  sc_counts: jax.Array, pack, plan: SolvePlan, q2cap: int,
+                  sc_counts: jax.Array, inv_flat: jax.Array,
+                  inv_sc: jax.Array, pack, plan: SolvePlan, q2cap: int,
                   k: int, exclude_hint: bool, domain: float,
                   interpret: bool = False):
     """Kernel launch over the plan's supercells with external query blocks.
 
     Returns ((m,k) ids in *sorted stored-point* indexing, (m,k) d2,
-    (m,) certified), rows in *sorted query* order.
+    (m,) certified), rows in *sorted query* order.  Same gather-only epilogue
+    as pallas_solve._solve_packed: inv_flat/inv_sc un-pad the slot blocks.
     """
     from .pallas_solve import _PAD_Q, _pallas_topk
 
-    m = queries_sorted.shape[0]
     s_total = pack.s_total
     slots = jnp.arange(q2cap, dtype=jnp.int32)
     qs_idx = sc_starts[:, None] + slots[None, :]
@@ -74,24 +84,19 @@ def _query_packed(queries_sorted: jax.Array, sc_starts: jax.Array,
 
     out_d, out_i = _pallas_topk(q, pack.cx, pack.cy, pack.cz, qid3, pack.cid3,
                                 q2cap, pack.ccap, k, exclude_hint, interpret)
-    best_d = out_d.transpose(0, 2, 1)
-    best_i = out_i.transpose(0, 2, 1)
-    ok = jnp.isfinite(best_d)
-    best_i = jnp.where(ok, best_i, INVALID_ID)
-    best_d = jnp.where(ok, best_d, jnp.inf)
+    flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
+    flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)
+    row_d = jnp.take(flat_d, inv_flat, axis=0)             # (m, k)
+    row_i = jnp.take(flat_i, inv_flat, axis=0)
+    ok = jnp.isfinite(row_d)
+    row_i = jnp.where(ok, row_i, INVALID_ID)
+    row_d = jnp.where(ok, row_d, jnp.inf)
 
-    lo = plan.box_lo.reshape(s_total, 3)
-    hi = plan.box_hi.reshape(s_total, 3)
-    cert = qs_ok & (best_d[..., k - 1] <= _margin_sq(q, lo, hi, domain))
-
-    out_d_full = jnp.full((m, k), jnp.inf, jnp.float32)
-    out_i_full = jnp.full((m, k), INVALID_ID, jnp.int32)
-    out_cert = jnp.zeros((m,), bool)
-    safe = jnp.where(qs_ok, qs_idx, m)
-    out_d_full = out_d_full.at[safe].set(best_d, mode="drop")
-    out_i_full = out_i_full.at[safe].set(best_i, mode="drop")
-    out_cert = out_cert.at[safe].set(cert, mode="drop")
-    return out_i_full, out_d_full, out_cert
+    lo = jnp.take(plan.box_lo.reshape(s_total, 3), inv_sc, axis=0)
+    hi = jnp.take(plan.box_hi.reshape(s_total, 3), inv_sc, axis=0)
+    cert = row_d[:, k - 1] <= _margin_sq(queries_sorted[:, None, :], lo, hi,
+                                         domain)[:, 0]
+    return row_i, row_d, cert
 
 
 @functools.partial(jax.jit, static_argnames=("k", "tile"))
@@ -136,9 +141,8 @@ def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
     m = queries.shape[0]
     if m == 0:
         return (np.empty((0, k), np.int32), np.empty((0, k), np.float32))
-    order, sc_counts, q2cap = bucket_queries(queries, grid, supercell,
-                                             plan.n_chunks * plan.batch)
-    starts = np.concatenate([[0], np.cumsum(sc_counts)[:-1]]).astype(np.int32)
+    order, sc_counts, starts, q2cap, inv_flat, inv_sc = bucket_queries(
+        queries, grid, supercell, plan.n_chunks * plan.batch)
     qs = jnp.asarray(queries[order])
 
     # Backend gate: the kernel tile must fit VMEM *with this query set's*
@@ -150,7 +154,8 @@ def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
     use_kernel = pack is not None and pallas_fits(q2cap, pack.ccap, k)
     if use_kernel:
         out_i, out_d, cert = _query_packed(
-            qs, jnp.asarray(starts), jnp.asarray(sc_counts), pack, plan,
+            qs, jnp.asarray(starts), jnp.asarray(sc_counts),
+            jnp.asarray(inv_flat), jnp.asarray(inv_sc), pack, plan,
             q2cap, k, False, grid.domain, interpret)
         out_i = np.asarray(jax.device_get(out_i))
         out_d = np.asarray(jax.device_get(out_d))

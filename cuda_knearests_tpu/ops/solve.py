@@ -309,7 +309,13 @@ def pick_backend(cfg: KnnConfig, qcap: int, ccap: int) -> str:
     paths.  'auto' picks the fused Pallas kernel on TPU whenever the tile
     fits the VMEM budget."""
     if cfg.backend != "auto":
+        if cfg.backend == "pallas" and cfg.dist_method == "dot":
+            raise ValueError(
+                "backend='pallas' computes 'diff' distances only; use "
+                "dist_method='diff' or backend='xla'")
         return cfg.backend
+    if cfg.dist_method == "dot":
+        return "xla"  # the kernel has no 'dot' arithmetic; honor the request
     from .pallas_solve import pallas_fits  # local import: avoid cycle
 
     on_tpu = jax.devices()[0].platform == "tpu"

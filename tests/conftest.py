@@ -8,7 +8,10 @@ The flags must be set before jax initializes, hence here.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard assignment, not setdefault: the launcher environment may export
+# JAX_PLATFORMS=axon (hardware pin), and the package honors the env var at
+# import -- tests must run on the emulated CPU mesh no matter what.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
