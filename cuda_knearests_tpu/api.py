@@ -52,6 +52,7 @@ class KnnProblem:
     plan: Optional[SolvePlan] = None
     result: Optional[KnnResult] = None
     pack: Optional[object] = None  # cached PallasPack (pallas backend only)
+    aplan: Optional[object] = None  # cached AdaptivePlan (adaptive solve)
 
     @classmethod
     def prepare(cls, points, config: KnnConfig | None = None,
@@ -69,19 +70,46 @@ class KnnProblem:
         points = validate_points(points) if validate else np.asarray(
             points, np.float32)
         grid = build_grid(points, dim=dim, density=config.density)
-        plan = build_plan(grid, config)
-        return cls(grid=grid, config=config, plan=plan)
+        problem = cls(grid=grid, config=config)
+        # plan the path solve() will actually take; the other is built lazily
+        # (query() still uses the legacy plan/pack)
+        if problem._adaptive_eligible():
+            from .ops.adaptive import build_adaptive_plan
+
+            problem.aplan = build_adaptive_plan(grid, config)
+        else:
+            problem.plan = build_plan(grid, config)
+        return problem
+
+    def _adaptive_eligible(self) -> bool:
+        cfg = self.config
+        if not (cfg.adaptive and cfg.dist_method == "diff"):
+            return False
+        if cfg.backend == "auto":
+            return True
+        # explicit 'pallas' only routes here where the kernel can actually
+        # run -- off-TPU without interpret it falls through to the legacy
+        # path, which fails loudly instead of silently streaming XLA
+        return (cfg.backend == "pallas"
+                and (jax.devices()[0].platform == "tpu" or cfg.interpret))
 
     def solve(self) -> KnnResult:
         """Run the grid solve, then resolve uncertified queries exactly
         (reference analog: kn_solve, knearests.cu:348-392)."""
-        from .ops.solve import prepare_pack
+        if self._adaptive_eligible():
+            from .ops.adaptive import build_adaptive_plan, solve_adaptive
 
-        if self.plan is None:
-            self.plan = build_plan(self.grid, self.config)
-        if self.pack is None:
-            self.pack = prepare_pack(self.grid, self.config, self.plan)
-        res = solve(self.grid, self.config, self.plan, self.pack)
+            if self.aplan is None:
+                self.aplan = build_adaptive_plan(self.grid, self.config)
+            res = solve_adaptive(self.grid, self.config, self.aplan)
+        else:
+            from .ops.solve import prepare_pack
+
+            if self.plan is None:
+                self.plan = build_plan(self.grid, self.config)
+            if self.pack is None:
+                self.pack = prepare_pack(self.grid, self.config, self.plan)
+            res = solve(self.grid, self.config, self.plan, self.pack)
         if self.config.fallback == "brute":
             res = self._resolve_uncertified(res)
         self.result = res
@@ -285,5 +313,11 @@ def load_problem(path: str) -> KnnProblem:
             cell_starts=jax.numpy.asarray(z["cell_starts"].astype(np.int32)),
             cell_counts=jax.numpy.asarray(counts),
             dim=int(z["dim"]), domain=float(z["domain"]))
-    plan = build_plan(grid, cfg, cell_counts_host=counts)
-    return KnnProblem(grid=grid, config=cfg, plan=plan)
+    problem = KnnProblem(grid=grid, config=cfg)
+    if problem._adaptive_eligible():
+        from .ops.adaptive import build_adaptive_plan
+
+        problem.aplan = build_adaptive_plan(grid, cfg, cell_counts_host=counts)
+    else:
+        problem.plan = build_plan(grid, cfg, cell_counts_host=counts)
+    return problem

@@ -86,6 +86,15 @@ class KnnConfig:
         XLA supercell scan (ops/solve.py), 'auto' = pallas on TPU when the tile
         fits VMEM, else xla.
       interpret: run Pallas kernels in interpreter mode (CPU testing).
+      adaptive: partition supercells into per-radius capacity classes sized
+        from local ring occupancy (ops/adaptive.py) -- the planner analog of
+        the reference's per-query ring walk (knearests.cu:113-136).  Applies
+        to the single-chip solve when backend is 'auto'/'pallas' and
+        dist_method is 'diff'; dense classes that exceed VMEM stream through
+        a memory-bounded merge instead of demoting the whole solve.
+      max_classes: cap on adaptive capacity classes (one compiled launch each).
+      stream_tile: candidate-axis tile of the streamed (non-kernel) class
+        solver; bounds its peak memory independently of ccap.
     """
 
     k: int = DEFAULT_K
@@ -98,6 +107,9 @@ class KnnConfig:
     fallback: str = "brute"
     backend: str = "auto"
     interpret: bool = False
+    adaptive: bool = True
+    max_classes: int = 4
+    stream_tile: int = 2048
 
     def resolved_ring_radius(self) -> int:
         if self.ring_radius is not None:

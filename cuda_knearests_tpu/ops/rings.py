@@ -101,3 +101,61 @@ def dilated_box(sc_coord: Tuple[int, int, int], supercell: int, radius: int,
     lo = np.maximum(np.asarray(sc_coord) * supercell - radius, 0)
     hi = np.minimum(np.asarray(sc_coord) * supercell + supercell + radius, dim)
     return lo.astype(np.int32), hi.astype(np.int32)
+
+
+def summed_area_table(counts3: np.ndarray) -> np.ndarray:
+    """(dim+1)^3 i64 inclusive 3D prefix sums of per-cell counts -- build once,
+    query many boxes via box_sums(..., sat=...)."""
+    dim = counts3.shape[0]
+    sat = np.zeros((dim + 1,) * 3, dtype=np.int64)
+    sat[1:, 1:, 1:] = counts3.cumsum(0).cumsum(1).cumsum(2)
+    return sat
+
+
+def box_sums(counts3: np.ndarray, lo: np.ndarray, hi: np.ndarray,
+             sat: np.ndarray | None = None) -> np.ndarray:
+    """Sum of per-cell counts over boxes [lo, hi) via a 3D summed-area table.
+
+    counts3 is (dim,dim,dim) indexed [z,y,x]; lo/hi are (m,3) as (x,y,z).
+    Pass a precomputed ``sat`` (summed_area_table) when querying many box sets
+    against the same grid.  The host-side occupancy primitive behind both the
+    capacity planners (ops/solve.py, ops/adaptive.py) and ring_occupancy.
+    """
+    dim = counts3.shape[0]
+    if sat is None:
+        sat = summed_area_table(counts3)
+    lo = np.clip(lo, 0, dim)
+    hi = np.clip(hi, 0, dim)
+    x0, y0, z0 = lo[:, 0], lo[:, 1], lo[:, 2]
+    x1, y1, z1 = hi[:, 0], hi[:, 1], hi[:, 2]
+    s = (sat[z1, y1, x1] - sat[z0, y1, x1] - sat[z1, y0, x1] - sat[z1, y1, x0]
+         + sat[z0, y0, x1] + sat[z0, y1, x0] + sat[z1, y0, x0] - sat[z0, y0, x0])
+    return s
+
+
+def ring_occupancy(counts3: np.ndarray, sc_coords: np.ndarray, supercell: int,
+                   rmax: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-supercell cumulative point and cell counts of the dilation rings.
+
+    The occupancy-resolved version of the reference's ring schedule: where the
+    reference walks ring offsets one query at a time (knearests.cu:113-136),
+    the TPU planner asks, per *supercell*, how many points (and how many
+    in-grid cells) each dilation radius r = 0..rmax captures -- the signal the
+    adaptive planner (ops/adaptive.py) turns into per-supercell radii.
+
+    Returns (points_cum, cells_cum), both (num_sc, rmax+1) i64, where
+    column r covers the box [sc*s - r, sc*s + s + r) clamped to the grid.
+    """
+    dim = counts3.shape[0]
+    num_sc = sc_coords.shape[0]
+    pts = np.empty((num_sc, rmax + 1), np.int64)
+    cells = np.empty((num_sc, rmax + 1), np.int64)
+    base_lo = sc_coords * supercell
+    base_hi = base_lo + supercell
+    sat = summed_area_table(counts3)  # one build for all rmax+1 box queries
+    for r in range(rmax + 1):
+        lo = np.clip(base_lo - r, 0, dim)
+        hi = np.clip(base_hi + r, 0, dim)
+        pts[:, r] = box_sums(counts3, lo, hi, sat=sat)
+        cells[:, r] = np.prod(hi - lo, axis=1)
+    return pts, cells

@@ -35,6 +35,7 @@ import numpy as np
 
 from ..config import KnnConfig
 from .gridhash import GridHash
+from .rings import box_sums as _box_sums  # C3 production wiring
 from .topk import INVALID_ID, init_topk, masked_topk, merge_topk
 
 _FAR = 1.0e30  # padding coordinate; squared distances to it dwarf any real pair
@@ -103,19 +104,6 @@ def _box_cell_ids(sc_coords: np.ndarray, lo_off: int, hi_off: int, s: int,
     return out.astype(np.int32)
 
 
-def _box_sums(counts3: np.ndarray, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
-    """Sum of per-cell counts over boxes [lo, hi) via a 3D summed-area table.
-    counts3 is (dim,dim,dim) indexed [z,y,x]; lo/hi are (m,3) as (x,y,z)."""
-    dim = counts3.shape[0]
-    sat = np.zeros((dim + 1,) * 3, dtype=np.int64)
-    sat[1:, 1:, 1:] = counts3.cumsum(0).cumsum(1).cumsum(2)
-    lo = np.clip(lo, 0, dim)
-    hi = np.clip(hi, 0, dim)
-    x0, y0, z0 = lo[:, 0], lo[:, 1], lo[:, 2]
-    x1, y1, z1 = hi[:, 0], hi[:, 1], hi[:, 2]
-    s = (sat[z1, y1, x1] - sat[z0, y1, x1] - sat[z1, y0, x1] - sat[z1, y1, x0]
-         + sat[z0, y0, x1] + sat[z0, y1, x0] + sat[z1, y0, x0] - sat[z0, y0, x0])
-    return s
 
 
 def _round_up(x: int, m: int) -> int:

@@ -14,8 +14,10 @@ from cuda_knearests_tpu import KnnConfig, KnnProblem
 from cuda_knearests_tpu.io import generate_blue_noise, generate_uniform
 from cuda_knearests_tpu.ops.pallas_solve import pallas_fits, vmem_bytes_estimate
 
+# adaptive=False pins the *legacy* single-pack kernel path this file covers;
+# the adaptive class-partitioned path has its own suite (test_adaptive.py).
 XLA = KnnConfig(k=8, backend="xla")
-PAL = KnnConfig(k=8, backend="pallas", interpret=True)
+PAL = KnnConfig(k=8, backend="pallas", interpret=True, adaptive=False)
 
 
 def _solve_pair(points, cfg_a=XLA, cfg_b=PAL):
