@@ -31,7 +31,6 @@ import argparse
 import json
 import os
 import signal
-import subprocess
 import sys
 import time
 
@@ -39,57 +38,22 @@ NORTH_STAR_METRIC = ("queries/sec/chip, all-points kNN on 900k_blue_cube.xyz "
                      "(k=10)")
 
 
+# Shared with the CLI driver; probing must stay subprocess-based (see the
+# docstrings in utils/platform.py).  Importing the package is backend-safe:
+# module import never initializes a jax backend.
+from cuda_knearests_tpu.utils import platform as _platform
+
+
 def _probe_default_backend(timeout_s: float) -> str | None:
-    """Ask a subprocess whether the default jax backend initializes, and on
-    what platform.  A subprocess because a down accelerator transport makes
-    backend init *hang*, not error -- the parent must be able to time it out
-    without poisoning its own jax state."""
-    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
-    try:
-        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                           text=True, timeout=timeout_s)
-    except (subprocess.TimeoutExpired, OSError):
-        return None
-    if r.returncode == 0:
-        for line in r.stdout.splitlines():
-            if line.startswith("PLATFORM="):
-                return line.split("=", 1)[1].strip()
-    return None
+    return _platform._probe_default_backend(timeout_s)
 
 
 def acquire_backend(tries: int | None = None, timeout_s: float | None = None):
-    """Bounded retry-with-backoff around backend acquisition.
-
-    Returns (platform, note): the platform the bench will run on, plus a
-    diagnostic note when the default (accelerator) backend was unavailable and
-    the bench fell back to CPU.  JAX_PLATFORMS=cpu short-circuits (cpu init
-    cannot hang); any other environment -- unset, or an accelerator pin like
-    the launcher's JAX_PLATFORMS=axon -- is probed in a subprocess first,
-    because a pinned-but-dead accelerator is exactly the round-1 failure mode.
-    BENCH_PROBE_TRIES / BENCH_PROBE_TIMEOUT_S override the retry bounds.
-    """
-    explicit = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
-    if explicit == "cpu":
-        return "cpu", None
-    if tries is None:
-        tries = int(os.environ.get("BENCH_PROBE_TRIES", "3"))
-    if timeout_s is None:
-        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
-    delay = 5.0
-    for i in range(tries):
-        platform = _probe_default_backend(timeout_s)
-        if platform:
-            return platform, None
-        if i + 1 < tries:
-            time.sleep(delay)
-            delay *= 2
-    # Persistent failure: pin cpu *before* this process first touches jax so
-    # the broken accelerator init is never entered here.
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    note = (f"default jax backend unavailable after {tries} probes "
-            f"({timeout_s:.0f}s timeout each); fell back to cpu")
-    print(note, file=sys.stderr, flush=True)
-    return "cpu", note
+    """Bounded retry-with-backoff around backend acquisition (see
+    utils/platform.acquire_backend).  Kept as a bench-module symbol so the
+    fault-injection tests can monkeypatch the probe here."""
+    return _platform.acquire_backend(tries, timeout_s,
+                                     probe=_probe_default_backend)
 
 
 def _steady_state(fn, iters: int = 3) -> float:

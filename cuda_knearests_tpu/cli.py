@@ -75,7 +75,13 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", help="emit a JSON summary line")
     args = ap.parse_args(argv)
 
-    from .utils.platform import honor_jax_platforms_env
+    # Bounded-time backend acquisition BEFORE the first jax touch: with the
+    # accelerator transport down, backend init hangs instead of erroring (the
+    # exact environment failure mode bench.py guards against) -- probe in a
+    # subprocess and pin cpu on persistent failure, so the driver always
+    # terminates.  JAX_PLATFORMS=cpu short-circuits the probe entirely.
+    from .utils.platform import acquire_backend, honor_jax_platforms_env
+    platform, backend_note = acquire_backend()
     honor_jax_platforms_env()
 
     from . import KnnConfig, KnnProblem
@@ -95,7 +101,11 @@ def main(argv=None) -> int:
     cfg_kw = {} if args.supercell is None else {"supercell": args.supercell}
     cfg = KnnConfig(k=args.k, density=args.density, ring_radius=args.ring_radius,
                     dist_method=args.dist, **cfg_kw)
-    summary = {"n": n, "k": args.k, "mode": "sharded" if args.sharded else "single"}
+    summary = {"n": n, "k": args.k,
+               "mode": "sharded" if args.sharded else "single",
+               "platform": platform}
+    if backend_note:
+        summary["backend_note"] = backend_note
 
     # --- accelerated solve (reference "knn gpu" phase, test_knearests.cu:136) ---
     if args.sharded:
@@ -103,8 +113,16 @@ def main(argv=None) -> int:
         with Stopwatch("prepare (grid + slab plan)"):
             sp = ShardedKnnProblem.prepare(points, n_devices=args.sharded,
                                            config=cfg)
-        with Stopwatch("solve (sharded, incl. compile)"):
-            neighbors, d2, cert = sp.solve()
+        # device-side steady state, compile split out -- same convention (and
+        # the same JSON summary schema) as the single-chip branch below
+        dev_out, t = timed(lambda: sp.solve_device(), warmup=1, iters=1)
+        print(f"solve (sharded): compile+first {t['warmup_s']:.3f}s, "
+              f"steady {t['min_s']:.3f}s "
+              f"({n / t['min_s']:.0f} queries/sec)")
+        summary["solve_s"] = t["min_s"]
+        summary["qps"] = n / t["min_s"]
+        with Stopwatch("assemble (host readback)"):
+            neighbors, d2, cert = sp.solve(device_out=dev_out)
         perm = np.asarray(sp.grid.permutation)
     else:
         with Stopwatch("prepare (grid + plan)"):

@@ -360,12 +360,15 @@ class ShardedKnnProblem:
             plan.top_pts, plan.top_counts, plan.top_base,
             plan.own_cells, plan.cand_cells, plan.box_lo, plan.box_hi)
 
-    def solve(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def solve(self, device_out=None) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run the sharded solve.  Returns (neighbors_original_ids (n, k),
         dists_sq (n, k), certified (n,)) on the host, exact (uncertified
-        queries resolved against the global array)."""
+        queries resolved against the global array).  Pass ``device_out`` (a
+        previous ``solve_device()`` result) to assemble without re-running the
+        mesh solve."""
         plan, cfg = self.plan, self.config
-        out_i, out_d, out_cert = self.solve_device()
+        out_i, out_d, out_cert = (device_out if device_out is not None
+                                  else self.solve_device())
         out_i = np.asarray(jax.device_get(out_i))
         out_d = np.asarray(jax.device_get(out_d))
         out_cert = np.asarray(jax.device_get(out_cert))

@@ -1,16 +1,29 @@
-"""Platform-selection guard.
+"""Platform selection & safe backend acquisition.
 
-Some environments install a site hook that registers an accelerator backend and
-widens ``jax_platforms`` behind the user's back, which both overrides an
-explicit ``JAX_PLATFORMS=cpu`` and can hang backend init when the accelerator
-transport is down.  ``honor_jax_platforms_env()`` restores the standard
-semantics: if the user set ``JAX_PLATFORMS``, that is what jax uses.  Call it
-at entry-point start, before the first backend use.
+Two related guards live here:
+
+``honor_jax_platforms_env()`` -- some environments install a site hook that
+registers an accelerator backend and widens ``jax_platforms`` behind the
+user's back, which both overrides an explicit ``JAX_PLATFORMS=cpu`` and can
+hang backend init when the accelerator transport is down.  This restores the
+standard semantics: if the user set ``JAX_PLATFORMS``, that is what jax uses.
+Call it at entry-point start, before the first backend use.
+
+``acquire_backend()`` -- a down accelerator transport makes jax backend init
+*hang*, not error (the reference's failure mode is the opposite: every CUDA
+call is checked and exits, /root/reference/knearests.cu:205-231).  So any
+entry point that must terminate in bounded time (bench, CLI) first probes the
+default backend in a subprocess it can time out, retries with backoff, and on
+persistent failure pins ``JAX_PLATFORMS=cpu`` before this process ever touches
+a backend.
 """
 
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
+import time
 
 
 def honor_jax_platforms_env() -> None:
@@ -19,3 +32,76 @@ def honor_jax_platforms_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", want)
+
+
+def _probe_default_backend(timeout_s: float) -> str | None:
+    """Ask a subprocess whether the default jax backend initializes, and on
+    what platform.  A subprocess because a down accelerator transport makes
+    backend init *hang*, not error -- the parent must be able to time it out
+    without poisoning its own jax state.  The probe applies the same
+    JAX_PLATFORMS-restoring semantics as honor_jax_platforms_env, so it
+    answers for the platform the parent will actually run on -- not whatever
+    a site hook widens the subprocess to."""
+    code = ("import os, jax\n"
+            "w = os.environ.get('JAX_PLATFORMS')\n"
+            "if w: jax.config.update('jax_platforms', w)\n"
+            "print('PLATFORM=' + jax.devices()[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s)
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if r.returncode == 0:
+        for line in r.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                return line.split("=", 1)[1].strip()
+    return None
+
+
+def acquire_backend(tries: int | None = None, timeout_s: float | None = None,
+                    probe=None):
+    """Bounded retry-with-backoff around backend acquisition.
+
+    Returns (platform, note): the platform the caller will run on, plus a
+    diagnostic note when the default (accelerator) backend was unavailable and
+    the caller fell back to CPU.  JAX_PLATFORMS=cpu short-circuits (cpu init
+    cannot hang); any other environment -- unset, or an accelerator pin like
+    the launcher's JAX_PLATFORMS=axon -- is probed in a subprocess first,
+    because a pinned-but-dead accelerator is exactly the hang scenario.
+    BENCH_PROBE_TRIES / BENCH_PROBE_TIMEOUT_S override the retry bounds.
+
+    The probe is deliberately NOT cached across invocations: a transport can
+    die between runs, and a stale "healthy" record would send the parent
+    straight into an unbounded in-process backend init -- the exact hang this
+    function exists to prevent.  Healthy accelerators therefore pay one
+    subprocess backend init per entry-point run; callers that want zero
+    overhead can pin JAX_PLATFORMS explicitly.
+    """
+    explicit = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    if explicit == "cpu":
+        return "cpu", None
+    if tries is None:
+        tries = int(os.environ.get("BENCH_PROBE_TRIES", "3"))
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
+    if probe is None:
+        probe = _probe_default_backend
+    delay = 5.0
+    for i in range(tries):
+        platform = probe(timeout_s)
+        if platform:
+            return platform, None
+        if i + 1 < tries:
+            time.sleep(delay)
+            delay *= 2
+    # Persistent failure: pin cpu in the env (for any child process) AND at
+    # jax config level -- jax is typically already imported by the package
+    # __init__ at this point, so the env var alone would be a no-op here.
+    # honor_jax_platforms_env applies the config-level pin; making the
+    # fallback self-contained means callers need no ordering contract.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    honor_jax_platforms_env()
+    note = (f"default jax backend unavailable after {tries} probes "
+            f"({timeout_s:.0f}s timeout each); fell back to cpu")
+    print(note, file=sys.stderr, flush=True)
+    return "cpu", note

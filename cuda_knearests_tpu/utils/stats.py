@@ -33,8 +33,16 @@ def occupancy_stats(cell_counts: np.ndarray) -> Dict[str, Any]:
 
 
 def problem_stats(problem) -> Dict[str, Any]:
-    """Full stats for an api.KnnProblem (post-solve fields optional)."""
+    """Full stats for an api.KnnProblem (post-solve fields optional).
+
+    Both planner shapes are reported under ``plan``: the legacy global
+    schedule as a single (qcap, ccap), and the adaptive schedule as the
+    per-class capacity table plus the (max-over-classes) aggregate caps --
+    so capacity diagnostics (the reference's convergence half of
+    kn_print_stats, knearests.cu:440-466) survive the default config.
+    """
     grid = problem.grid
+    aplan = getattr(problem, "aplan", None)
     out: Dict[str, Any] = {
         "n_points": grid.n_points,
         "grid_dim": grid.dim,
@@ -42,9 +50,21 @@ def problem_stats(problem) -> Dict[str, Any]:
         "ring_radius": problem.config.resolved_ring_radius(),
         "supercell": problem.config.supercell,
         "occupancy": occupancy_stats(np.asarray(grid.cell_counts)),
-        "device_bytes": nbytes((grid, problem.plan)),
+        "device_bytes": nbytes((grid, problem.plan, aplan,
+                                getattr(problem, "pack", None))),
     }
-    if problem.plan is not None:
+    # aplan wins the report when both schedules exist: solve() routes adaptive
+    # whenever an aplan is present, the legacy plan then only serves query()
+    if aplan is not None:
+        classes = [{"radius": cp.radius, "n_supercells": cp.n_sc,
+                    "qcap": cp.qcap, "ccap": cp.ccap,
+                    "use_pallas": bool(cp.use_pallas)}
+                   for cp in aplan.classes]
+        out["plan"] = {"adaptive": True, "n_classes": len(classes),
+                       "qcap": max(c["qcap"] for c in classes),
+                       "ccap": max(c["ccap"] for c in classes),
+                       "classes": classes}
+    elif problem.plan is not None:
         out["plan"] = {"qcap": problem.plan.qcap, "ccap": problem.plan.ccap,
                        "n_supercell_chunks": problem.plan.n_chunks,
                        "chunk_batch": problem.plan.batch}
@@ -66,6 +86,17 @@ def print_stats(problem) -> Dict[str, Any]:
     hist = occ["histogram"]
     for v in sorted(hist):
         print(f"  cells with {v:3d} points: {hist[v]}")
+    plan = s.get("plan")
+    if plan is not None and plan.get("adaptive"):
+        print(f"adaptive schedule: {plan['n_classes']} capacity classes "
+              f"(max qcap {plan['qcap']}, max ccap {plan['ccap']})")
+        for c in plan["classes"]:
+            route = "pallas" if c["use_pallas"] else "streamed"
+            print(f"  class r={c['radius']}: {c['n_supercells']} supercells, "
+                  f"qcap {c['qcap']}, ccap {c['ccap']} [{route}]")
+    elif plan is not None:
+        print(f"schedule: qcap {plan['qcap']}, ccap {plan['ccap']}, "
+              f"{plan['n_supercell_chunks']} chunks x {plan['chunk_batch']}")
     if "certified_fraction" in s:
         print(f"certified: {100.0 * s['certified_fraction']:.4f}% "
               f"({s['uncertified']} fallback queries)")

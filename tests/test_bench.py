@@ -44,6 +44,11 @@ def test_acquire_backend_falls_back_to_cpu(monkeypatch):
     assert platform == "cpu"
     assert note and "unavailable" in note
     assert os.environ["JAX_PLATFORMS"] == "cpu"
+    # self-contained pin: the config level must be set too (jax is already
+    # imported in-process here), not just the env var
+    import jax
+
+    assert jax.config.jax_platforms == "cpu"
 
 
 def _last_json_line(text: str):
