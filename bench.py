@@ -56,13 +56,27 @@ def acquire_backend(tries: int | None = None, timeout_s: float | None = None):
                                      probe=_probe_default_backend)
 
 
-def _steady_state(fn, iters: int = 3) -> float:
-    """Min wall seconds over `iters` runs of fn (fn must block on its result)."""
+def _budget_s(default: float = 75.0) -> float:
+    """Per-measurement wall-clock budget (BENCH_MAX_SECONDS overrides).  The
+    bench must produce its JSON line in bounded time even on the CPU fallback,
+    where one 900k solve costs ~2 minutes (measured: 115s steady)."""
+    return float(os.environ.get("BENCH_MAX_SECONDS", default))
+
+
+def _steady_state(fn, iters: int = 3, max_seconds: float | None = None) -> float:
+    """Min wall seconds over up to `iters` runs of fn (fn must block on its
+    result).  Stops early -- always after at least one run -- once cumulative
+    wall time exceeds `max_seconds`, so a slow platform caps at one
+    measurement instead of multiplying it."""
     times = []
+    spent = 0.0
     for _ in range(iters):
         t0 = time.perf_counter()
         fn()
         times.append(time.perf_counter() - t0)
+        spent += times[-1]
+        if max_seconds is not None and spent >= max_seconds:
+            break
     return min(times)
 
 
@@ -79,35 +93,82 @@ def _solve_qps(points, cfg, iters: int = 3):
         jax.block_until_ready((res.neighbors, res.dists_sq, res.certified))
 
     run()  # compile + warmup
-    s = _steady_state(run, iters)
+    s = _steady_state(run, iters, max_seconds=_budget_s())
     return points.shape[0] / s, s, problem
 
 
-def _oracle_qps(points, k: int):
-    """(qps, seconds, (ids, d2)) for the exact CPU kd-tree, build + query."""
+def _oracle_qps(points, k: int, sample_idx=None):
+    """Exact CPU kd-tree baseline, build + query (the reference's own
+    "knn cpu" phase, test_knearests.cu:198-214).
+
+    With ``sample_idx`` (seeded query subsample), only those rows are queried
+    and the all-points cost is extrapolated from the measured per-query rate
+    -- recall on ~20k sampled queries is statistically indistinguishable from
+    the full check, at a fraction of the wall clock.  Returns
+    (qps_all_points_equivalent, seconds_measured, (ids, d2)).
+    """
+    import numpy as np
+
     from cuda_knearests_tpu.oracle import KdTreeOracle
 
+    n = points.shape[0]
     t0 = time.perf_counter()
     oracle = KdTreeOracle(points)
-    ref_ids, ref_d2 = oracle.knn_all_points(k=k)
-    s = time.perf_counter() - t0
-    return points.shape[0] / s, s, (ref_ids, ref_d2)
+    build_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    if sample_idx is None:
+        ref_ids, ref_d2 = oracle.knn_all_points(k=k)
+        query_s = time.perf_counter() - t0
+        total = build_s + query_s
+        return n / total, total, (ref_ids, ref_d2)
+    sample_idx = np.asarray(sample_idx, np.int32)
+    ref_ids, ref_d2 = oracle.knn(points[sample_idx], k, exclude_ids=sample_idx)
+    query_s = time.perf_counter() - t0
+    est_total = build_s + query_s * (n / max(1, sample_idx.size))
+    return n / est_total, build_s + query_s, (ref_ids, ref_d2)
 
 
 def bench_north_star() -> dict:
-    """900k_blue_cube.xyz, k=10: qps/chip + recall@10 vs the exact oracle."""
+    """900k_blue_cube.xyz, k=10: qps/chip + recall@10 vs the exact oracle.
+
+    The oracle recall check runs on a seeded ~20k query subsample
+    (BENCH_ORACLE_SAMPLE overrides; 0 = all points): statistically identical
+    to the full check and bounded-time on every platform, so the bench always
+    lands its JSON line (the perf-record contract this harness exists for).
+    """
     import numpy as np
 
     from cuda_knearests_tpu import KnnConfig
     from cuda_knearests_tpu.cli import set_recall
     from cuda_knearests_tpu.io import get_dataset
 
+    import jax
+
     k = 10
     points = get_dataset("900k_blue_cube.xyz")
+    # CPU fallback: one 900k solve costs 190s compile + 115s steady on this
+    # host (measured) -- with the dead-transport probe cost in front, the
+    # full-size run cannot land inside the wall budget.  Scale the fallback
+    # down (honestly marked in the JSON) so a valid line always appears;
+    # accelerator runs always measure the full 900k.  BENCH_NORTH_N overrides.
+    full_n = points.shape[0]
+    on_cpu = jax.devices()[0].platform == "cpu"
+    n_target = int(os.environ.get("BENCH_NORTH_N",
+                                  "150000" if on_cpu else str(full_n)))
+    if n_target < full_n:
+        sel = np.random.default_rng(900).permutation(full_n)[:n_target]
+        points = points[np.sort(sel)]
+    n = points.shape[0]
     qps, solve_s, problem = _solve_qps(points, KnnConfig(k=k))
-    cpu_qps, _, (ref_ids, _) = _oracle_qps(points, k)
-    recall = set_recall(problem.get_knearests_original(), ref_ids)
-    return {
+    sample_n = int(os.environ.get("BENCH_ORACLE_SAMPLE", "20000")) or n
+    sample_n = min(sample_n, n)
+    sample = (None if sample_n >= n else
+              np.sort(np.random.default_rng(20626).choice(
+                  n, sample_n, replace=False).astype(np.int32)))
+    cpu_qps, _, (ref_ids, _) = _oracle_qps(points, k, sample_idx=sample)
+    got = problem.get_knearests_original()
+    recall = set_recall(got if sample is None else got[sample], ref_ids)
+    out = {
         "metric": "queries/sec/chip, all-points kNN on 900k_blue_cube.xyz (k=10)",
         "value": round(qps, 1),
         "unit": "queries/sec",
@@ -115,10 +176,14 @@ def bench_north_star() -> dict:
         "recall_at_10": round(recall, 6),
         "solve_s": round(solve_s, 4),
         "cpu_oracle_qps": round(cpu_qps, 1),
-        "n_points": points.shape[0],
+        "oracle_sampled": sample_n,
+        "n_points": n,
         "certified_fraction": float(
             np.asarray(problem.result.certified).mean()),
     }
+    if n < full_n:
+        out["scaled_down_from"] = full_n
+    return out
 
 
 def bench_config(name: str) -> dict:
@@ -156,7 +221,13 @@ def bench_config(name: str) -> dict:
         from cuda_knearests_tpu.parallel.sharded import ShardedKnnProblem
 
         ndev = len(jax.devices())
-        points = generate_uniform(10_000_000, seed=10)
+        # Full 10M on accelerators; the CPU fallback scales the point count
+        # down (BENCH_SHARDED_N overrides) so the row still executes in
+        # bounded time and the mesh path stays on record even chip-down.
+        on_cpu = jax.devices()[0].platform == "cpu"
+        n_target = int(os.environ.get("BENCH_SHARDED_N",
+                                      "1000000" if on_cpu else "10000000"))
+        points = generate_uniform(n_target, seed=10)
         sp = ShardedKnnProblem.prepare(points, n_devices=ndev,
                                        config=KnnConfig(k=10))
 
@@ -164,13 +235,17 @@ def bench_config(name: str) -> dict:
             jax.block_until_ready(sp.solve_device())
 
         run()  # compile + warmup; timing is device-side like the other configs
-        s = _steady_state(run, iters=2)
+        s = _steady_state(run, iters=2, max_seconds=_budget_s())
         qps = points.shape[0] / s
-        return {"config": f"sharded 10M synthetic uniform points (k=10) over "
-                          f"{ndev}-chip mesh",
-                "value": round(qps / ndev, 1), "unit": "queries/sec/chip",
-                "total_qps": round(qps, 1), "n_devices": ndev,
-                "solve_s": round(s, 4), "n_points": points.shape[0]}
+        label_n = f"{n_target / 1e6:g}M"
+        row = {"config": f"sharded {label_n} synthetic uniform points (k=10) "
+                         f"over {ndev}-chip mesh",
+               "value": round(qps / ndev, 1), "unit": "queries/sec/chip",
+               "total_qps": round(qps, 1), "n_devices": ndev,
+               "solve_s": round(s, 4), "n_points": points.shape[0]}
+        if n_target != 10_000_000:
+            row["scaled_down_from"] = 10_000_000
+        return row
     raise ValueError(f"unknown config {name!r}")
 
 

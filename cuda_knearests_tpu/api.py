@@ -33,6 +33,7 @@ from .ops.gridhash import GridHash, build_grid, unpermute_neighbors
 from .ops.solve import (KnnResult, SolvePlan, brute_force_by_index, build_plan,
                         solve)
 from .utils import stats as _stats
+from .utils.memory import from_device
 
 
 def _pad_pow2(x: np.ndarray, fill: int, minimum: int = 8) -> np.ndarray:
@@ -71,8 +72,8 @@ class KnnProblem:
             points, np.float32)
         grid = build_grid(points, dim=dim, density=config.density)
         problem = cls(grid=grid, config=config)
-        # plan the path solve() will actually take; the other is built lazily
-        # (query() still uses the legacy plan/pack)
+        # one planning pass: adaptive problems use the aplan for both solve()
+        # and query(); the legacy plan/pack exist only for non-adaptive configs
         if problem._adaptive_eligible():
             from .ops.adaptive import build_adaptive_plan
 
@@ -120,7 +121,7 @@ class KnnProblem:
         # path costs an 8-byte transfer, not the full (n,) mask.
         if int(jax.device_get(jax.numpy.sum(~res.certified))) == 0:
             return res
-        cert = np.asarray(jax.device_get(res.certified))
+        cert = from_device(res.certified)
         bad = np.nonzero(~cert)[0].astype(np.int32)
         # Pad to a power of two so repeated solves reuse a handful of compiles.
         q_idx = _pad_pow2(bad, fill=-1)
@@ -146,13 +147,20 @@ class KnnProblem:
         Returns ((m, k) neighbor ids in original indexing, ascending by
         distance; (m, k) squared distances).
         """
-        from .ops.query import query_knn
-
         k = self.config.k if k is None else int(k)
         if k > self.config.k:
             raise ValueError(
                 f"k={k} exceeds the prepared k={self.config.k}; re-prepare "
                 f"with a larger config.k (it sizes the candidate dilation)")
+        # One planning pass per problem: adaptive problems route external
+        # queries through the class schedule prepare() already built, never
+        # materializing the legacy SolvePlan/PallasPack alongside it.
+        if self.aplan is not None:
+            from .ops.adaptive import query_adaptive
+
+            return query_adaptive(self.grid, self.config, self.aplan,
+                                  queries, k, self.config.fallback)
+        from .ops.query import query_knn
         from .ops.solve import prepare_pack
 
         if self.plan is None:
@@ -200,30 +208,30 @@ class KnnProblem:
 
     def get_points(self) -> np.ndarray:
         """Points in sorted (grid) order, like kn_get_points (knearests.cu:406)."""
-        return np.asarray(jax.device_get(self.grid.points))
+        return from_device(self.grid.points)
 
     def get_permutation(self) -> np.ndarray:
         """sorted position -> original index, like kn_get_permutation
         (knearests.cu:430)."""
-        return np.asarray(jax.device_get(self.grid.permutation))
+        return from_device(self.grid.permutation)
 
     def get_knearests(self) -> np.ndarray:
         """(n, k) neighbor ids in *sorted* indexing, ascending by distance --
         the reference's output contract (knearests.cu:141-147,420)."""
         self._require_solved()
-        return np.asarray(jax.device_get(self.result.neighbors))
+        return from_device(self.result.neighbors)
 
     def get_knearests_original(self) -> np.ndarray:
         """(n, k) neighbor table re-expressed in original point ids -- the
         un-permute step the reference leaves to its caller
         (test_knearests.cu:155-160)."""
         self._require_solved()
-        return np.asarray(jax.device_get(
-            unpermute_neighbors(self.grid, self.result.neighbors)))
+        return from_device(
+            unpermute_neighbors(self.grid, self.result.neighbors))
 
     def get_dists_sq(self) -> np.ndarray:
         self._require_solved()
-        return np.asarray(jax.device_get(self.result.dists_sq))
+        return from_device(self.result.dists_sq)
 
     def get_edges(self, symmetric: bool = False) -> np.ndarray:
         """kNN graph as a COO edge list (E, 2) of original point ids.
@@ -286,10 +294,10 @@ def save_problem(problem: KnnProblem, path: str) -> None:
     cfg = dataclasses.asdict(problem.config)
     np.savez_compressed(
         path,
-        points=np.asarray(jax.device_get(g.points)),
-        permutation=np.asarray(jax.device_get(g.permutation)),
-        cell_starts=np.asarray(jax.device_get(g.cell_starts)),
-        cell_counts=np.asarray(jax.device_get(g.cell_counts)),
+        points=from_device(g.points),
+        permutation=from_device(g.permutation),
+        cell_starts=from_device(g.cell_starts),
+        cell_counts=from_device(g.cell_counts),
         dim=np.int64(g.dim), domain=np.float64(g.domain),
         config_json=np.bytes_(
             __import__("json").dumps(

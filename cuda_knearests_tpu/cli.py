@@ -123,7 +123,7 @@ def main(argv=None) -> int:
         summary["qps"] = n / t["min_s"]
         with Stopwatch("assemble (host readback)"):
             neighbors, d2, cert = sp.solve(device_out=dev_out)
-        perm = np.asarray(sp.grid.permutation)
+        perm = sp.permutation()
     else:
         with Stopwatch("prepare (grid + plan)"):
             problem = KnnProblem.prepare(points, cfg)

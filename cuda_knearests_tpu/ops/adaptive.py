@@ -165,7 +165,7 @@ class ClassPlan:
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=("classes", "inv_flat", "inv_box"),
+    data_fields=("classes", "inv_flat", "inv_box", "class_of_sc", "row_of_sc"),
     meta_fields=("n_points",),
 )
 @dataclasses.dataclass(frozen=True)
@@ -176,11 +176,18 @@ class AdaptivePlan:
               (class c contributes n_sc * qcap_pad rows at its offset).
     inv_box:  (n,) i32 into the concatenation of per-class supercell axes
               (for the per-row lo/hi certificate gather).
+    class_of_sc / row_of_sc: (n_sc_global,) i32 -- which class each global
+              supercell landed in (-1 = dropped/empty) and its row within
+              that class's tables; external queries bucket through these
+              (query_adaptive), so one planning pass serves both the
+              self-solve and arbitrary-coordinate queries.
     """
 
     classes: Tuple[ClassPlan, ...]
     inv_flat: jax.Array
     inv_box: jax.Array
+    class_of_sc: jax.Array
+    row_of_sc: jax.Array
     n_points: int
 
 
@@ -213,7 +220,11 @@ def build_adaptive_plan(grid: GridHash, cfg: KnnConfig,
 
     w = grid.domain / dim
     classes = []
-    for spec in specs:
+    class_of = np.full((sc.shape[0],), -1, np.int32)
+    row_of = np.zeros((sc.shape[0],), np.int32)
+    for ci, spec in enumerate(specs):
+        class_of[spec.rows] = ci
+        row_of[spec.rows] = np.arange(spec.rows.size, dtype=np.int32)
         sc_c = sc[spec.rows]
         own = _box_cell_ids(sc_c, 0, 0, s, dim)
         cand = _box_cell_ids(sc_c, -spec.radius, spec.radius, s, dim)
@@ -228,7 +239,8 @@ def build_adaptive_plan(grid: GridHash, cfg: KnnConfig,
     inv_flat, inv_box = _invert_partition(
         tuple(classes), grid.cell_starts, grid.cell_counts, grid.n_points)
     return AdaptivePlan(classes=tuple(classes), inv_flat=inv_flat,
-                        inv_box=inv_box, n_points=grid.n_points)
+                        inv_box=inv_box, class_of_sc=jnp.asarray(class_of),
+                        row_of_sc=jnp.asarray(row_of), n_points=grid.n_points)
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
@@ -253,24 +265,25 @@ def _invert_partition(classes: Tuple[ClassPlan, ...], starts: jax.Array,
     return inv_flat, inv_box
 
 
-def _streamed_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
-                    cp: ClassPlan, k: int, exclude_self: bool, tile: int):
-    """Memory-bounded class solver: candidates stream through merge_topk.
+def _streamed_topk(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                   cand_cells: jax.Array, q: jax.Array, q_ok: jax.Array,
+                   q_excl: jax.Array, k: int, ccap: int, tile: int):
+    """Memory-bounded candidate streaming through merge_topk (the core shared
+    by the self-solve streamed route and external queries).
 
-    The route for classes whose (qcap, ccap) tile exceeds VMEM (dense
-    clusters) -- and for non-kernel platforms.  Peak temp is
-    (rows_chunk, qcap, tile), independent of ccap, so no class can demote or
-    OOM the solve.  Returns (Sc * qcap_pad, k) flat dists/ids, ascending.
+    q: (Sc, qcap, 3) query blocks; q_ok validity; q_excl (Sc, qcap) stored
+    index to exclude per slot (-2 = exclude nothing -- external queries).
+    Peak temp is (rows_chunk, qcap, tile), independent of ccap, so no class
+    can demote or OOM the solve.  Returns (Sc * qcap, k) flat dists/ids,
+    ascending.
     """
-    qcap, ccap = cp.qcap_pad, cp.ccap
+    n_sc, qcap = q.shape[0], q.shape[1]
     c_pad = -(-ccap // tile) * tile
-    q_idx, q_ok = pack_cells(cp.own, starts, counts, qcap)
-    c_idx, c_ok = pack_cells(cp.cand, starts, counts, c_pad)
-    q = jnp.take(points, q_idx, axis=0)                      # (Sc, qcap, 3)
+    c_idx, c_ok = pack_cells(cand_cells, starts, counts, c_pad)
     n_tiles = c_pad // tile
     # rows per scan step: bound the (rows, qcap, tile) temp to ~64 MB
-    rows_chunk = max(1, min(cp.n_sc, (64 << 20) // (qcap * tile * 4)))
-    n_row_chunks = -(-cp.n_sc // rows_chunk)
+    rows_chunk = max(1, min(n_sc, (64 << 20) // (qcap * tile * 4)))
+    n_row_chunks = -(-n_sc // rows_chunk)
     rows_pad = n_row_chunks * rows_chunk
 
     def pad_rows(a):
@@ -279,7 +292,7 @@ def _streamed_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
             a = jnp.concatenate([a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
         return a.reshape((n_row_chunks, rows_chunk) + a.shape[1:])
 
-    qs, qi, qo = pad_rows(q), pad_rows(q_idx), pad_rows(q_ok)
+    qs, qi, qo = pad_rows(q), pad_rows(q_excl), pad_rows(q_ok)
     ci = pad_rows(c_idx).reshape(n_row_chunks, rows_chunk, n_tiles, tile)
     co = pad_rows(c_ok).reshape(n_row_chunks, rows_chunk, n_tiles, tile)
 
@@ -294,9 +307,10 @@ def _streamed_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
             for ax in range(3):
                 diff = q_c[:, :, None, ax] - c[:, None, :, ax]
                 d2 = d2 + diff * diff
-            mask = qo_c[:, :, None] & co_t[:, None, :]
-            if exclude_self:
-                mask = mask & (ci_t[:, None, :] != qi_c[:, :, None])
+            # exclusion by stored index; -2 sentinel never matches, so the
+            # same arithmetic serves self-queries and external queries
+            mask = (qo_c[:, :, None] & co_t[:, None, :]
+                    & (ci_t[:, None, :] != qi_c[:, :, None]))
             ids = jnp.broadcast_to(ci_t[:, None, :], d2.shape)
             return merge_topk(best_d, best_i, d2, ids, mask), None
 
@@ -307,9 +321,20 @@ def _streamed_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
         return None, (best_d, best_i)
 
     _, (out_d, out_i) = jax.lax.scan(row_step, None, (qs, qi, qo, ci, co))
-    out_d = out_d.reshape(rows_pad * qcap, k)[: cp.n_sc * qcap]
-    out_i = out_i.reshape(rows_pad * qcap, k)[: cp.n_sc * qcap]
+    out_d = out_d.reshape(rows_pad * qcap, k)[: n_sc * qcap]
+    out_i = out_i.reshape(rows_pad * qcap, k)[: n_sc * qcap]
     return out_d, out_i
+
+
+def _streamed_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                    cp: ClassPlan, k: int, exclude_self: bool, tile: int):
+    """Self-solve wrapper over _streamed_topk: queries are the class's own
+    stored points.  Returns (Sc * qcap_pad, k) flat dists/ids, ascending."""
+    q_idx, q_ok = pack_cells(cp.own, starts, counts, cp.qcap_pad)
+    q = jnp.take(points, q_idx, axis=0)                      # (Sc, qcap, 3)
+    q_excl = q_idx if exclude_self else jnp.full_like(q_idx, -2)
+    return _streamed_topk(points, starts, counts, cp.cand, q, q_ok, q_excl,
+                          k, cp.ccap, tile)
 
 
 def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
@@ -369,3 +394,133 @@ def solve_adaptive(grid: GridHash, cfg: KnnConfig,
         grid.points, grid.cell_starts, grid.cell_counts, plan, cfg.k,
         cfg.exclude_self, grid.domain, cfg.interpret, cfg.stream_tile)
     return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert)
+
+
+# -- external queries through the class schedule ------------------------------
+
+@functools.partial(jax.jit, static_argnames=("q2cap", "k", "use_pallas",
+                                             "domain", "interpret", "tile"))
+def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                 cp: ClassPlan, qsorted: jax.Array, rstarts: jax.Array,
+                 rcounts: jax.Array, inv: jax.Array, rows_sel: jax.Array,
+                 q2cap: int, k: int, use_pallas: bool, domain: float,
+                 interpret: bool, tile: int):
+    """One class's external-query launch: build the per-supercell query block
+    from the row-bucketed queries, run the class solver (kernel or streamed),
+    gather each query's row back, and certify against the class's dilated
+    boxes.  Returns ((m_c, k) ids into sorted storage, (m_c, k) d2 ascending,
+    (m_c,) certified)."""
+    slots = jnp.arange(q2cap, dtype=jnp.int32)
+    qs_idx = rstarts[:, None] + slots[None, :]               # (Sc, q2cap)
+    qs_ok = slots[None, :] < rcounts[:, None]
+    q = jnp.take(qsorted, jnp.where(qs_ok, qs_idx, 0), axis=0)
+    if use_pallas:
+        from .pallas_solve import _PAD_C, _PAD_Q, _pallas_topk
+
+        c_idx, c_ok = pack_cells(cp.cand, starts, counts, cp.ccap)
+        axes = points.T
+        cx, cy, cz = (jnp.take(axes[ax], c_idx, axis=0)
+                      .reshape(cp.n_sc, 1, cp.ccap) for ax in range(3))
+        cid3 = jnp.where(c_ok, c_idx, _PAD_C).astype(jnp.int32).reshape(
+            cp.n_sc, 1, cp.ccap)
+        qid3 = jnp.full((cp.n_sc, 1, q2cap), _PAD_Q, jnp.int32)
+        out_d, out_i = _pallas_topk(q, cx, cy, cz, qid3, cid3, q2cap, cp.ccap,
+                                    k, False, interpret)
+        flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
+        flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)
+    else:
+        q_excl = jnp.full((cp.n_sc, q2cap), -2, jnp.int32)   # exclude nothing
+        flat_d, flat_i = _streamed_topk(points, starts, counts, cp.cand,
+                                        q, qs_ok, q_excl, k, cp.ccap, tile)
+    row_d = jnp.take(flat_d, inv, axis=0)                    # (m_c, k)
+    row_i = jnp.take(flat_i, inv, axis=0)
+    ok = jnp.isfinite(row_d)
+    row_i = jnp.where(ok, row_i, INVALID_ID)
+    row_d = jnp.where(ok, row_d, jnp.inf)
+    lo = jnp.take(cp.lo, rows_sel, axis=0)                   # (m_c, 3)
+    hi = jnp.take(cp.hi, rows_sel, axis=0)
+    cert = row_d[:, k - 1] <= _margin_sq(qsorted[:, None, :], lo, hi,
+                                         domain)[:, 0]
+    return row_i, row_d, cert
+
+
+def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
+                   queries: np.ndarray, k: int,
+                   fallback: str = "brute") -> Tuple[np.ndarray, np.ndarray]:
+    """Arbitrary-coordinate kNN through the adaptive class schedule -- the
+    external-query twin of solve_adaptive, reusing the one plan prepare()
+    built (no legacy SolvePlan or PallasPack is ever materialized).
+
+    Queries bucket by supercell, inherit their supercell's class (radius,
+    candidate box, route), and un-pad by a per-class gather.  Queries landing
+    in supercells no class owns (empty regions) and uncertified rows are
+    resolved exactly by the tiled brute-force pass.  Returns ((m, k) ids in
+    ORIGINAL indexing, ascending; (m, k) squared distances), query order.
+    """
+    from .gridhash import cell_coords
+    from .pallas_solve import pallas_fits
+    from .query import brute_force_by_coords
+
+    queries = np.ascontiguousarray(queries, np.float32)
+    m = queries.shape[0]
+    if m == 0:
+        return (np.empty((0, k), np.int32), np.empty((0, k), np.float32))
+    coords = np.asarray(jax.device_get(cell_coords(
+        jnp.asarray(queries), grid.dim, grid.domain)))
+    s = cfg.supercell
+    n_sc = -(-grid.dim // s)
+    scc = coords // s
+    sid = (scc[:, 0].astype(np.int64) + n_sc * (scc[:, 1].astype(np.int64)
+           + n_sc * scc[:, 2].astype(np.int64)))
+    cls_of = np.asarray(jax.device_get(plan.class_of_sc))
+    row_of = np.asarray(jax.device_get(plan.row_of_sc))
+    qcls, qrow = cls_of[sid], row_of[sid]
+
+    out_i = np.full((m, k), INVALID_ID, np.int32)
+    out_d = np.full((m, k), np.inf, np.float32)
+    cert = np.zeros((m,), bool)
+
+    for ci, cp in enumerate(plan.classes):
+        sel = np.nonzero(qcls == ci)[0]
+        if sel.size == 0:
+            continue
+        order = np.argsort(qrow[sel], kind="stable")
+        sel_sorted = sel[order].astype(np.int64)
+        rows_sorted = qrow[sel_sorted]
+        rcounts = np.bincount(rows_sorted, minlength=cp.n_sc).astype(np.int32)
+        rstarts = np.concatenate([[0], np.cumsum(rcounts)[:-1]]).astype(np.int32)
+        rank = np.arange(sel.size, dtype=np.int64) - rstarts[rows_sorted]
+        max_q = int(rcounts.max())
+        # kernel lanes need 128-multiples; streamed takes any pow2 (bounds
+        # recompiles across query sets)
+        q2cap_pal = -(-max_q // 128) * 128
+        use_pallas = (cp.use_pallas and pallas_fits(q2cap_pal, cp.ccap, k))
+        q2cap = (q2cap_pal if use_pallas
+                 else 1 << max(3, (max_q - 1).bit_length()))
+        inv = (rows_sorted * q2cap + rank).astype(np.int32)
+        r_i, r_d, r_c = _query_class(
+            grid.points, grid.cell_starts, grid.cell_counts, cp,
+            jnp.asarray(queries[sel_sorted]), jnp.asarray(rstarts),
+            jnp.asarray(rcounts), jnp.asarray(inv),
+            jnp.asarray(rows_sorted.astype(np.int32)), q2cap, k,
+            use_pallas, grid.domain, cfg.interpret, cfg.stream_tile)
+        out_i[sel_sorted] = np.asarray(jax.device_get(r_i))
+        out_d[sel_sorted] = np.asarray(jax.device_get(r_d))
+        cert[sel_sorted] = np.asarray(jax.device_get(r_c))
+
+    # Exact resolve: classless queries (empty supercells) have no grid route,
+    # so they are always brute-forced; uncertified class rows go through the
+    # same pass when the fallback is enabled.
+    need = (qcls < 0) if fallback != "brute" else ~cert
+    if need.any():
+        bad = np.nonzero(need)[0].astype(np.int32)
+        b_i, b_d = brute_force_by_coords(grid.points, jnp.asarray(queries[bad]),
+                                         k)
+        out_i[bad] = np.asarray(jax.device_get(b_i))
+        out_d[bad] = np.asarray(jax.device_get(b_d))
+
+    perm = np.asarray(jax.device_get(grid.permutation))
+    valid = out_i >= 0
+    ids_orig = np.where(valid, perm[np.clip(out_i, 0, grid.n_points - 1)],
+                        INVALID_ID)
+    return ids_orig, out_d

@@ -105,10 +105,22 @@ def build_grid(points: jax.Array, dim: int | None = None,
                density: float = DEFAULT_CELL_DENSITY,
                domain: float = DOMAIN_SIZE) -> GridHash:
     """Build the spatial hash (reference analog: kn_firstbuild via kn_prepare,
-    /root/reference/knearests.cu:152-201,235-344)."""
+    /root/reference/knearests.cu:152-201,235-344).
+
+    Host input goes through the checked staging helper (utils/memory.to_device,
+    the gpuMallocNCopy analog): a failed H2D placement surfaces shape/dtype and
+    the cause instead of a bare runtime error.  Device-resident input is used
+    as-is.
+    """
     if dim is None:
         dim = grid_dim_for(points.shape[0], density)
-    return _build(jnp.asarray(points, jnp.float32), dim=int(dim), domain=float(domain))
+    if isinstance(points, jax.Array):
+        staged = jnp.asarray(points, jnp.float32)
+    else:
+        from ..utils.memory import to_device
+
+        staged = to_device(points, validate=False)  # validate_points upstream
+    return _build(staged, dim=int(dim), domain=float(domain))
 
 
 def unpermute_neighbors(grid: GridHash, neighbors_sorted: jax.Array,

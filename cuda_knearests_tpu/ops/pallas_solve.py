@@ -160,12 +160,10 @@ def pallas_fits(qcap: int, ccap: int, k: int) -> bool:
 
 
 def _pallas_topk(q, cx, cy, cz, qid3, cid3, qcap: int, ccap: int, k: int,
-                 exclude_self: bool, interpret: bool, vma=None):
+                 exclude_self: bool, interpret: bool):
     """Launch the kernel over a flat supercell grid.  Returns ((S,k,Q) dists,
-    (S,k,Q) ids) -- raw, untransposed.  ``vma`` marks outputs as varying over
-    mesh axes when called inside a shard_map (e.g. frozenset({'z'}))."""
+    (S,k,Q) ids) -- raw, untransposed."""
     s_total = q.shape[0]
-    out_kw = {} if vma is None else {"vma": frozenset(vma)}
     return pl.pallas_call(
         functools.partial(_kernel, k=k, exclude_self=exclude_self),
         grid=(s_total,),
@@ -190,8 +188,8 @@ def _pallas_topk(q, cx, cy, cz, qid3, cid3, qcap: int, ccap: int, k: int,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((s_total, k, qcap), jnp.float32, **out_kw),
-            jax.ShapeDtypeStruct((s_total, k, qcap), jnp.int32, **out_kw),
+            jax.ShapeDtypeStruct((s_total, k, qcap), jnp.float32),
+            jax.ShapeDtypeStruct((s_total, k, qcap), jnp.int32),
         ],
         interpret=interpret,
     )(q, cx, cy, cz, qid3, cid3)
@@ -201,7 +199,7 @@ def _pack_inputs(points: jax.Array, starts: jax.Array, counts: jax.Array,
                  own: jax.Array, cand: jax.Array, qcap: int, ccap: int):
     """Shared pack-and-gather block: CSR slot packing + coordinate/id blocks
     in kernel layout.  Single source of truth for the packing contract, used
-    by build_pack (cached single-chip) and packed_best (in-shard_map).
+    by build_pack (cached single-chip) and the adaptive class solvers.
 
     Returns (q_idx, q_ok, q, cx, cy, cz, qid3, cid3) with qcap rounded to the
     output lane multiple (128)."""
@@ -224,29 +222,6 @@ def _pack_inputs(points: jax.Array, starts: jax.Array, counts: jax.Array,
     cid3 = jnp.where(c_ok, c_idx, _PAD_C).astype(jnp.int32).reshape(
         s_total, 1, ccap)
     return q_idx, q_ok, q, cx, cy, cz, qid3, cid3
-
-
-def packed_best(points: jax.Array, starts: jax.Array, counts: jax.Array,
-                own: jax.Array, cand: jax.Array, lo: jax.Array, hi: jax.Array,
-                qcap: int, ccap: int, k: int, exclude_self: bool, domain: float,
-                interpret: bool = False, vma=None):
-    """Pallas twin of solve.chunk_best over a flat (S, ...) supercell schedule:
-    pack, gather, kernel, certify.  Works on any (points, CSR) triplet --
-    including the halo-extended local arrays inside the sharded shard_map
-    (parallel/sharded.py).  Returns (q_idx, q_ok, (S,Q,k) dists ascending,
-    (S,Q,k) ids into `points`, (S,Q) certificates)."""
-    q_idx, q_ok, q, cx, cy, cz, qid3, cid3 = _pack_inputs(
-        points, starts, counts, own, cand, qcap, ccap)
-    qcap = q.shape[1]
-    out_d, out_i = _pallas_topk(q, cx, cy, cz, qid3, cid3, qcap, ccap, k,
-                                exclude_self, interpret, vma)
-    best_d = out_d.transpose(0, 2, 1)
-    best_i = out_i.transpose(0, 2, 1)
-    ok = jnp.isfinite(best_d)
-    best_i = jnp.where(ok, best_i, INVALID_ID)
-    best_d = jnp.where(ok, best_d, jnp.inf)
-    cert = q_ok & (best_d[..., k - 1] <= _margin_sq(q, lo, hi, domain))
-    return q_idx, q_ok, best_d, best_i, cert
 
 
 @jax.jit

@@ -104,10 +104,11 @@ def dilated_box(sc_coord: Tuple[int, int, int], supercell: int, radius: int,
 
 
 def summed_area_table(counts3: np.ndarray) -> np.ndarray:
-    """(dim+1)^3 i64 inclusive 3D prefix sums of per-cell counts -- build once,
-    query many boxes via box_sums(..., sat=...)."""
-    dim = counts3.shape[0]
-    sat = np.zeros((dim + 1,) * 3, dtype=np.int64)
+    """(dz+1, dy+1, dx+1) i64 inclusive 3D prefix sums of per-cell counts --
+    build once, query many boxes via box_sums(..., sat=...).  Accepts
+    non-cubic windows (the sharded per-chip planner's z-slab case)."""
+    dz, dy, dx = counts3.shape
+    sat = np.zeros((dz + 1, dy + 1, dx + 1), dtype=np.int64)
     sat[1:, 1:, 1:] = counts3.cumsum(0).cumsum(1).cumsum(2)
     return sat
 
@@ -116,16 +117,18 @@ def box_sums(counts3: np.ndarray, lo: np.ndarray, hi: np.ndarray,
              sat: np.ndarray | None = None) -> np.ndarray:
     """Sum of per-cell counts over boxes [lo, hi) via a 3D summed-area table.
 
-    counts3 is (dim,dim,dim) indexed [z,y,x]; lo/hi are (m,3) as (x,y,z).
-    Pass a precomputed ``sat`` (summed_area_table) when querying many box sets
-    against the same grid.  The host-side occupancy primitive behind both the
-    capacity planners (ops/solve.py, ops/adaptive.py) and ring_occupancy.
+    counts3 is (dz,dy,dx) indexed [z,y,x] (cubic or a z-slab window); lo/hi
+    are (m,3) as (x,y,z).  Pass a precomputed ``sat`` (summed_area_table) when
+    querying many box sets against the same grid.  The host-side occupancy
+    primitive behind both the capacity planners (ops/solve.py,
+    ops/adaptive.py) and ring_occupancy.
     """
-    dim = counts3.shape[0]
+    dz, dy, dx = counts3.shape
     if sat is None:
         sat = summed_area_table(counts3)
-    lo = np.clip(lo, 0, dim)
-    hi = np.clip(hi, 0, dim)
+    dims = np.array([dx, dy, dz])
+    lo = np.clip(lo, 0, dims)
+    hi = np.clip(hi, 0, dims)
     x0, y0, z0 = lo[:, 0], lo[:, 1], lo[:, 2]
     x1, y1, z1 = hi[:, 0], hi[:, 1], hi[:, 2]
     s = (sat[z1, y1, x1] - sat[z0, y1, x1] - sat[z1, y0, x1] - sat[z1, y1, x0]

@@ -1,77 +1,53 @@
-"""Multi-chip kNN: grid-slab sharding over a device mesh with ICI halo exchange.
+"""Multi-chip kNN: per-chip grid slabs over a device mesh with ICI halo exchange.
 
 The reference is strictly single-GPU -- its only "communication" is cudaMemcpy
-H2D/D2H (SURVEY.md section 2.3).  This module is the framework's new scaling
-capability, per the BASELINE.json north star: for point sets beyond single-chip
-HBM, shard the uniform grid into contiguous z-slabs across a 1-D
-``jax.sharding.Mesh``; each chip owns its slab's points and CSR, and queries
-near slab faces need candidates from the neighboring chips' boundary cells --
-exchanged as fixed-size halo buffers with ``lax.ppermute`` over ICI inside a
-``jax.shard_map``.  DCN is crossed only at multi-host slab seams, by the same
-collective.
+H2D/D2H (SURVEY.md section 2.3).  This module is the framework's scaling
+capability, per the BASELINE.json north star: point sets beyond single-chip
+HBM, sharded as contiguous z-slabs across a 1-D ``jax.sharding.Mesh``.
 
-Decomposition invariants:
-  * The global grid is built once (ops/gridhash.py); its x-fastest/z-slowest
-    cell order makes every z-slab a *contiguous* range of the sorted point
-    array, so slabbing is slicing, not reshuffling.
-  * Slab boundaries are supercell-aligned (z cell extent per chip = Zcap =
-    layers * supercell), so every chip reuses the single-chip supercell
-    schedule unchanged -- the candidate boxes of a chip's supercells always fit
-    inside [slab - halo, slab + halo].
-  * Halo depth equals the ring radius R, so boundary queries get exactly the
-    candidate set the single-chip solver would gather; certificates remain
-    valid verbatim.  Queries whose k-th distance exceeds their margin (rare)
-    are resolved exactly on the host against the global array.
+Pipeline (three phases, no global device-resident array at any point):
 
-All shapes are static and identical across chips (capacities are global
-maxima), which is what lets one ``shard_map`` program serve every chip.
+  1. **Host partition** (numpy): each point's z-cell decides its chip; points
+     bucket per chip, padded to the max slab population.  The host never sorts
+     globally and never round-trips device arrays -- its working set is the
+     input plus O(n/ndev)-sized per-chip buckets.
+  2. **Device build + halo exchange** (one ``shard_map`` program): every chip
+     sorts its own slab by local cell id (deterministic stable sort -- the
+     per-chip counting-sort analog of ops/gridhash.py), builds its local CSR,
+     and exchanges fixed-size boundary blocks (points + original ids + counts)
+     with its z-neighbors via ``lax.ppermute`` over ICI.
+  3. **Per-chip adaptive solve**: each chip plans its own capacity classes
+     from its *local* ring occupancy (ops/adaptive machinery over the chip's
+     halo-extended window) and solves with per-class kernels -- chip schedules
+     are static per chip index, so a dense blob on one chip never inflates
+     another chip's tiles (the multi-chip completion of the reference's
+     per-query adaptivity, /root/reference/knearests.cu:116).
+
+Correctness: halo depth equals the per-chip planner's maximum dilation radius,
+so every candidate box fits the local window and the single-chip completeness
+certificates hold verbatim; uncertified stragglers resolve exactly against the
+host-side kd-tree oracle (the only place the full point set is touched, and
+only on the host).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..config import KnnConfig
-from ..ops.gridhash import GridHash, build_grid
-from ..ops.solve import (_FAR, _round_up, brute_force_by_index, chunk_best,
-                         global_schedule)
+from ..config import DOMAIN_SIZE, KnnConfig, default_ring_radius
+from ..ops.adaptive import (ClassPlan, _pallas_class, _streamed_topk,
+                            build_class_specs, select_radii)
+from ..ops.gridhash import cell_coords
+from ..ops.rings import box_sums, summed_area_table
+from ..ops.solve import _FAR, _margin_sq, _round_up, pack_cells
 from ..ops.topk import INVALID_ID
-
-
-@dataclasses.dataclass(frozen=True)
-class ShardedPlan:
-    """Host-built static schedule + device-stacked inputs (leading axis = chip)."""
-
-    # per-chip point slabs and CSR (stacked on axis 0, sharded over the mesh)
-    local_pts: np.ndarray     # (ndev, Pcap, 3) f32, FAR-padded
-    local_counts: np.ndarray  # (ndev, Zcap*A) i32
-    local_base: np.ndarray    # (ndev, 1) i32 global sorted index of slab start
-    n_local: np.ndarray       # (ndev, 1) i32
-    # halo send buffers (bottom goes to chip-1, top goes to chip+1)
-    bot_pts: np.ndarray       # (ndev, Hcap, 3) f32
-    bot_counts: np.ndarray    # (ndev, R*A) i32
-    bot_base: np.ndarray      # (ndev, 1) i32
-    top_pts: np.ndarray       # (ndev, Hcap, 3) f32
-    top_counts: np.ndarray    # (ndev, R*A) i32
-    top_base: np.ndarray      # (ndev, 1) i32
-    # supercell schedule in halo-extended local cell coordinates
-    own_cells: np.ndarray     # (ndev, nchunks, B, s^3) i32, -1 padded
-    cand_cells: np.ndarray    # (ndev, nchunks, B, (s+2R)^3) i32
-    box_lo: np.ndarray        # (ndev, nchunks, B, 3) f32
-    box_hi: np.ndarray        # (ndev, nchunks, B, 3) f32
-    # static meta
-    ndev: int
-    qcap: int
-    ccap: int
-    pcap: int
-    hcap: int
 
 
 def _slab_bounds(dim: int, supercell: int, ndev: int) -> Tuple[np.ndarray, np.ndarray, int]:
@@ -85,220 +61,353 @@ def _slab_bounds(dim: int, supercell: int, ndev: int) -> Tuple[np.ndarray, np.nd
     return zc0, zc1, zcap
 
 
-def build_sharded_plan(grid: GridHash, cfg: KnnConfig, ndev: int,
-                       cell_counts_host: Optional[np.ndarray] = None) -> ShardedPlan:
-    dim, s = grid.dim, cfg.supercell
-    radius = cfg.resolved_ring_radius()
-    domain = grid.domain
-    w = domain / dim
-    A = dim * dim
-    n = grid.n_points
+@dataclasses.dataclass(frozen=True)
+class ShardMeta:
+    """Host-side static decomposition metadata."""
 
-    zc0, zc1, zcap = _slab_bounds(dim, s, ndev)
-    if zcap < radius:
-        raise ValueError(
-            f"slab thickness {zcap} cells < halo depth {radius}: halo would "
-            f"span multiple chips. Use fewer devices, a larger supercell, or a "
-            f"smaller ring radius (dim={dim}, ndev={ndev}).")
+    ndev: int
+    dim: int
+    zcap: int
+    radius: int     # halo depth == max per-chip dilation radius
+    pcap: int       # per-chip point capacity (max slab population, padded)
+    hcap: int       # halo block capacity (max boundary-layer population)
+    domain: float
 
-    counts = (np.asarray(cell_counts_host) if cell_counts_host is not None
-              else np.asarray(jax.device_get(grid.cell_counts)))
-    starts = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
 
-    def pts_at(zcell: int) -> int:
-        """Global sorted index of the first point at z-layer `zcell` (clamped)."""
-        c = int(np.clip(zcell, 0, dim)) * A
-        return int(starts[c])
+def _measured_halo_depth(points: np.ndarray, dim: int, zcap: int,
+                         cfg: KnnConfig) -> int:
+    """The largest dilation radius any nonempty supercell will select, from
+    global cell occupancy (O(cells) host work, no device involvement).
 
-    # ---- global supercell schedule (shared with the single-chip planner) ----
-    own_g, cand_g, box_lo_g, box_hi_g, qcap, ccap = global_schedule(
-        grid, cfg, counts)
+    Per-chip planners later re-derive radii from the identical occupancy
+    boxes (window slices of the same counts), so every per-supercell choice
+    is <= this depth by construction and candidate boxes always fit the
+    halo-extended window.  Capped at the slab thickness: supercells whose
+    sparse neighborhood wants more stay uncertified and resolve through the
+    exact host fallback."""
+    from ..ops.rings import ring_occupancy
+    from ..ops.solve import _boxes_grid
+
+    s = cfg.supercell
+    rmax = min(zcap, int(min(dim, max(6, 2 * default_ring_radius(
+        cfg.k, cfg.density)))))
+    coords = np.clip((points * (dim / DOMAIN_SIZE)).astype(np.int64),
+                     0, dim - 1)
+    lin = coords[:, 0] + dim * coords[:, 1] + dim * dim * coords[:, 2]
+    counts3 = np.bincount(lin, minlength=dim ** 3).reshape(dim, dim, dim)
     n_sc = -(-dim // s)
-
-    # ---- per-chip slicing ----------------------------------------------------
-    nxy = n_sc * n_sc                       # supercells per z-layer of supercells
-    layers = zcap // s
-    sc_per_dev = layers * nxy
-    batch = max(1, int(cfg.sc_batch))
-    nchunks = -(-sc_per_dev // batch)
-    sc_pad = nchunks * batch
-
-    p0 = np.array([pts_at(z) for z in zc0])
-    p1 = np.array([pts_at(z) for z in zc1])
-    pcap = _round_up(int((p1 - p0).max()) if ndev else 1, 8)
-
-    # halo regions: bottom R layers [zc0, zc0+R), top R layers [zc0+zcap-R, zc0+zcap)
-    b0, b1 = p0, np.array([pts_at(z) for z in zc0 + radius])
-    t0 = np.array([pts_at(z) for z in zc0 + zcap - radius])
-    t1 = np.array([pts_at(z) for z in zc0 + zcap])
-    hcap = _round_up(int(max((b1 - b0).max(), (t1 - t0).max())) if ndev else 1, 8)
-
-    pts_sorted = np.asarray(jax.device_get(grid.points))
-
-    def pad_pts(lo: int, hi: int, cap: int) -> np.ndarray:
-        out = np.full((cap, 3), _FAR, np.float32)
-        out[: hi - lo] = pts_sorted[lo:hi]
-        return out
-
-    def counts_slice(z_from: int, z_to: int) -> np.ndarray:
-        """Per-cell counts for z-layers [z_from, z_to), zero-padded beyond grid."""
-        out = np.zeros(((z_to - z_from) * A,), np.int32)
-        lo, hi = np.clip([z_from, z_to], 0, dim)
-        if hi > lo:
-            out[(lo - z_from) * A:(hi - z_from) * A] = counts[lo * A:hi * A]
-        return out
-
-    local_pts = np.stack([pad_pts(p0[d], p1[d], pcap) for d in range(ndev)])
-    local_counts = np.stack([counts_slice(zc0[d], zc0[d] + zcap)
-                             for d in range(ndev)])
-    bot_pts = np.stack([pad_pts(b0[d], b1[d], hcap) for d in range(ndev)])
-    bot_counts = np.stack([counts_slice(zc0[d], zc0[d] + radius)
-                           for d in range(ndev)])
-    top_pts = np.stack([pad_pts(t0[d], t1[d], hcap) for d in range(ndev)])
-    top_counts = np.stack([counts_slice(zc0[d] + zcap - radius, zc0[d] + zcap)
-                           for d in range(ndev)])
-
-    def per_dev_plan(d: int):
-        r0, r1 = d * sc_per_dev, min((d + 1) * sc_per_dev, own_g.shape[0])
-        rows = slice(r0, r1)
-        nrows = r1 - r0 if r1 > r0 else 0
-
-        def pad_rows(a: np.ndarray, fill) -> np.ndarray:
-            out = np.full((sc_pad,) + a.shape[1:], fill, a.dtype)
-            if nrows > 0:
-                out[:nrows] = a[rows]
-            return out
-
-        # global linear cell id -> halo-extended local id: subtract the window
-        # origin (zc0 - R) * A; -1 mask passes through
-        shift = A * (radius - int(zc0[d]))
-        own = pad_rows(own_g, -1)
-        own = np.where(own >= 0, own + shift, -1).astype(np.int32)
-        cand = pad_rows(cand_g, -1)
-        cand = np.where(cand >= 0, cand + shift, -1).astype(np.int32)
-        lo = pad_rows(box_lo_g, 0.0)
-        hi = pad_rows(box_hi_g, 0.0)
-        rs = lambda a: a.reshape(nchunks, batch, *a.shape[1:])
-        return rs(own), rs(cand), rs(lo), rs(hi)
-
-    per_dev = [per_dev_plan(d) for d in range(ndev)]
-    own_cells = np.stack([p[0] for p in per_dev])
-    cand_cells = np.stack([p[1] for p in per_dev])
-    box_lo = np.stack([p[2] for p in per_dev])
-    box_hi = np.stack([p[3] for p in per_dev])
-
-    as_col = lambda a: a.astype(np.int32).reshape(ndev, 1)
-    return ShardedPlan(
-        local_pts=local_pts, local_counts=local_counts,
-        local_base=as_col(p0), n_local=as_col(p1 - p0),
-        bot_pts=bot_pts, bot_counts=bot_counts, bot_base=as_col(b0),
-        top_pts=top_pts, top_counts=top_counts, top_base=as_col(t0),
-        own_cells=own_cells, cand_cells=cand_cells,
-        box_lo=box_lo.astype(np.float32), box_hi=box_hi.astype(np.float32),
-        ndev=ndev, qcap=int(qcap), ccap=int(ccap), pcap=int(pcap),
-        hcap=int(hcap))
+    sc = _boxes_grid(n_sc)
+    pts_cum, cells_cum = ring_occupancy(counts3, sc, s, rmax)
+    radii = select_radii(pts_cum, cells_cum, cfg.k, rmax)
+    nonempty = pts_cum[:, 0] > 0
+    return max(1, int(radii[nonempty].max()) if nonempty.any() else rmax)
 
 
-def _use_pallas(cfg: KnnConfig, qcap: int, ccap: int) -> bool:
-    from ..ops.solve import pick_backend
+def _partition_host(points: np.ndarray, dim: int, zcap: int, radius: int,
+                    ndev: int, domain: float):
+    """Bucket points by owning chip (z-cell // zcap).  Pure numpy; the only
+    O(n) host work in prepare.  Returns (bucket_pts (ndev, pcap, 3) FAR-pad,
+    bucket_ids (ndev, pcap) i32 original index -1-pad, n_local (ndev,),
+    pcap, hcap)."""
+    n = points.shape[0]
+    cz = np.clip((points[:, 2] * (dim / domain)).astype(np.int64), 0, dim - 1)
+    chip = np.minimum(cz // zcap, ndev - 1).astype(np.int64)
+    order = np.argsort(chip, kind="stable")
+    chip_sorted = chip[order]
+    counts = np.bincount(chip_sorted, minlength=ndev).astype(np.int64)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pcap = _round_up(int(counts.max()) if n else 1, 8)
 
-    return pick_backend(cfg, qcap, ccap) == "pallas"
+    bucket_pts = np.full((ndev, pcap, 3), _FAR, np.float32)
+    bucket_ids = np.full((ndev, pcap), -1, np.int32)
+    for d in range(ndev):
+        rows = order[starts[d]: starts[d] + counts[d]]
+        bucket_pts[d, : counts[d]] = points[rows]
+        bucket_ids[d, : counts[d]] = rows.astype(np.int32)
+
+    # halo capacity: max points in any chip's R bottom / top z-cell layers
+    hmax = 1
+    for d in range(ndev):
+        zc0 = d * zcap
+        local_cz = cz[chip == d]
+        hmax = max(hmax, int((local_cz < zc0 + radius).sum()),
+                   int((local_cz >= zc0 + zcap - radius).sum()))
+    hcap = _round_up(hmax, 8)
+    return bucket_pts, bucket_ids, counts.astype(np.int32), pcap, hcap
 
 
-def _make_device_solve(plan: ShardedPlan, cfg: KnnConfig, domain: float,
-                       use_pallas: bool):
-    """The per-chip program run under shard_map: halo exchange + local solve
-    (fused Pallas kernel on TPU, chunked XLA scan otherwise)."""
-    ndev, k = plan.ndev, cfg.k
-    hcap, pcap = plan.hcap, plan.pcap
+@functools.lru_cache(maxsize=32)
+def _build_program(meta: ShardMeta, mesh: Mesh):
+    """Jitted shard_map build program, cached by the (hashable) decomposition
+    metadata + mesh so repeated prepares with the same shapes reuse one
+    compile."""
+    spec = P("z")
+    return jax.jit(jax.shard_map(
+        _make_build_fn(meta), mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=(spec,) * 9))
+
+
+def _make_build_fn(meta: ShardMeta):
+    """The shard_map phase-2 program: per-chip sort/CSR + halo ppermute."""
+    ndev, dim, zcap, R = meta.ndev, meta.dim, meta.zcap, meta.radius
+    A = dim * dim
+    ncell = zcap * A
+    hcap = meta.hcap
     fwd = [(i, i + 1) for i in range(ndev - 1)]   # chip d -> d+1
     bwd = [(i + 1, i) for i in range(ndev - 1)]   # chip d -> d-1
 
-    def device_fn(local_pts, local_counts, local_base, bot_pts, bot_counts,
-                  bot_base, top_pts, top_counts, top_base, own, cand, blo, bhi):
-        # shard_map blocks carry the leading mesh axis of size 1
-        sq = lambda a: a[0]
-        local_pts, local_counts = sq(local_pts), sq(local_counts)
-        local_base = sq(local_base)[0]
-        own, cand, blo, bhi = sq(own), sq(cand), sq(blo), sq(bhi)
+    def build_fn(bucket_pts, bucket_ids, n_local):
+        pts, ids = bucket_pts[0], bucket_ids[0]
+        nloc = n_local[0, 0]
+        d = jax.lax.axis_index("z")
+        zc0 = d * zcap
+        pcap = pts.shape[0]
+        slots = jnp.arange(pcap, dtype=jnp.int32)
+        valid = slots < nloc
+        cc = cell_coords(pts, dim, meta.domain)
+        lid = cc[:, 0] + dim * cc[:, 1] + A * (cc[:, 2] - zc0)
+        lid = jnp.where(valid, lid, ncell)        # pad rows sort last
+        order = jnp.argsort(lid, stable=True).astype(jnp.int32)
+        spts = jnp.take(pts, order, axis=0)
+        sids = jnp.take(ids, order, axis=0)
+        slid = jnp.take(lid, order)
+        counts = jnp.zeros((ncell,), jnp.int32).at[slid].add(1, mode="drop")
+
+        # boundary blocks: the sorted array is cell-ascending, so the bottom
+        # R layers are a prefix and the top R layers are the suffix of the
+        # valid region.  Suffix extraction pads by hcap first so
+        # dynamic_slice never clamp-shifts the block (which would silently
+        # misalign the receiver's CSR).
+        tcount = jnp.sum(counts[(zcap - R) * A:])
+        spts_ext = jnp.concatenate(
+            [spts, jnp.full((hcap, 3), _FAR, spts.dtype)], axis=0)
+        sids_ext = jnp.concatenate(
+            [sids, jnp.full((hcap,), -1, sids.dtype)], axis=0)
+        bot_pts, bot_ids = spts[:hcap], sids[:hcap]
+        tstart = jnp.maximum(nloc - tcount, 0)
+        top_pts = jax.lax.dynamic_slice_in_dim(spts_ext, tstart, hcap, 0)
+        top_ids = jax.lax.dynamic_slice_in_dim(sids_ext, tstart, hcap, 0)
+        bot_counts = counts[: R * A]
+        top_counts = counts[(zcap - R) * A:]
 
         if ndev > 1:
-            # halo exchange over ICI: my top region becomes my upper neighbor's
-            # lower halo and vice versa.  Edge chips receive zeros -- zero
-            # counts, so the empty halos are never gathered from.
-            lo_pts = jax.lax.ppermute(sq(top_pts), "z", fwd)
-            lo_counts = jax.lax.ppermute(sq(top_counts), "z", fwd)
-            lo_base = jax.lax.ppermute(sq(top_base), "z", fwd)[0]
-            hi_pts = jax.lax.ppermute(sq(bot_pts), "z", bwd)
-            hi_counts = jax.lax.ppermute(sq(bot_counts), "z", bwd)
-            hi_base = jax.lax.ppermute(sq(bot_base), "z", bwd)[0]
+            # halo exchange over ICI: my top block becomes my upper
+            # neighbor's lower halo and vice versa; edge chips receive zeros
+            # (zero counts -> the empty halo is never gathered from).
+            lo_pts = jax.lax.ppermute(top_pts, "z", fwd)
+            lo_ids = jax.lax.ppermute(top_ids, "z", fwd)
+            lo_counts = jax.lax.ppermute(top_counts, "z", fwd)
+            hi_pts = jax.lax.ppermute(bot_pts, "z", bwd)
+            hi_ids = jax.lax.ppermute(bot_ids, "z", bwd)
+            hi_counts = jax.lax.ppermute(bot_counts, "z", bwd)
         else:
-            lo_pts = jnp.full_like(sq(top_pts), _FAR)
-            lo_counts = jnp.zeros_like(sq(top_counts))
-            lo_base = jnp.int32(0)
-            hi_pts = jnp.full_like(sq(bot_pts), _FAR)
-            hi_counts = jnp.zeros_like(sq(bot_counts))
-            hi_base = jnp.int32(0)
+            lo_pts = jnp.full_like(top_pts, _FAR)
+            lo_ids = jnp.full_like(top_ids, -1)
+            lo_counts = jnp.zeros_like(top_counts)
+            hi_pts = jnp.full_like(bot_pts, _FAR)
+            hi_ids = jnp.full_like(bot_ids, -1)
+            hi_counts = jnp.zeros_like(bot_counts)
 
-        # halo-extended point array + CSR over the z-window [zc0-R, zc0+Zcap+R)
-        ext_pts = jnp.concatenate([lo_pts, local_pts, hi_pts], axis=0)
-        mk_starts = lambda c: jnp.cumsum(c) - c
-        ext_starts = jnp.concatenate([
-            mk_starts(lo_counts),
-            mk_starts(local_counts) + hcap,
-            mk_starts(hi_counts) + hcap + pcap]).astype(jnp.int32)
-        ext_counts = jnp.concatenate([lo_counts, local_counts, hi_counts])
+        pack = (spts, sids, counts, lo_pts, lo_ids, lo_counts,
+                hi_pts, hi_ids, hi_counts)
+        return tuple(a[None] for a in pack)
 
-        # mark the carry as device-varying over the mesh axis (each chip
-        # accumulates its own slab's outputs); moot when the vma checker is
-        # off (pallas branch)
-        vary = ((lambda a: a) if use_pallas
-                else (lambda a: jax.lax.pcast(a, ("z",), to="varying")))
-        out_d = vary(jnp.full((pcap, k), jnp.inf, jnp.float32))
-        out_i = vary(jnp.full((pcap, k), INVALID_ID, jnp.int32))
-        out_cert = vary(jnp.zeros((pcap,), bool))
+    return build_fn
 
-        def to_global_and_scatter(carry, q_idx, q_valid, best_d, best_i, cert):
-            out_d, out_i, out_cert = carry
-            # extended index -> global sorted index
-            in_lo = best_i < hcap
-            in_loc = best_i < hcap + pcap
-            gl = jnp.where(in_lo, lo_base + best_i,
-                           jnp.where(in_loc, local_base + best_i - hcap,
-                                     hi_base + best_i - hcap - pcap))
-            gl = jnp.where(best_i == INVALID_ID, INVALID_ID, gl).astype(jnp.int32)
-            row = q_idx - hcap  # queries always live in the local section
-            safe = jnp.where(q_valid & (row >= 0) & (row < pcap), row, pcap)
-            out_d = out_d.at[safe].set(best_d, mode="drop")
-            out_i = out_i.at[safe].set(gl, mode="drop")
-            out_cert = out_cert.at[safe].set(cert, mode="drop")
-            return out_d, out_i, out_cert
 
-        if use_pallas:
-            from ..ops.pallas_solve import packed_best
+def _window_occupancy(win3: np.ndarray, sc: np.ndarray, s: int, R: int,
+                      dim: int, zc0: int, rmax: int):
+    """Per-supercell cumulative points/in-grid cells over the chip's
+    halo-extended window (the z-slab twin of rings.ring_occupancy).
 
-            flat = lambda a: a.reshape((-1,) + a.shape[2:])
-            q_idx, q_valid, best_d, best_i, cert = packed_best(
-                ext_pts, ext_starts, ext_counts, flat(own), flat(cand),
-                flat(blo), flat(bhi), plan.qcap, plan.ccap, k,
-                cfg.exclude_self, domain, cfg.interpret)
-            out_d, out_i, out_cert = to_global_and_scatter(
-                (out_d, out_i, out_cert), q_idx, q_valid, best_d, best_i, cert)
+    win3: (2R+zcap, dim, dim) [z,y,x] counts; sc: (m, 3) chip-local supercell
+    coords (z in layers of the local slab).  Boxes are expressed in window
+    cell coordinates (z offset +R); in-grid cell counts clip z against the
+    *global* grid through the window mapping zw -> zc0 - R + zw."""
+    zwin = win3.shape[0]
+    base_lo = sc * s + np.array([0, 0, R])
+    base_hi = base_lo + s
+    sat = summed_area_table(win3)
+    z_valid_lo = max(0, R - zc0)
+    z_valid_hi = min(zwin, dim + R - zc0)
+    pts = np.empty((sc.shape[0], rmax + 1), np.int64)
+    cells = np.empty((sc.shape[0], rmax + 1), np.int64)
+    for r in range(rmax + 1):
+        lo = base_lo - r
+        hi = base_hi + r
+        pts[:, r] = box_sums(win3, lo, hi, sat=sat)
+        cx = (np.clip(hi[:, 0], 0, dim) - np.clip(lo[:, 0], 0, dim))
+        cy = (np.clip(hi[:, 1], 0, dim) - np.clip(lo[:, 1], 0, dim))
+        cz = (np.clip(hi[:, 2], z_valid_lo, z_valid_hi)
+              - np.clip(lo[:, 2], z_valid_lo, z_valid_hi))
+        cells[:, r] = cx * cy * np.maximum(cz, 0)
+    return pts, cells
+
+
+def _window_box_cells(sc: np.ndarray, lo_off: int, hi_off: int, s: int,
+                      dim: int, R: int, zc0: int, zwin: int) -> np.ndarray:
+    """Linear window-cell ids of [sc*s+lo_off, sc*s+s+hi_off) per supercell,
+    -1 where outside the grid (x/y) or outside the global z range (z).
+    Window linearization: x + dim*y + dim^2*zw with zw = local z + R."""
+    side = s + hi_off - lo_off
+    offs = np.arange(lo_off, s + hi_off, dtype=np.int64)
+    ax = sc[:, :, None].astype(np.int64) * s + offs[None, None, :]
+    x, y, z = ax[:, 0], ax[:, 1], ax[:, 2] + R       # z into window coords
+    okx = (x >= 0) & (x < dim)
+    oky = (y >= 0) & (y < dim)
+    # window z must be inside the window AND map to a real global layer
+    gz = z + zc0 - R
+    okz = (z >= 0) & (z < zwin) & (gz >= 0) & (gz < dim)
+    xc = np.clip(x, 0, dim - 1)
+    yc = np.clip(y, 0, dim - 1)
+    zc = np.clip(z, 0, zwin - 1)
+    lin = (xc[:, None, None, :] + dim * yc[:, None, :, None]
+           + dim * dim * zc[:, :, None, None])
+    valid = (okx[:, None, None, :] & oky[:, None, :, None]
+             & okz[:, :, None, None])
+    return np.where(valid, lin, -1).reshape(sc.shape[0], side ** 3).astype(np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipPlan:
+    """One chip's static adaptive schedule (classes over its local window)."""
+
+    classes: Tuple[ClassPlan, ...]
+    n_queries: int      # valid local points on this chip
+
+
+def _plan_chip(counts_all: np.ndarray, d: int, meta: ShardMeta,
+               cfg: KnnConfig, on_kernel_platform: bool) -> ChipPlan:
+    """Adaptive class partition from chip d's local ring occupancy.
+
+    counts_all: (ndev, zcap*A) host copies of every chip's cell counts (the
+    only per-point-scale data the host reads back, at 4 bytes/cell)."""
+    dim, zcap, R, s = meta.dim, meta.zcap, meta.radius, cfg.supercell
+    A = dim * dim
+    mk3 = lambda c: c.reshape(zcap, dim, dim)
+    zeros = np.zeros((R, dim, dim), np.int64)
+    lo3 = (mk3(counts_all[d - 1])[-R:] if d > 0 else zeros)
+    hi3 = (mk3(counts_all[d + 1])[:R] if d + 1 < meta.ndev else zeros)
+    win3 = np.concatenate([lo3, mk3(counts_all[d]).astype(np.int64), hi3])
+
+    n_sc_xy = -(-dim // s)
+    layers = zcap // s
+    r = np.arange(n_sc_xy, dtype=np.int32)
+    lz = np.arange(layers, dtype=np.int32)
+    zz, yy, xx = np.meshgrid(lz, r, r, indexing="ij")
+    sc = np.stack([xx.ravel(), yy.ravel(), zz.ravel()], axis=1)
+
+    zc0 = d * meta.zcap
+    if cfg.ring_radius is not None:
+        rmax = min(R, max(1, int(cfg.ring_radius)))
+        pts_cum, _ = _window_occupancy(win3, sc, s, R, dim, zc0, rmax)
+        radii_all = np.full((sc.shape[0],), rmax, np.int32)
+    else:
+        rmax = R
+        pts_cum, cells_cum = _window_occupancy(win3, sc, s, R, dim, zc0, rmax)
+        radii_all = select_radii(pts_cum, cells_cum, cfg.k, rmax)
+
+    own_n = pts_cum[:, 0]
+    specs = build_class_specs(own_n, pts_cum, radii_all, cfg,
+                              on_kernel_platform)
+    w = meta.domain / dim
+    zwin = win3.shape[0]
+    classes = []
+    for spec in specs:
+        sc_c = sc[spec.rows]
+        own = _window_box_cells(sc_c, 0, 0, s, dim, R, zc0, zwin)
+        cand = _window_box_cells(sc_c, -spec.radius, spec.radius, s, dim, R,
+                                 zc0, zwin)
+        # certificate boxes in GLOBAL domain coordinates (z offset by zc0)
+        gsc = sc_c + np.array([0, 0, zc0 // s])
+        lo = ((gsc * s - spec.radius) * w).astype(np.float32)
+        hi = ((gsc * s + s + spec.radius) * w).astype(np.float32)
+        classes.append(ClassPlan(
+            own=jnp.asarray(own), cand=jnp.asarray(cand),
+            lo=jnp.asarray(lo), hi=jnp.asarray(hi),
+            radius=spec.radius, qcap=spec.qcap, qcap_pad=spec.qcap_pad,
+            ccap=spec.ccap, use_pallas=spec.use_pallas))
+    return ChipPlan(classes=tuple(classes),
+                    n_queries=int(win3[R: R + zcap].sum()))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
+                                             "interpret", "tile", "hcap"))
+def _chip_solve(spts, sids, counts, lo_pts, lo_ids, lo_counts,
+                hi_pts, hi_ids, hi_counts, classes: Tuple[ClassPlan, ...],
+                k: int, exclude_self: bool, domain: float, interpret: bool,
+                tile: int, hcap: int):
+    """One chip's local solve over its halo-extended window.
+
+    Assembles the extended point/CSR arrays (lower halo | local | upper
+    halo), runs every capacity class (fused kernel or streamed), inverts the
+    slot partition for the local rows, and translates neighbor indices to
+    ORIGINAL ids through the exchanged id blocks -- so the output needs no
+    global permutation state.  Returns ((pcap, k) original-id neighbors,
+    (pcap, k) d2 ascending, (pcap,) certified), rows in local sorted order.
+    """
+    pcap = spts.shape[0]
+    ext_pts = jnp.concatenate([lo_pts, spts, hi_pts], axis=0)
+    ext_ids = jnp.concatenate([lo_ids, sids, hi_ids], axis=0)
+    mk_starts = lambda c: jnp.cumsum(c) - c
+    ext_starts = jnp.concatenate([
+        mk_starts(lo_counts),
+        mk_starts(counts) + hcap,
+        mk_starts(hi_counts) + hcap + pcap]).astype(jnp.int32)
+    ext_counts = jnp.concatenate([lo_counts, counts, hi_counts])
+
+    n_ext = ext_pts.shape[0]
+    flats_d, flats_i, los, his = [], [], [], []
+    inv_flat = jnp.zeros((n_ext,), jnp.int32)
+    inv_box = jnp.zeros((n_ext,), jnp.int32)
+    flat_off = box_off = 0
+    for cp in classes:
+        if cp.use_pallas:
+            fd, fi = _pallas_class(ext_pts, ext_starts, ext_counts, cp, k,
+                                   exclude_self, interpret)
         else:
-            def step(carry, chunk):
-                own_c, cand_c, lo_c, hi_c = chunk
-                q_idx, q_valid, best_d, best_i, cert = chunk_best(
-                    ext_pts, ext_starts, ext_counts, own_c, cand_c, lo_c, hi_c,
-                    plan.qcap, plan.ccap, k, cfg.dist_method, cfg.exclude_self,
-                    domain)
-                return to_global_and_scatter(carry, q_idx, q_valid, best_d,
-                                             best_i, cert), None
+            q_idx, q_ok = pack_cells(cp.own, ext_starts, ext_counts,
+                                     cp.qcap_pad)
+            q = jnp.take(ext_pts, q_idx, axis=0)
+            q_excl = (q_idx if exclude_self
+                      else jnp.full_like(q_idx, -2))
+            fd, fi = _streamed_topk(ext_pts, ext_starts, ext_counts, cp.cand,
+                                    q, q_ok, q_excl, k, cp.ccap, tile)
+        flats_d.append(fd)
+        flats_i.append(fi)
+        los.append(cp.lo)
+        his.append(cp.hi)
+        # invert this class's slot partition (local rows only own slots here:
+        # own cells never cover halo layers)
+        q_idx, q_ok = pack_cells(cp.own, ext_starts, ext_counts, cp.qcap_pad)
+        slot = (jnp.arange(cp.n_sc * cp.qcap_pad, dtype=jnp.int32)
+                .reshape(cp.n_sc, cp.qcap_pad))
+        safe = jnp.where(q_ok, q_idx, n_ext)
+        inv_flat = inv_flat.at[safe].set(flat_off + slot, mode="drop")
+        rows = jnp.broadcast_to(
+            jnp.arange(cp.n_sc, dtype=jnp.int32)[:, None], q_idx.shape)
+        inv_box = inv_box.at[safe].set(box_off + rows, mode="drop")
+        flat_off += cp.n_sc * cp.qcap_pad
+        box_off += cp.n_sc
 
-            (out_d, out_i, out_cert), _ = jax.lax.scan(
-                step, (out_d, out_i, out_cert), (own, cand, blo, bhi))
-        return out_i[None], out_d[None], out_cert[None]
-
-    return device_fn
+    flat_d = jnp.concatenate(flats_d, axis=0)
+    flat_i = jnp.concatenate(flats_i, axis=0)
+    loc = slice(hcap, hcap + pcap)
+    row_d = jnp.take(flat_d, inv_flat[loc], axis=0)          # (pcap, k)
+    row_i = jnp.take(flat_i, inv_flat[loc], axis=0)
+    ok = jnp.isfinite(row_d)
+    row_i = jnp.where(ok, row_i, INVALID_ID)
+    row_d = jnp.where(ok, row_d, jnp.inf)
+    # extended index -> original id, via the exchanged id blocks
+    nbr_orig = jnp.where(
+        row_i >= 0,
+        jnp.take(ext_ids, jnp.clip(row_i, 0, n_ext - 1), axis=0),
+        INVALID_ID)
+    lo = jnp.take(jnp.concatenate(los, axis=0), inv_box[loc], axis=0)
+    hi = jnp.take(jnp.concatenate(his, axis=0), inv_box[loc], axis=0)
+    cert = row_d[:, k - 1] <= _margin_sq(spts[:, None, :], lo, hi,
+                                         domain)[:, 0]
+    return nbr_orig, row_d, cert
 
 
 @dataclasses.dataclass
@@ -306,20 +415,27 @@ class ShardedKnnProblem:
     """Multi-chip analog of api.KnnProblem: one prepared problem over a mesh.
 
     The reference has no counterpart -- this is the "sharded 10M points over
-    v5e-8 ICI" capability from BASELINE.json.configs.
+    v5e-8 ICI" capability from BASELINE.json.  Unlike rounds 1-2, prepare
+    never builds a global device grid: each chip builds and owns its slab.
     """
 
-    grid: GridHash
     config: KnnConfig
-    plan: ShardedPlan
     mesh: Mesh
-    _fn: Optional[object] = dataclasses.field(default=None, repr=False)
+    meta: ShardMeta
+    n_points: int
+    chip_plans: List[ChipPlan]
+    # device state (sharded over the mesh, leading axis = chip)
+    dev: Dict[str, jax.Array] = dataclasses.field(default_factory=dict,
+                                                  repr=False)
+    _points_host: Optional[np.ndarray] = dataclasses.field(default=None,
+                                                           repr=False)
 
     @classmethod
     def prepare(cls, points, n_devices: Optional[int] = None,
                 config: Optional[KnnConfig] = None,
                 mesh: Optional[Mesh] = None,
                 dim: Optional[int] = None) -> "ShardedKnnProblem":
+        from ..config import grid_dim_for
         from ..io import validate_points
 
         config = config or KnnConfig()
@@ -327,86 +443,156 @@ class ShardedKnnProblem:
             n_devices = n_devices or len(jax.devices())
             mesh = jax.make_mesh((n_devices,), ("z",))
         ndev = mesh.devices.size
-        grid = build_grid(validate_points(points), dim=dim,
-                          density=config.density)
-        plan = build_sharded_plan(grid, config, ndev)
-        return cls(grid=grid, config=config, plan=plan, mesh=mesh)
+        points = validate_points(points)
+        n = points.shape[0]
+        if dim is None:
+            dim = grid_dim_for(n, config.density)
+        dim = int(dim)
+        zc0, zc1, zcap = _slab_bounds(dim, config.supercell, ndev)
+
+        # Halo depth = the max dilation radius any nonempty supercell will
+        # actually select -- measured on the host from O(cells) occupancy,
+        # not assumed.  Thin slabs with a worst-case halo would otherwise
+        # carry boundary blocks rivaling the slab itself (uniform data only
+        # needs radius ~2 where the planner's ceiling is 6).
+        if config.ring_radius is not None:
+            radius = max(1, int(config.ring_radius))
+            if zcap < radius:
+                raise ValueError(
+                    f"slab thickness {zcap} cells < halo depth {radius}: "
+                    f"halo would span multiple chips. Use fewer devices, a "
+                    f"larger supercell, or a smaller ring radius "
+                    f"(dim={dim}, ndev={ndev}).")
+        else:
+            radius = _measured_halo_depth(points, dim, zcap, config)
+
+        meta_pts, meta_ids, n_local, pcap, hcap = _partition_host(
+            points, dim, zcap, radius, ndev, DOMAIN_SIZE)
+        meta = ShardMeta(ndev=ndev, dim=dim, zcap=zcap, radius=radius,
+                         pcap=pcap, hcap=hcap, domain=DOMAIN_SIZE)
+
+        spec = P("z")
+        build = _build_program(meta, mesh)
+        out = build(
+            jax.device_put(meta_pts,
+                           jax.sharding.NamedSharding(mesh, spec)),
+            jax.device_put(meta_ids,
+                           jax.sharding.NamedSharding(mesh, spec)),
+            jax.device_put(n_local.reshape(ndev, 1),
+                           jax.sharding.NamedSharding(mesh, spec)))
+        names = ("spts", "sids", "counts", "lo_pts", "lo_ids", "lo_counts",
+                 "hi_pts", "hi_ids", "hi_counts")
+        dev = dict(zip(names, out))
+
+        # per-chip adaptive planning from the (small) cell-count readback
+        counts_all = np.asarray(jax.device_get(dev["counts"]))
+        # explicit backend='xla' pins every class to the streamed route, like
+        # the single-chip pick_backend policy
+        on_kernel = (config.backend != "xla"
+                     and (jax.devices()[0].platform == "tpu"
+                          or config.interpret))
+        chip_plans = [_plan_chip(counts_all, d, meta, config, on_kernel)
+                      for d in range(ndev)]
+        return cls(config=config, mesh=mesh, meta=meta, n_points=n,
+                   chip_plans=chip_plans, dev=dev, _points_host=points)
+
+    # -- per-chip shard access ------------------------------------------------
+
+    def local_chips(self) -> List[int]:
+        """Global mesh positions of the chips THIS process can address.
+        Single-process (and the emulated CPU mesh): all of them.  On a
+        multi-host mesh each process sees only its own chips -- the build
+        phase (shard_map + ppermute) is SPMD across hosts, and each host then
+        drives the solve for its local slabs."""
+        arr = next(iter(self.dev.values()))
+        return sorted(int(sh.index[0].start or 0)
+                      for sh in arr.addressable_shards)
+
+    def _chip_inputs(self, d: int):
+        """Device-resident shard of chip (mesh position) d for every build
+        output -- no cross-device copies: addressable_shards hands back the
+        block already living on that chip."""
+        out = {}
+        for name, arr in self.dev.items():
+            shard = next(sh for sh in arr.addressable_shards
+                         if int(sh.index[0].start or 0) == d)
+            out[name] = shard.data.reshape(shard.data.shape[1:])
+        return out
 
     def solve_device(self):
-        """Run the sharded solve on the mesh, leaving results device-resident.
+        """Run every process-local chip's adaptive solve, results
+        device-resident.
 
-        Returns (out_i, out_d, out_cert) sharded over the mesh, shaped
-        (ndev, pcap, ...): per-chip slab rows in *global sorted* neighbor
-        indexing, pad rows beyond each chip's n_local undefined.  This is the
-        steady-state hot path -- host assembly (solve()) is a separate,
-        optional phase, like the reference's kn_get_* readback
-        (/root/reference/knearests.cu:406-437).
+        Returns {mesh position: (orig_ids (pcap, k), d2 (pcap, k),
+        cert (pcap,)) or None for empty slabs}, each value resident on its
+        chip.  Dispatch is a host loop but execution overlaps: jit dispatch
+        is asynchronous and each chip's program runs on its own device.  Chip
+        schedules are static per chip index (per-chip capacity classes), so
+        one chip's dense blob never sizes another chip's tiles.  On a
+        multi-host mesh each process solves its own slabs (local_chips());
+        host assembly (solve()) is single-controller.
         """
-        plan, cfg = self.plan, self.config
-        if self._fn is None:
-            # built once per problem so repeated solves reuse the compile cache
-            use_pallas = _use_pallas(cfg, plan.qcap, plan.ccap)
-            spec_tree = (P("z"),) * 13
-            self._fn = jax.jit(jax.shard_map(
-                _make_device_solve(plan, cfg, self.grid.domain, use_pallas),
-                mesh=self.mesh, in_specs=spec_tree,
-                out_specs=(P("z"), P("z"), P("z")),
-                # pallas_call's block machinery trips the vma checker (its
-                # internal dynamic_slice mixes varying/invariant operands)
-                check_vma=not use_pallas))
-        return self._fn(
-            plan.local_pts, plan.local_counts, plan.local_base,
-            plan.bot_pts, plan.bot_counts, plan.bot_base,
-            plan.top_pts, plan.top_counts, plan.top_base,
-            plan.own_cells, plan.cand_cells, plan.box_lo, plan.box_hi)
+        cfg, meta = self.config, self.meta
+        outs = {}
+        for d in self.local_chips():
+            if not self.chip_plans[d].classes:   # empty slab: nothing to do
+                outs[d] = None
+                continue
+            inp = self._chip_inputs(d)
+            outs[d] = _chip_solve(
+                inp["spts"], inp["sids"], inp["counts"],
+                inp["lo_pts"], inp["lo_ids"], inp["lo_counts"],
+                inp["hi_pts"], inp["hi_ids"], inp["hi_counts"],
+                self.chip_plans[d].classes, cfg.k, cfg.exclude_self,
+                meta.domain, cfg.interpret, cfg.stream_tile, meta.hcap)
+        return outs
+
+    def permutation(self) -> np.ndarray:
+        """Original index per storage row, concatenated chip-major -- the
+        multi-chip analog of kn_get_permutation (a bijection over [0, n);
+        single-controller, like solve())."""
+        ids = [np.asarray(jax.device_get(self._chip_inputs(d)["sids"]))
+               for d in self.local_chips()]
+        flat = np.concatenate(ids)
+        return flat[flat >= 0]
 
     def solve(self, device_out=None) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Run the sharded solve.  Returns (neighbors_original_ids (n, k),
-        dists_sq (n, k), certified (n,)) on the host, exact (uncertified
-        queries resolved against the global array).  Pass ``device_out`` (a
-        previous ``solve_device()`` result) to assemble without re-running the
-        mesh solve."""
-        plan, cfg = self.plan, self.config
-        out_i, out_d, out_cert = (device_out if device_out is not None
-                                  else self.solve_device())
-        out_i = np.asarray(jax.device_get(out_i))
-        out_d = np.asarray(jax.device_get(out_d))
-        out_cert = np.asarray(jax.device_get(out_cert))
-
-        n, k = self.grid.n_points, cfg.k
-        nbr_sorted = np.full((n, k), INVALID_ID, np.int32)
+        """Run the sharded solve and assemble host results in ORIGINAL
+        indexing.  Returns (neighbors (n, k), dists_sq (n, k),
+        certified (n,)); uncertified rows are resolved exactly against the
+        host kd-tree oracle (the one place the full set is touched, host-side
+        only -- no chip ever holds the global array).  Pass ``device_out`` (a
+        previous ``solve_device()`` result) to skip re-running the solve."""
+        cfg, meta = self.config, self.meta
+        outs = device_out if device_out is not None else self.solve_device()
+        if len(outs) < meta.ndev:
+            raise RuntimeError(
+                f"solve() assembles all {meta.ndev} slabs but this process "
+                f"addresses only chips {sorted(outs)}; on a multi-host mesh "
+                f"use solve_device() per process and aggregate externally")
+        n, k = self.n_points, cfg.k
+        neighbors = np.full((n, k), INVALID_ID, np.int32)
         d2 = np.full((n, k), np.inf, np.float32)
         cert = np.zeros((n,), bool)
-        base = plan.local_base.ravel()
-        nloc = plan.n_local.ravel()
-        for d in range(plan.ndev):
-            m = int(nloc[d])
-            if m == 0:
+        for d in sorted(outs):
+            if outs[d] is None:
                 continue
-            rows = slice(int(base[d]), int(base[d]) + m)
-            nbr_sorted[rows] = out_i[d, :m]
-            d2[rows] = out_d[d, :m]
-            cert[rows] = out_cert[d, :m]
+            sids = np.asarray(jax.device_get(self._chip_inputs(d)["sids"]))
+            o_i, o_d, o_c = (np.asarray(jax.device_get(x)) for x in outs[d])
+            rows = sids >= 0
+            neighbors[sids[rows]] = o_i[rows]
+            d2[sids[rows]] = o_d[rows]
+            cert[sids[rows]] = o_c[rows]
 
         if cfg.fallback == "brute" and not cert.all():
-            from ..api import _pad_pow2
-            bad = np.nonzero(~cert)[0].astype(np.int32)
-            q_idx = _pad_pow2(bad, fill=-1)
-            b_ids, b_d2 = brute_force_by_index(
-                self.grid.points, jnp.asarray(q_idx), k, cfg.exclude_self)
-            b_ids, b_d2 = np.asarray(b_ids), np.asarray(b_d2)
-            nbr_sorted[bad] = b_ids[: bad.size]
-            d2[bad] = b_d2[: bad.size]
-            cert[bad] = True
+            from ..oracle import KdTreeOracle
 
-        perm = np.asarray(jax.device_get(self.grid.permutation))
-        valid = nbr_sorted >= 0
-        nbr_orig_vals = np.where(valid, perm[np.clip(nbr_sorted, 0, n - 1)],
-                                 INVALID_ID)
-        neighbors = np.empty_like(nbr_orig_vals)
-        neighbors[perm] = nbr_orig_vals
-        d2_out = np.empty_like(d2)
-        d2_out[perm] = d2
-        cert_out = np.empty_like(cert)
-        cert_out[perm] = cert
-        return neighbors, d2_out, cert_out
+            bad = np.nonzero(~cert)[0].astype(np.int32)
+            oracle = KdTreeOracle(self._points_host)
+            b_ids, b_d2 = oracle.knn(
+                self._points_host[bad], k,
+                exclude_ids=bad if cfg.exclude_self else None)
+            neighbors[bad] = b_ids
+            d2[bad] = b_d2
+            cert[bad] = True
+        return neighbors, d2, cert

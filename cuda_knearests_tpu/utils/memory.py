@@ -24,10 +24,15 @@ class DeviceMemoryError(RuntimeError):
 
 
 def to_device(x: np.ndarray, dtype: Any = jnp.float32,
-              sharding: Optional[jax.sharding.Sharding] = None) -> jax.Array:
-    """Validated host->HBM staging (analog of gpuMallocNCopy, knearests.cu:219-226)."""
+              sharding: Optional[jax.sharding.Sharding] = None,
+              validate: bool = True) -> jax.Array:
+    """Validated host->HBM staging (analog of gpuMallocNCopy, knearests.cu:219-226).
+
+    ``validate=False`` skips the finite scan for callers whose input already
+    went through io.validate_points (e.g. gridhash.build_grid) -- the checked
+    device placement and error reporting still apply."""
     arr = np.asarray(x)
-    if not np.isfinite(arr).all():
+    if validate and not np.isfinite(arr).all():
         raise DeviceMemoryError("refusing to stage non-finite data to device")
     arr = np.ascontiguousarray(arr, dtype=np.dtype(dtype))
     try:

@@ -8,9 +8,7 @@ import numpy as np
 import pytest
 
 from cuda_knearests_tpu import KnnConfig, KnnProblem
-from cuda_knearests_tpu.parallel.sharded import (ShardedKnnProblem,
-                                                 _slab_bounds,
-                                                 build_sharded_plan)
+from cuda_knearests_tpu.parallel.sharded import ShardedKnnProblem, _slab_bounds
 from conftest import brute_knn_np
 
 
@@ -26,10 +24,11 @@ def test_slab_bounds_cover_grid():
 
 
 def test_halo_too_deep_raises(uniform_10k):
-    from cuda_knearests_tpu.ops.gridhash import build_grid
-    g = build_grid(uniform_10k)  # dim ~ 15 -> 8 devices -> 4-cell slabs
+    # dim ~ 15 -> 8 devices -> 3-cell slabs; an explicit 30-cell ring radius
+    # cannot be haloed from adjacent chips
     with pytest.raises(ValueError, match="halo"):
-        build_sharded_plan(g, KnnConfig(k=10, ring_radius=30), ndev=8)
+        ShardedKnnProblem.prepare(uniform_10k, n_devices=8,
+                                  config=KnnConfig(k=10, ring_radius=30))
 
 
 @pytest.mark.parametrize("ndev", [1, 2, 8])
@@ -108,6 +107,60 @@ def test_sharded_clustered_points():
     ref = brute_knn_np(pts, q, 5)
     for row, qi in enumerate(q):
         assert set(ref[row].tolist()) == set(nbrs[qi].tolist())
+
+
+def test_per_chip_capacity_classes():
+    """VERDICT round-2 item 6: a dense blob on one chip must size only that
+    chip's tiles -- other chips keep capacities from their own local density,
+    and no chip inherits the blob's ccap."""
+    rng = np.random.default_rng(11)
+    bg = rng.random((8000, 3)).astype(np.float32) * 1000.0
+    blob = (np.float32([500, 500, 60])
+            + 8.0 * rng.standard_normal((4000, 3)).astype(np.float32))
+    pts = np.clip(np.concatenate([bg, blob]), 0.0, 1000.0).astype(np.float32)
+    sp = ShardedKnnProblem.prepare(pts, n_devices=4, config=KnnConfig(k=10))
+    # blob z ~ 60/1000 -> chip 0; far chips see only background density
+    ccap = [max((c.ccap for c in p.classes), default=0) for p in sp.chip_plans]
+    qcap = [max((c.qcap for c in p.classes), default=0) for p in sp.chip_plans]
+    assert ccap[0] > 2 * max(ccap[2], ccap[3]), (
+        f"blob chip ccap {ccap[0]} should dwarf far-chip ccaps {ccap}")
+    assert qcap[0] > 2 * max(qcap[2], qcap[3]), qcap
+    # and the solve stays exact
+    nbrs, d2, cert = sp.solve()
+    assert cert.all()
+    q = rng.integers(0, len(pts), 16)
+    ref = brute_knn_np(pts, q, 10)
+    for row, qi in enumerate(q):
+        assert set(ref[row].tolist()) == set(nbrs[qi].tolist())
+
+
+@pytest.mark.slow
+def test_per_device_footprint_scales(rng):
+    """VERDICT round-2 item 5: at 1M+ points over 8 devices, no device holds
+    the global array -- per-chip capacities (and thus per-device bytes) scale
+    ~1/ndev, and prepare never materializes a global device-resident sort."""
+    from cuda_knearests_tpu.io import generate_uniform
+
+    n, ndev = 1_000_000, 8
+    pts = generate_uniform(n, seed=4)
+    sp = ShardedKnnProblem.prepare(pts, n_devices=ndev, config=KnnConfig(k=10))
+    meta = sp.meta
+    # slab population cap is ~n/ndev (uniform data): generous 1.35x slack
+    assert meta.pcap <= 1.35 * n / ndev, (meta.pcap, n / ndev)
+    # halo blocks are a small fraction of a slab
+    assert meta.hcap < meta.pcap
+    # per-device resident build state: points + ids + CSR + halo blocks
+    per_dev_bytes = (meta.pcap * (12 + 4)                 # spts + sids
+                     + meta.zcap * meta.dim ** 2 * 4      # counts
+                     + 2 * meta.hcap * (12 + 4)           # halo pts + ids
+                     + 2 * meta.radius * meta.dim ** 2 * 4)
+    global_bytes = n * 16
+    assert per_dev_bytes < 0.3 * global_bytes, (per_dev_bytes, global_bytes)
+    # every sharded build output splits its leading axis across the mesh
+    for name, arr in sp.dev.items():
+        assert arr.shape[0] == ndev, name
+        shard = arr.addressable_shards[0].data
+        assert shard.shape[0] == 1, name
 
 
 def test_dryrun_multichip_entry():
