@@ -46,7 +46,7 @@ from .gridhash import GridHash
 from .rings import ring_occupancy
 from .solve import (KnnResult, _boxes_grid, _box_cell_ids, _margin_sq,
                     _round_up, pack_cells)
-from .topk import INVALID_ID, init_topk, merge_topk
+from .topk import INVALID_ID, init_topk, masked_topk, merge_topk
 
 
 def select_radii(points_cum: np.ndarray, cells_cum: np.ndarray, k: int,
@@ -73,6 +73,11 @@ def select_radii(points_cum: np.ndarray, cells_cum: np.ndarray, k: int,
     return radii
 
 
+# Dense-route ceiling: one (rows, qcap, ccap) f32 tile per scan step must
+# stay within this budget or the class streams instead.
+_DENSE_TILE_BYTES = 128 << 20
+
+
 @dataclasses.dataclass(frozen=True)
 class ClassSpec:
     """Host-side description of one capacity class (all-static)."""
@@ -82,7 +87,11 @@ class ClassSpec:
     qcap: int             # per-supercell query capacity (pre-lane-rounding)
     qcap_pad: int         # capacity as laid out by the class solver
     ccap: int
-    use_pallas: bool
+    route: str            # 'pallas' | 'dense' | 'streamed'
+
+    @property
+    def use_pallas(self) -> bool:
+        return self.route == "pallas"
 
 
 def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
@@ -98,6 +107,13 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
     ccap is sized from the counts *at that class's final radius* -- sizing
     from a pre-merge radius would make pack_cells silently truncate
     candidates, returning wrong neighbors that still certify.
+
+    Route policy: kernel platforms (TPU / interpret) run each class through
+    the fused Pallas kernel when its tile fits VMEM and stream it otherwise;
+    host platforms run a chunked dense masked-top-k (measured ~3.5x the
+    streamed path's throughput on CPU -- XLA CPU's TopK is fast, the
+    streaming merge's extra tile copies are not), streaming only tiles past
+    the dense byte ceiling.
     """
     from .pallas_solve import pallas_fits
 
@@ -130,11 +146,15 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
         qcap = _round_up(int(own_n[rows].max()), 8)
         ccap = _round_up(max(int(cand_at(rows, radius).max()), cfg.k), 128)
         qcap_pad = -(-qcap // 128) * 128
-        use_pallas = (on_kernel_platform
-                      and pallas_fits(qcap_pad, ccap, cfg.k))
+        if on_kernel_platform:
+            route = ("pallas" if pallas_fits(qcap_pad, ccap, cfg.k)
+                     else "streamed")
+        else:
+            route = ("dense" if qcap * ccap * 4 <= _DENSE_TILE_BYTES
+                     else "streamed")
         return ClassSpec(rows=rows, radius=radius, qcap=qcap,
-                         qcap_pad=qcap_pad if use_pallas else qcap,
-                         ccap=ccap, use_pallas=use_pallas)
+                         qcap_pad=qcap_pad if route == "pallas" else qcap,
+                         ccap=ccap, route=route)
 
     return tuple(mk(rows, r) for rows, r in groups)
 
@@ -142,7 +162,7 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
 @functools.partial(
     jax.tree_util.register_dataclass,
     data_fields=("own", "cand", "lo", "hi"),
-    meta_fields=("radius", "qcap", "qcap_pad", "ccap", "use_pallas"),
+    meta_fields=("radius", "qcap", "qcap_pad", "ccap", "route"),
 )
 @dataclasses.dataclass(frozen=True)
 class ClassPlan:
@@ -156,7 +176,11 @@ class ClassPlan:
     qcap: int
     qcap_pad: int
     ccap: int
-    use_pallas: bool
+    route: str        # 'pallas' | 'dense' | 'streamed'
+
+    @property
+    def use_pallas(self) -> bool:
+        return self.route == "pallas"
 
     @property
     def n_sc(self) -> int:
@@ -234,7 +258,7 @@ def build_adaptive_plan(grid: GridHash, cfg: KnnConfig,
             own=jnp.asarray(own), cand=jnp.asarray(cand),
             lo=jnp.asarray(lo), hi=jnp.asarray(hi),
             radius=spec.radius, qcap=spec.qcap, qcap_pad=spec.qcap_pad,
-            ccap=spec.ccap, use_pallas=spec.use_pallas))
+            ccap=spec.ccap, route=spec.route))
 
     inv_flat, inv_box = _invert_partition(
         tuple(classes), grid.cell_starts, grid.cell_counts, grid.n_points)
@@ -326,13 +350,61 @@ def _streamed_topk(points: jax.Array, starts: jax.Array, counts: jax.Array,
     return out_d, out_i
 
 
-def _streamed_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
-                    cp: ClassPlan, k: int, exclude_self: bool, tile: int):
-    """Self-solve wrapper over _streamed_topk: queries are the class's own
-    stored points.  Returns (Sc * qcap_pad, k) flat dists/ids, ascending."""
+def _dense_topk(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                cand_cells: jax.Array, q: jax.Array, q_ok: jax.Array,
+                q_excl: jax.Array, k: int, ccap: int):
+    """Dense per-class solver: one (rows_chunk, qcap, ccap) distance tile per
+    scan step + masked_topk -- the host-platform route (measured ~3.5x the
+    streamed merge's throughput on CPU: XLA CPU's TopK is fast; the streaming
+    merge's tile-multiple padding and extra copies are not).  Same I/O
+    contract as _streamed_topk."""
+    n_sc, qcap = q.shape[0], q.shape[1]
+    c_idx, c_ok = pack_cells(cand_cells, starts, counts, ccap)
+    rows_chunk = max(1, min(n_sc, (32 << 20) // (qcap * ccap * 4)))
+    n_chunks = -(-n_sc // rows_chunk)
+    rows_pad = n_chunks * rows_chunk
+
+    def pad_rows(a):
+        pad = rows_pad - a.shape[0]
+        if pad:
+            a = jnp.concatenate([a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
+        return a.reshape((n_chunks, rows_chunk) + a.shape[1:])
+
+    def step(_, inp):
+        q_c, qe_c, qo_c, ci_c, co_c = inp
+        c = jnp.take(points, ci_c, axis=0)                   # (rows, ccap, 3)
+        d2 = jnp.zeros((rows_chunk, qcap, ccap), jnp.float32)
+        for ax in range(3):
+            diff = q_c[:, :, None, ax] - c[:, None, :, ax]
+            d2 = d2 + diff * diff
+        mask = (qo_c[:, :, None] & co_c[:, None, :]
+                & (ci_c[:, None, :] != qe_c[:, :, None]))
+        ids = jnp.broadcast_to(ci_c[:, None, :], d2.shape)
+        return None, masked_topk(d2, ids, mask, k)
+
+    _, (out_d, out_i) = jax.lax.scan(
+        step, None, (pad_rows(q), pad_rows(q_excl), pad_rows(q_ok),
+                     pad_rows(c_idx), pad_rows(c_ok)))
+    out_d = out_d.reshape(rows_pad * qcap, k)[: n_sc * qcap]
+    out_i = out_i.reshape(rows_pad * qcap, k)[: n_sc * qcap]
+    return out_d, out_i
+
+
+def _class_flat(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                cp: ClassPlan, k: int, exclude_self: bool, tile: int,
+                interpret: bool):
+    """Route one class's self-solve to its solver.  Returns
+    (Sc * qcap_pad, k) flat dists/ids, ascending -- the shared layout
+    contract of all three routes."""
+    if cp.route == "pallas":
+        return _pallas_class(points, starts, counts, cp, k, exclude_self,
+                             interpret)
     q_idx, q_ok = pack_cells(cp.own, starts, counts, cp.qcap_pad)
     q = jnp.take(points, q_idx, axis=0)                      # (Sc, qcap, 3)
     q_excl = q_idx if exclude_self else jnp.full_like(q_idx, -2)
+    if cp.route == "dense":
+        return _dense_topk(points, starts, counts, cp.cand, q, q_ok, q_excl,
+                           k, cp.ccap)
     return _streamed_topk(points, starts, counts, cp.cand, q, q_ok, q_excl,
                           k, cp.ccap, tile)
 
@@ -359,12 +431,8 @@ def _solve_adaptive(points: jax.Array, starts: jax.Array, counts: jax.Array,
                     domain: float, interpret: bool, tile: int):
     flats_d, flats_i, los, his = [], [], [], []
     for cp in plan.classes:
-        if cp.use_pallas:
-            fd, fi = _pallas_class(points, starts, counts, cp, k,
-                                   exclude_self, interpret)
-        else:
-            fd, fi = _streamed_class(points, starts, counts, cp, k,
-                                     exclude_self, tile)
+        fd, fi = _class_flat(points, starts, counts, cp, k, exclude_self,
+                             tile, interpret)
         flats_d.append(fd)
         flats_i.append(fi)
         los.append(cp.lo)
@@ -398,12 +466,12 @@ def solve_adaptive(grid: GridHash, cfg: KnnConfig,
 
 # -- external queries through the class schedule ------------------------------
 
-@functools.partial(jax.jit, static_argnames=("q2cap", "k", "use_pallas",
+@functools.partial(jax.jit, static_argnames=("q2cap", "k", "route",
                                              "domain", "interpret", "tile"))
 def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                  cp: ClassPlan, qsorted: jax.Array, rstarts: jax.Array,
                  rcounts: jax.Array, inv: jax.Array, rows_sel: jax.Array,
-                 q2cap: int, k: int, use_pallas: bool, domain: float,
+                 q2cap: int, k: int, route: str, domain: float,
                  interpret: bool, tile: int):
     """One class's external-query launch: build the per-supercell query block
     from the row-bucketed queries, run the class solver (kernel or streamed),
@@ -414,7 +482,7 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
     qs_idx = rstarts[:, None] + slots[None, :]               # (Sc, q2cap)
     qs_ok = slots[None, :] < rcounts[:, None]
     q = jnp.take(qsorted, jnp.where(qs_ok, qs_idx, 0), axis=0)
-    if use_pallas:
+    if route == "pallas":
         from .pallas_solve import _PAD_C, _PAD_Q, _pallas_topk
 
         c_idx, c_ok = pack_cells(cp.cand, starts, counts, cp.ccap)
@@ -428,6 +496,10 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                                     k, False, interpret)
         flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
         flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)
+    elif route == "dense":
+        q_excl = jnp.full((cp.n_sc, q2cap), -2, jnp.int32)   # exclude nothing
+        flat_d, flat_i = _dense_topk(points, starts, counts, cp.cand,
+                                     q, qs_ok, q_excl, k, cp.ccap)
     else:
         q_excl = jnp.full((cp.n_sc, q2cap), -2, jnp.int32)   # exclude nothing
         flat_d, flat_i = _streamed_topk(points, starts, counts, cp.cand,
@@ -491,19 +563,26 @@ def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
         rstarts = np.concatenate([[0], np.cumsum(rcounts)[:-1]]).astype(np.int32)
         rank = np.arange(sel.size, dtype=np.int64) - rstarts[rows_sorted]
         max_q = int(rcounts.max())
-        # kernel lanes need 128-multiples; streamed takes any pow2 (bounds
-        # recompiles across query sets)
+        # kernel lanes need 128-multiples; the other routes take any pow2
+        # (bounds recompiles across query sets).  A kernel class re-gates
+        # against VMEM with *this query set's* capacity: a query blob can
+        # exceed the budget the stored-point tile fit, in which case the
+        # class drops to its non-kernel route for this call.
         q2cap_pal = -(-max_q // 128) * 128
-        use_pallas = (cp.use_pallas and pallas_fits(q2cap_pal, cp.ccap, k))
-        q2cap = (q2cap_pal if use_pallas
+        route = cp.route
+        if route == "pallas" and not pallas_fits(q2cap_pal, cp.ccap, k):
+            route = "streamed"
+        q2cap = (q2cap_pal if route == "pallas"
                  else 1 << max(3, (max_q - 1).bit_length()))
+        if route == "dense" and q2cap * cp.ccap * 4 > _DENSE_TILE_BYTES:
+            route = "streamed"  # query blob inflated the dense tile too
         inv = (rows_sorted * q2cap + rank).astype(np.int32)
         r_i, r_d, r_c = _query_class(
             grid.points, grid.cell_starts, grid.cell_counts, cp,
             jnp.asarray(queries[sel_sorted]), jnp.asarray(rstarts),
             jnp.asarray(rcounts), jnp.asarray(inv),
             jnp.asarray(rows_sorted.astype(np.int32)), q2cap, k,
-            use_pallas, grid.domain, cfg.interpret, cfg.stream_tile)
+            route, grid.domain, cfg.interpret, cfg.stream_tile)
         out_i[sel_sorted] = np.asarray(jax.device_get(r_i))
         out_d[sel_sorted] = np.asarray(jax.device_get(r_d))
         cert[sel_sorted] = np.asarray(jax.device_get(r_c))

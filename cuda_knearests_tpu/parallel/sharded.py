@@ -42,8 +42,8 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import DOMAIN_SIZE, KnnConfig, default_ring_radius
-from ..ops.adaptive import (ClassPlan, _pallas_class, _streamed_topk,
-                            build_class_specs, select_radii)
+from ..ops.adaptive import (ClassPlan, _class_flat, build_class_specs,
+                            select_radii)
 from ..ops.gridhash import cell_coords
 from ..ops.rings import box_sums, summed_area_table
 from ..ops.solve import _FAR, _margin_sq, _round_up, pack_cells
@@ -125,13 +125,16 @@ def _partition_host(points: np.ndarray, dim: int, zcap: int, radius: int,
         bucket_pts[d, : counts[d]] = points[rows]
         bucket_ids[d, : counts[d]] = rows.astype(np.int32)
 
-    # halo capacity: max points in any chip's R bottom / top z-cell layers
+    # halo capacity: max points in any chip's R bottom / top z-cell layers --
+    # O(n + dim) via one z-layer histogram (chip ownership is a pure function
+    # of the z-cell, so per-chip boundary populations are layer-range sums)
+    zhist = np.bincount(cz, minlength=dim)
     hmax = 1
     for d in range(ndev):
         zc0 = d * zcap
-        local_cz = cz[chip == d]
-        hmax = max(hmax, int((local_cz < zc0 + radius).sum()),
-                   int((local_cz >= zc0 + zcap - radius).sum()))
+        hmax = max(hmax,
+                   int(zhist[zc0: zc0 + radius].sum()),
+                   int(zhist[max(zc0 + zcap - radius, 0): zc0 + zcap].sum()))
     hcap = _round_up(hmax, 8)
     return bucket_pts, bucket_ids, counts.astype(np.int32), pcap, hcap
 
@@ -326,7 +329,7 @@ def _plan_chip(counts_all: np.ndarray, d: int, meta: ShardMeta,
             own=jnp.asarray(own), cand=jnp.asarray(cand),
             lo=jnp.asarray(lo), hi=jnp.asarray(hi),
             radius=spec.radius, qcap=spec.qcap, qcap_pad=spec.qcap_pad,
-            ccap=spec.ccap, use_pallas=spec.use_pallas))
+            ccap=spec.ccap, route=spec.route))
     return ChipPlan(classes=tuple(classes),
                     n_queries=int(win3[R: R + zcap].sum()))
 
@@ -362,17 +365,8 @@ def _chip_solve(spts, sids, counts, lo_pts, lo_ids, lo_counts,
     inv_box = jnp.zeros((n_ext,), jnp.int32)
     flat_off = box_off = 0
     for cp in classes:
-        if cp.use_pallas:
-            fd, fi = _pallas_class(ext_pts, ext_starts, ext_counts, cp, k,
-                                   exclude_self, interpret)
-        else:
-            q_idx, q_ok = pack_cells(cp.own, ext_starts, ext_counts,
-                                     cp.qcap_pad)
-            q = jnp.take(ext_pts, q_idx, axis=0)
-            q_excl = (q_idx if exclude_self
-                      else jnp.full_like(q_idx, -2))
-            fd, fi = _streamed_topk(ext_pts, ext_starts, ext_counts, cp.cand,
-                                    q, q_ok, q_excl, k, cp.ccap, tile)
+        fd, fi = _class_flat(ext_pts, ext_starts, ext_counts, cp, k,
+                             exclude_self, tile, interpret)
         flats_d.append(fd)
         flats_i.append(fi)
         los.append(cp.lo)
@@ -551,8 +545,14 @@ class ShardedKnnProblem:
         """Original index per storage row, concatenated chip-major -- the
         multi-chip analog of kn_get_permutation (a bijection over [0, n);
         single-controller, like solve())."""
+        chips = self.local_chips()
+        if len(chips) < self.meta.ndev:
+            raise RuntimeError(
+                f"permutation() covers all {self.meta.ndev} slabs but this "
+                f"process addresses only chips {chips}; on a multi-host mesh "
+                f"read per-chip sids from solve_device() inputs instead")
         ids = [np.asarray(jax.device_get(self._chip_inputs(d)["sids"]))
-               for d in self.local_chips()]
+               for d in chips]
         flat = np.concatenate(ids)
         return flat[flat >= 0]
 

@@ -58,6 +58,7 @@ def problem_stats(problem) -> Dict[str, Any]:
     if aplan is not None:
         classes = [{"radius": cp.radius, "n_supercells": cp.n_sc,
                     "qcap": cp.qcap, "ccap": cp.ccap,
+                    "route": cp.route,
                     "use_pallas": bool(cp.use_pallas)}
                    for cp in aplan.classes]
         out["plan"] = {"adaptive": True, "n_classes": len(classes),
@@ -91,9 +92,8 @@ def print_stats(problem) -> Dict[str, Any]:
         print(f"adaptive schedule: {plan['n_classes']} capacity classes "
               f"(max qcap {plan['qcap']}, max ccap {plan['ccap']})")
         for c in plan["classes"]:
-            route = "pallas" if c["use_pallas"] else "streamed"
             print(f"  class r={c['radius']}: {c['n_supercells']} supercells, "
-                  f"qcap {c['qcap']}, ccap {c['ccap']} [{route}]")
+                  f"qcap {c['qcap']}, ccap {c['ccap']} [{c['route']}]")
     elif plan is not None:
         print(f"schedule: qcap {plan['qcap']}, ccap {plan['ccap']}, "
               f"{plan['n_supercell_chunks']} chunks x {plan['chunk_batch']}")
