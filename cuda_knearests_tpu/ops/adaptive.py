@@ -350,43 +350,87 @@ def _streamed_topk(points: jax.Array, starts: jax.Array, counts: jax.Array,
     return out_d, out_i
 
 
-def _dense_topk(points: jax.Array, starts: jax.Array, counts: jax.Array,
-                cand_cells: jax.Array, q: jax.Array, q_ok: jax.Array,
-                q_excl: jax.Array, k: int, ccap: int):
-    """Dense per-class solver: one (rows_chunk, qcap, ccap) distance tile per
-    scan step + masked_topk -- the host-platform route (measured ~3.5x the
-    streamed merge's throughput on CPU: XLA CPU's TopK is fast; the streaming
-    merge's tile-multiple padding and extra copies are not).  Same I/O
-    contract as _streamed_topk."""
-    n_sc, qcap = q.shape[0], q.shape[1]
-    c_idx, c_ok = pack_cells(cand_cells, starts, counts, ccap)
-    rows_chunk = max(1, min(n_sc, (32 << 20) // (qcap * ccap * 4)))
-    n_chunks = -(-n_sc // rows_chunk)
-    rows_pad = n_chunks * rows_chunk
+def _dense_rows_chunk(n_sc: int, qcap: int, ccap: int) -> int:
+    """Rows per dense scan step: bound the (rows, qcap, ccap) f32 tile."""
+    return max(1, min(n_sc, (32 << 20) // (qcap * ccap * 4)))
 
-    def pad_rows(a):
-        pad = rows_pad - a.shape[0]
-        if pad:
-            a = jnp.concatenate([a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
-        return a.reshape((n_chunks, rows_chunk) + a.shape[1:])
+
+def _pad_chunk(a, n_chunks: int, rows_chunk: int, fill=0):
+    pad = n_chunks * rows_chunk - a.shape[0]
+    if pad:
+        a = jnp.concatenate([a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)])
+    return a.reshape((n_chunks, rows_chunk) + a.shape[1:])
+
+
+def _dense_step(points, starts, counts, cand_c, q_c, qe_c, qo_c, k, ccap):
+    """One dense chunk: in-step candidate pack + gather + tile + masked_topk.
+
+    Candidate indices are packed INSIDE the scan step from the (small) cell
+    tables -- prepacking the whole class's (Sc, ccap) index array and
+    threading it through scan xs measured ~1.6x slower on CPU (the stacked
+    arrays stream through the loop; the in-step pack recomputes them from
+    kilobytes of cell ids).  ``qe_c=None`` = exclude nothing (external
+    queries), compiled out of the mask."""
+    ci_c, co_c = pack_cells(cand_c, starts, counts, ccap)
+    c = jnp.take(points, ci_c, axis=0)                       # (rows, ccap, 3)
+    d2 = jnp.zeros(q_c.shape[:2] + (ccap,), jnp.float32)
+    for ax in range(3):
+        diff = q_c[:, :, None, ax] - c[:, None, :, ax]
+        d2 = d2 + diff * diff
+    mask = qo_c[:, :, None] & co_c[:, None, :]
+    if qe_c is not None:
+        mask = mask & (ci_c[:, None, :] != qe_c[:, :, None])
+    ids = jnp.broadcast_to(ci_c[:, None, :], d2.shape)
+    return masked_topk(d2, ids, mask, k)
+
+
+def _dense_self(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                own_cells: jax.Array, cand_cells: jax.Array, qcap: int,
+                k: int, ccap: int, exclude_self: bool):
+    """Dense self-solve: queries are the class's own stored points, packed
+    in-step together with the candidates -- the host-platform route (XLA
+    CPU's TopK is fast; the streaming merge's tile-multiple padding and extra
+    copies are not).  Returns (Sc * qcap, k) flat dists/ids, ascending."""
+    n_sc = own_cells.shape[0]
+    rows_chunk = _dense_rows_chunk(n_sc, qcap, ccap)
+    n_chunks = -(-n_sc // rows_chunk)
 
     def step(_, inp):
-        q_c, qe_c, qo_c, ci_c, co_c = inp
-        c = jnp.take(points, ci_c, axis=0)                   # (rows, ccap, 3)
-        d2 = jnp.zeros((rows_chunk, qcap, ccap), jnp.float32)
-        for ax in range(3):
-            diff = q_c[:, :, None, ax] - c[:, None, :, ax]
-            d2 = d2 + diff * diff
-        mask = (qo_c[:, :, None] & co_c[:, None, :]
-                & (ci_c[:, None, :] != qe_c[:, :, None]))
-        ids = jnp.broadcast_to(ci_c[:, None, :], d2.shape)
-        return None, masked_topk(d2, ids, mask, k)
+        own_c, cand_c = inp
+        qi_c, qo_c = pack_cells(own_c, starts, counts, qcap)
+        q_c = jnp.take(points, qi_c, axis=0)
+        qe_c = qi_c if exclude_self else None
+        return None, _dense_step(points, starts, counts, cand_c, q_c, qe_c,
+                                 qo_c, k, ccap)
 
     _, (out_d, out_i) = jax.lax.scan(
-        step, None, (pad_rows(q), pad_rows(q_excl), pad_rows(q_ok),
-                     pad_rows(c_idx), pad_rows(c_ok)))
-    out_d = out_d.reshape(rows_pad * qcap, k)[: n_sc * qcap]
-    out_i = out_i.reshape(rows_pad * qcap, k)[: n_sc * qcap]
+        step, None, (_pad_chunk(own_cells, n_chunks, rows_chunk, -1),
+                     _pad_chunk(cand_cells, n_chunks, rows_chunk, -1)))
+    out_d = out_d.reshape(n_chunks * rows_chunk * qcap, k)[: n_sc * qcap]
+    out_i = out_i.reshape(n_chunks * rows_chunk * qcap, k)[: n_sc * qcap]
+    return out_d, out_i
+
+
+def _dense_query_topk(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                      cand_cells: jax.Array, q: jax.Array, q_ok: jax.Array,
+                      k: int, ccap: int):
+    """Dense external-query solve: prebuilt query blocks, in-step candidate
+    packing.  Same flat output contract as _dense_self."""
+    n_sc, qcap = q.shape[0], q.shape[1]
+    rows_chunk = _dense_rows_chunk(n_sc, qcap, ccap)
+    n_chunks = -(-n_sc // rows_chunk)
+
+    def step(_, inp):
+        cand_c, q_c, qo_c = inp
+        return None, _dense_step(points, starts, counts, cand_c, q_c, None,
+                                 qo_c, k, ccap)
+
+    _, (out_d, out_i) = jax.lax.scan(
+        step, None, (_pad_chunk(cand_cells, n_chunks, rows_chunk, -1),
+                     _pad_chunk(q, n_chunks, rows_chunk),
+                     _pad_chunk(q_ok, n_chunks, rows_chunk)))
+    out_d = out_d.reshape(n_chunks * rows_chunk * qcap, k)[: n_sc * qcap]
+    out_i = out_i.reshape(n_chunks * rows_chunk * qcap, k)[: n_sc * qcap]
     return out_d, out_i
 
 
@@ -399,12 +443,12 @@ def _class_flat(points: jax.Array, starts: jax.Array, counts: jax.Array,
     if cp.route == "pallas":
         return _pallas_class(points, starts, counts, cp, k, exclude_self,
                              interpret)
+    if cp.route == "dense":
+        return _dense_self(points, starts, counts, cp.own, cp.cand,
+                           cp.qcap_pad, k, cp.ccap, exclude_self)
     q_idx, q_ok = pack_cells(cp.own, starts, counts, cp.qcap_pad)
     q = jnp.take(points, q_idx, axis=0)                      # (Sc, qcap, 3)
     q_excl = q_idx if exclude_self else jnp.full_like(q_idx, -2)
-    if cp.route == "dense":
-        return _dense_topk(points, starts, counts, cp.cand, q, q_ok, q_excl,
-                           k, cp.ccap)
     return _streamed_topk(points, starts, counts, cp.cand, q, q_ok, q_excl,
                           k, cp.ccap, tile)
 
@@ -497,9 +541,8 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
         flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
         flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)
     elif route == "dense":
-        q_excl = jnp.full((cp.n_sc, q2cap), -2, jnp.int32)   # exclude nothing
-        flat_d, flat_i = _dense_topk(points, starts, counts, cp.cand,
-                                     q, qs_ok, q_excl, k, cp.ccap)
+        flat_d, flat_i = _dense_query_topk(points, starts, counts, cp.cand,
+                                           q, qs_ok, k, cp.ccap)
     else:
         q_excl = jnp.full((cp.n_sc, q2cap), -2, jnp.int32)   # exclude nothing
         flat_d, flat_i = _streamed_topk(points, starts, counts, cp.cand,
