@@ -516,7 +516,7 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                  cp: ClassPlan, qsorted: jax.Array, rstarts: jax.Array,
                  rcounts: jax.Array, inv: jax.Array, rows_sel: jax.Array,
                  q2cap: int, k: int, route: str, domain: float,
-                 interpret: bool, tile: int):
+                 interpret: bool, tile: int, ids_map: jax.Array | None = None):
     """One class's external-query launch: build the per-supercell query block
     from the row-bucketed queries, run the class solver (kernel or streamed),
     gather each query's row back, and certify against the class's dilated
@@ -552,11 +552,59 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
     ok = jnp.isfinite(row_d)
     row_i = jnp.where(ok, row_i, INVALID_ID)
     row_d = jnp.where(ok, row_d, jnp.inf)
+    if ids_map is not None:
+        # translate to final ids on device (e.g. the sharded path's
+        # ext-index -> original-id block); readback stays O(m*k)
+        row_i = jnp.where(
+            row_i >= 0,
+            jnp.take(ids_map, jnp.clip(row_i, 0, ids_map.shape[0] - 1)),
+            INVALID_ID)
     lo = jnp.take(cp.lo, rows_sel, axis=0)                   # (m_c, 3)
     hi = jnp.take(cp.hi, rows_sel, axis=0)
     cert = row_d[:, k - 1] <= _margin_sq(qsorted[:, None, :], lo, hi,
                                          domain)[:, 0]
     return row_i, row_d, cert
+
+
+def launch_class_query(points, starts, counts, cp: ClassPlan,
+                       queries_sel: np.ndarray, rows_sel: np.ndarray, k: int,
+                       cfg: KnnConfig, domain: float, ids_map=None):
+    """Bucket one class's queries by supercell row and launch _query_class.
+
+    The shared front half of every external-query path (single-chip
+    query_adaptive and the sharded per-chip query): sorts queries row-major,
+    sizes the padded per-row capacity, re-gates the route against THIS query
+    set (a kernel class whose inflated q2cap no longer fits VMEM drops to
+    streamed; likewise a dense class past the dense byte ceiling), and builds
+    the flat-slot inverse.  Returns (order, r_i, r_d, r_c): ``order`` sorts
+    ``queries_sel`` row-major; the device results are in that order.
+    """
+    from .pallas_solve import pallas_fits
+
+    order = np.argsort(rows_sel, kind="stable")
+    rows_sorted = rows_sel[order]
+    rcounts = np.bincount(rows_sorted, minlength=cp.n_sc).astype(np.int32)
+    rstarts = np.concatenate([[0], np.cumsum(rcounts)[:-1]]).astype(np.int32)
+    rank = np.arange(order.size, dtype=np.int64) - rstarts[rows_sorted]
+    max_q = int(rcounts.max())
+    # kernel lanes need 128-multiples; the other routes take any pow2
+    # (bounds recompiles across query sets)
+    q2cap_pal = -(-max_q // 128) * 128
+    route = cp.route
+    if route == "pallas" and not pallas_fits(q2cap_pal, cp.ccap, k):
+        route = "streamed"
+    q2cap = (q2cap_pal if route == "pallas"
+             else 1 << max(3, (max_q - 1).bit_length()))
+    if route == "dense" and q2cap * cp.ccap * 4 > _DENSE_TILE_BYTES:
+        route = "streamed"  # query blob inflated the dense tile too
+    inv = (rows_sorted * q2cap + rank).astype(np.int32)
+    r_i, r_d, r_c = _query_class(
+        points, starts, counts, cp,
+        jnp.asarray(queries_sel[order]), jnp.asarray(rstarts),
+        jnp.asarray(rcounts), jnp.asarray(inv),
+        jnp.asarray(rows_sorted.astype(np.int32)), q2cap, k,
+        route, domain, cfg.interpret, cfg.stream_tile, ids_map)
+    return order, r_i, r_d, r_c
 
 
 def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
@@ -573,7 +621,6 @@ def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
     ORIGINAL indexing, ascending; (m, k) squared distances), query order.
     """
     from .gridhash import cell_coords
-    from .pallas_solve import pallas_fits
     from .query import brute_force_by_coords
 
     queries = np.ascontiguousarray(queries, np.float32)
@@ -599,33 +646,10 @@ def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
         sel = np.nonzero(qcls == ci)[0]
         if sel.size == 0:
             continue
-        order = np.argsort(qrow[sel], kind="stable")
-        sel_sorted = sel[order].astype(np.int64)
-        rows_sorted = qrow[sel_sorted]
-        rcounts = np.bincount(rows_sorted, minlength=cp.n_sc).astype(np.int32)
-        rstarts = np.concatenate([[0], np.cumsum(rcounts)[:-1]]).astype(np.int32)
-        rank = np.arange(sel.size, dtype=np.int64) - rstarts[rows_sorted]
-        max_q = int(rcounts.max())
-        # kernel lanes need 128-multiples; the other routes take any pow2
-        # (bounds recompiles across query sets).  A kernel class re-gates
-        # against VMEM with *this query set's* capacity: a query blob can
-        # exceed the budget the stored-point tile fit, in which case the
-        # class drops to its non-kernel route for this call.
-        q2cap_pal = -(-max_q // 128) * 128
-        route = cp.route
-        if route == "pallas" and not pallas_fits(q2cap_pal, cp.ccap, k):
-            route = "streamed"
-        q2cap = (q2cap_pal if route == "pallas"
-                 else 1 << max(3, (max_q - 1).bit_length()))
-        if route == "dense" and q2cap * cp.ccap * 4 > _DENSE_TILE_BYTES:
-            route = "streamed"  # query blob inflated the dense tile too
-        inv = (rows_sorted * q2cap + rank).astype(np.int32)
-        r_i, r_d, r_c = _query_class(
+        order, r_i, r_d, r_c = launch_class_query(
             grid.points, grid.cell_starts, grid.cell_counts, cp,
-            jnp.asarray(queries[sel_sorted]), jnp.asarray(rstarts),
-            jnp.asarray(rcounts), jnp.asarray(inv),
-            jnp.asarray(rows_sorted.astype(np.int32)), q2cap, k,
-            route, grid.domain, cfg.interpret, cfg.stream_tile)
+            queries[sel], qrow[sel], k, cfg, grid.domain)
+        sel_sorted = sel[order]
         out_i[sel_sorted] = np.asarray(jax.device_get(r_i))
         out_d[sel_sorted] = np.asarray(jax.device_get(r_d))
         cert[sel_sorted] = np.asarray(jax.device_get(r_c))

@@ -273,10 +273,16 @@ def _window_box_cells(sc: np.ndarray, lo_off: int, hi_off: int, s: int,
 
 @dataclasses.dataclass(frozen=True)
 class ChipPlan:
-    """One chip's static adaptive schedule (classes over its local window)."""
+    """One chip's static adaptive schedule (classes over its local window).
+
+    class_of / row_of: (n_sc_local,) host arrays mapping every chip-local
+    supercell to its class (-1 = dropped/empty) and row within that class --
+    external queries bucket through these (query()).
+    """
 
     classes: Tuple[ClassPlan, ...]
-    n_queries: int      # valid local points on this chip
+    class_of: np.ndarray
+    row_of: np.ndarray
 
 
 def _plan_chip(counts_all: np.ndarray, d: int, meta: ShardMeta,
@@ -316,6 +322,11 @@ def _plan_chip(counts_all: np.ndarray, d: int, meta: ShardMeta,
     w = meta.domain / dim
     zwin = win3.shape[0]
     classes = []
+    class_of = np.full((sc.shape[0],), -1, np.int32)
+    row_of = np.zeros((sc.shape[0],), np.int32)
+    for ci, spec in enumerate(specs):
+        class_of[spec.rows] = ci
+        row_of[spec.rows] = np.arange(spec.rows.size, dtype=np.int32)
     for spec in specs:
         sc_c = sc[spec.rows]
         own = _window_box_cells(sc_c, 0, 0, s, dim, R, zc0, zwin)
@@ -330,8 +341,26 @@ def _plan_chip(counts_all: np.ndarray, d: int, meta: ShardMeta,
             lo=jnp.asarray(lo), hi=jnp.asarray(hi),
             radius=spec.radius, qcap=spec.qcap, qcap_pad=spec.qcap_pad,
             ccap=spec.ccap, route=spec.route))
-    return ChipPlan(classes=tuple(classes),
-                    n_queries=int(win3[R: R + zcap].sum()))
+    return ChipPlan(classes=tuple(classes), class_of=class_of, row_of=row_of)
+
+
+def _assemble_ext(spts, sids, counts, lo_pts, lo_ids, lo_counts,
+                  hi_pts, hi_ids, hi_counts, hcap: int):
+    """Halo-extended point/id/CSR arrays: lower halo | local | upper halo."""
+    pcap = spts.shape[0]
+    ext_pts = jnp.concatenate([lo_pts, spts, hi_pts], axis=0)
+    ext_ids = jnp.concatenate([lo_ids, sids, hi_ids], axis=0)
+    mk_starts = lambda c: jnp.cumsum(c) - c
+    ext_starts = jnp.concatenate([
+        mk_starts(lo_counts),
+        mk_starts(counts) + hcap,
+        mk_starts(hi_counts) + hcap + pcap]).astype(jnp.int32)
+    ext_counts = jnp.concatenate([lo_counts, counts, hi_counts])
+    return ext_pts, ext_ids, ext_starts, ext_counts
+
+
+_ext_program = functools.partial(jax.jit, static_argnames=("hcap",))(
+    _assemble_ext)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
@@ -350,14 +379,9 @@ def _chip_solve(spts, sids, counts, lo_pts, lo_ids, lo_counts,
     (pcap, k) d2 ascending, (pcap,) certified), rows in local sorted order.
     """
     pcap = spts.shape[0]
-    ext_pts = jnp.concatenate([lo_pts, spts, hi_pts], axis=0)
-    ext_ids = jnp.concatenate([lo_ids, sids, hi_ids], axis=0)
-    mk_starts = lambda c: jnp.cumsum(c) - c
-    ext_starts = jnp.concatenate([
-        mk_starts(lo_counts),
-        mk_starts(counts) + hcap,
-        mk_starts(hi_counts) + hcap + pcap]).astype(jnp.int32)
-    ext_counts = jnp.concatenate([lo_counts, counts, hi_counts])
+    ext_pts, ext_ids, ext_starts, ext_counts = _assemble_ext(
+        spts, sids, counts, lo_pts, lo_ids, lo_counts, hi_pts, hi_ids,
+        hi_counts, hcap)
 
     n_ext = ext_pts.shape[0]
     flats_d, flats_i, los, his = [], [], [], []
@@ -423,6 +447,17 @@ class ShardedKnnProblem:
                                                   repr=False)
     _points_host: Optional[np.ndarray] = dataclasses.field(default=None,
                                                            repr=False)
+    _oracle_cache: Optional[object] = dataclasses.field(default=None,
+                                                        repr=False)
+
+    def _oracle(self):
+        """Host kd-tree over the full set, built once per problem (the exact
+        resolver for uncertified rows; _points_host is immutable)."""
+        if self._oracle_cache is None:
+            from ..oracle import KdTreeOracle
+
+            self._oracle_cache = KdTreeOracle(self._points_host)
+        return self._oracle_cache
 
     @classmethod
     def prepare(cls, points, n_devices: Optional[int] = None,
@@ -541,6 +576,131 @@ class ShardedKnnProblem:
                 meta.domain, cfg.interpret, cfg.stream_tile, meta.hcap)
         return outs
 
+    def query(self, queries, k: Optional[int] = None
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact kNN of arbitrary query coordinates against the sharded set.
+
+        The multi-chip twin of api.KnnProblem.query(): each query routes to
+        the chip owning its z-slab and rides that chip's class schedule over
+        the halo-extended window (a query inside a slab has its whole
+        candidate box inside that chip's window, so certificates hold
+        verbatim).  No self-exclusion; classless and uncertified rows resolve
+        exactly against the host oracle.  Single-controller, like solve().
+
+        Returns ((m, k) ids in ORIGINAL indexing, ascending; (m, k) squared
+        distances), rows in query order.
+        """
+        from ..ops.adaptive import launch_class_query
+
+        cfg, meta = self.config, self.meta
+        k = cfg.k if k is None else int(k)
+        if k > cfg.k:
+            raise ValueError(
+                f"k={k} exceeds the prepared k={cfg.k} (it sized the "
+                f"candidate dilation)")
+        chips = self.local_chips()
+        if len(chips) < meta.ndev:
+            raise RuntimeError(
+                f"query() needs all {meta.ndev} slabs addressable; this "
+                f"process sees chips {chips}")
+        queries = np.ascontiguousarray(queries, np.float32)
+        m = queries.shape[0]
+        if m == 0:
+            return (np.empty((0, k), np.int32), np.empty((0, k), np.float32))
+        dim, s = meta.dim, cfg.supercell
+        coords = np.clip((queries * (dim / meta.domain)).astype(np.int64),
+                         0, dim - 1)
+        owner = np.minimum(coords[:, 2] // meta.zcap, meta.ndev - 1)
+        n_sc_xy = -(-dim // s)
+
+        out_i = np.full((m, k), INVALID_ID, np.int32)
+        out_d = np.full((m, k), np.inf, np.float32)
+        cert = np.zeros((m,), bool)
+        for d in chips:
+            on_d = np.nonzero(owner == d)[0]
+            if on_d.size == 0:
+                continue
+            plan = self.chip_plans[d]
+            inp = self._chip_inputs(d)
+            ext_pts, ext_ids, ext_starts, ext_counts = _ext_program(
+                inp["spts"], inp["sids"], inp["counts"],
+                inp["lo_pts"], inp["lo_ids"], inp["lo_counts"],
+                inp["hi_pts"], inp["hi_ids"], inp["hi_counts"],
+                hcap=meta.hcap)
+            cc = coords[on_d]
+            scidx = ((cc[:, 2] - d * meta.zcap) // s * (n_sc_xy ** 2)
+                     + (cc[:, 1] // s) * n_sc_xy + (cc[:, 0] // s))
+            qcls = plan.class_of[scidx]
+            qrow = plan.row_of[scidx]
+            for ci, cp in enumerate(plan.classes):
+                sel = on_d[qcls == ci]
+                if sel.size == 0:
+                    continue
+                # ids_map=ext_ids translates ext indices to ORIGINAL ids on
+                # device, so readback is O(m*k) -- not the whole id block
+                order, r_i, r_d, r_c = launch_class_query(
+                    ext_pts, ext_starts, ext_counts, cp, queries[sel],
+                    qrow[qcls == ci], k, cfg, meta.domain, ids_map=ext_ids)
+                sel_sorted = sel[order]
+                out_i[sel_sorted] = np.asarray(jax.device_get(r_i))
+                out_d[sel_sorted] = np.asarray(jax.device_get(r_d))
+                cert[sel_sorted] = np.asarray(jax.device_get(r_c))
+
+        if not cert.all():
+            bad = np.nonzero(~cert)[0].astype(np.int32)
+            b_i, b_d = self._oracle().knn(queries[bad], k)  # no self-exclusion
+            out_i[bad] = b_i
+            out_d[bad] = b_d
+        return out_i, out_d
+
+    def stats(self) -> dict:
+        """Decomposition + per-chip schedule diagnostics, machine-readable --
+        the multi-chip extension of api.KnnProblem.stats() (C6 parity,
+        /root/reference/knearests.cu:440-466)."""
+        from ..utils.stats import occupancy_stats
+
+        meta = self.meta
+        chips = []
+        for d in self.local_chips():
+            counts = np.asarray(jax.device_get(self._chip_inputs(d)["counts"]))
+            plan = self.chip_plans[d]
+            chips.append({
+                "chip": d,
+                "n_points": int(counts.sum()),
+                "occupancy": occupancy_stats(counts),
+                "classes": [{"radius": cp.radius, "n_supercells": cp.n_sc,
+                             "qcap": cp.qcap, "ccap": cp.ccap,
+                             "route": cp.route} for cp in plan.classes],
+            })
+        return {
+            "n_points": self.n_points,
+            "n_devices": meta.ndev,
+            "grid_dim": meta.dim,
+            "slab_cells_z": meta.zcap,
+            "halo_depth": meta.radius,
+            "pcap": meta.pcap,
+            "hcap": meta.hcap,
+            "k": self.config.k,
+            "chips": chips,
+        }
+
+    def print_stats(self) -> dict:
+        """Human-readable decomposition dump (kn_print_stats analog)."""
+        s = self.stats()
+        print(f"grid {s['grid_dim']}^3, {s['n_points']} points over "
+              f"{s['n_devices']} chips; z-slab {s['slab_cells_z']} cells, "
+              f"halo {s['halo_depth']} cells, pcap {s['pcap']}, "
+              f"hcap {s['hcap']}")
+        for c in s["chips"]:
+            occ = c["occupancy"]
+            print(f"chip {c['chip']}: {c['n_points']} points, "
+                  f"max {occ['max_per_cell']}/cell")
+            for cl in c["classes"]:
+                print(f"  class r={cl['radius']}: {cl['n_supercells']} "
+                      f"supercells, qcap {cl['qcap']}, ccap {cl['ccap']} "
+                      f"[{cl['route']}]")
+        return s
+
     def permutation(self) -> np.ndarray:
         """Original index per storage row, concatenated chip-major -- the
         multi-chip analog of kn_get_permutation (a bijection over [0, n);
@@ -585,11 +745,8 @@ class ShardedKnnProblem:
             cert[sids[rows]] = o_c[rows]
 
         if cfg.fallback == "brute" and not cert.all():
-            from ..oracle import KdTreeOracle
-
             bad = np.nonzero(~cert)[0].astype(np.int32)
-            oracle = KdTreeOracle(self._points_host)
-            b_ids, b_d2 = oracle.knn(
+            b_ids, b_d2 = self._oracle().knn(
                 self._points_host[bad], k,
                 exclude_ids=bad if cfg.exclude_self else None)
             neighbors[bad] = b_ids

@@ -163,6 +163,36 @@ def test_per_device_footprint_scales(rng):
         assert shard.shape[0] == 1, name
 
 
+def test_sharded_query_matches_brute(blue_8k, rng):
+    """External queries against a sharded problem: routed by owning slab,
+    exact vs numpy brute force (incl. queries near slab boundaries)."""
+    from cuda_knearests_tpu.io import generate_uniform
+
+    sp = ShardedKnnProblem.prepare(blue_8k, n_devices=4, config=KnnConfig(k=8))
+    queries = generate_uniform(300, seed=41)
+    nbrs, d2 = sp.query(queries, k=8)
+    assert nbrs.shape == (300, 8)
+    for i in rng.integers(0, 300, 20):
+        dd = ((queries[i] - blue_8k) ** 2).sum(-1)
+        assert set(np.argsort(dd, kind="stable")[:8]) == set(nbrs[i].tolist()), i
+    assert (np.diff(d2, axis=1) >= 0).all()
+    with pytest.raises(ValueError, match="exceeds the prepared k"):
+        sp.query(queries, k=9)
+
+
+def test_sharded_stats(uniform_10k):
+    sp = ShardedKnnProblem.prepare(uniform_10k, n_devices=4,
+                                   config=KnnConfig(k=6))
+    s = sp.print_stats()
+    assert s["n_devices"] == 4 and s["n_points"] == len(uniform_10k)
+    assert len(s["chips"]) == 4
+    assert sum(c["n_points"] for c in s["chips"]) == len(uniform_10k)
+    for c in s["chips"]:
+        for cl in c["classes"]:
+            assert cl["route"] in ("pallas", "dense", "streamed")
+            assert cl["qcap"] >= 1 and cl["ccap"] >= 6
+
+
 def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
