@@ -1,6 +1,6 @@
 from . import distributed, sharded
 from .distributed import init_distributed, z_mesh
-from .sharded import ShardedKnnProblem
+from .sharded import ShardedKnnProblem, load_sharded, save_sharded
 
-__all__ = ["sharded", "distributed", "ShardedKnnProblem", "init_distributed",
-           "z_mesh"]
+__all__ = ["sharded", "distributed", "ShardedKnnProblem", "save_sharded",
+           "load_sharded", "init_distributed", "z_mesh"]

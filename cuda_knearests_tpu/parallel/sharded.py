@@ -428,6 +428,49 @@ def _chip_solve(spts, sids, counts, lo_pts, lo_ids, lo_counts,
     return nbr_orig, row_d, cert
 
 
+def save_sharded(problem: "ShardedKnnProblem", path: str) -> None:
+    """Checkpoint a sharded problem to one ``.npz`` ('.npz' appended when
+    missing), the multi-chip counterpart of api.save_problem.
+
+    What persists is the *input contract* -- points, config, grid dim --
+    not per-chip device state: the decomposition, build, and planning are
+    deterministic, so resume = re-prepare, which also re-binds the problem
+    to whatever mesh the resuming process has (checkpoints move freely
+    between mesh sizes and hosts)."""
+    import json
+
+    from ..api import _npz_path
+
+    path = _npz_path(path)
+    cfg = dataclasses.asdict(problem.config)
+    np.savez_compressed(
+        path,
+        points=problem._points_host,
+        dim=np.int64(problem.meta.dim),
+        n_devices=np.int64(problem.meta.ndev),
+        config_json=np.bytes_(json.dumps(
+            {k: v for k, v in cfg.items() if v is not None}).encode()))
+
+
+def load_sharded(path: str, n_devices: Optional[int] = None,
+                 mesh: Optional[Mesh] = None) -> "ShardedKnnProblem":
+    """Resume a checkpointed sharded problem (see save_sharded).  The mesh
+    defaults to the checkpoint's device count; pass ``n_devices``/``mesh``
+    to re-shard onto a different topology."""
+    import json
+
+    from ..api import _npz_path
+
+    with np.load(_npz_path(path)) as z:
+        cfg = KnnConfig(**json.loads(bytes(z["config_json"]).decode()))
+        points = z["points"]
+        dim = int(z["dim"])
+        if n_devices is None and mesh is None:
+            n_devices = int(z["n_devices"])
+    return ShardedKnnProblem.prepare(points, n_devices=n_devices,
+                                     config=cfg, mesh=mesh, dim=dim)
+
+
 @dataclasses.dataclass
 class ShardedKnnProblem:
     """Multi-chip analog of api.KnnProblem: one prepared problem over a mesh.
