@@ -170,6 +170,24 @@ def test_degenerate_through_adaptive():
         assert len(set(nbrs[r].tolist())) == 4
 
 
+def test_dense_and_streamed_routes_identical(blue_8k, monkeypatch):
+    """The two host-class solvers are interchangeable: forcing every class
+    off the dense route (byte ceiling = 0) must not change a single bit."""
+    import cuda_knearests_tpu.ops.adaptive as ad
+
+    p1 = KnnProblem.prepare(blue_8k, KnnConfig(k=9))
+    r1 = p1.solve()
+    assert all(c.route == "dense" for c in p1.aplan.classes)
+    monkeypatch.setattr(ad, "_DENSE_TILE_BYTES", 0)
+    p2 = KnnProblem.prepare(blue_8k, KnnConfig(k=9))
+    assert all(c.route == "streamed" for c in p2.aplan.classes)
+    r2 = p2.solve()
+    np.testing.assert_array_equal(np.asarray(r1.neighbors),
+                                  np.asarray(r2.neighbors))
+    np.testing.assert_array_equal(np.asarray(r1.dists_sq),
+                                  np.asarray(r2.dists_sq))
+
+
 @pytest.mark.slow
 def test_adaptive_at_scale_clustered_stays_certified():
     """Scale check (round-2 weak #6): a 200k clustered fixture keeps distinct

@@ -193,6 +193,36 @@ def test_sharded_stats(uniform_10k):
             assert cl["qcap"] >= 1 and cl["ccap"] >= 6
 
 
+@pytest.mark.slow
+def test_sharded_1m_exact_sampled():
+    """Scale exactness: 1M uniform points over 8 emulated devices, sampled
+    differential against the C++ oracle (the sharded_10m_k10 config's shape,
+    scaled to what an emulated CPU mesh can solve in minutes)."""
+    from cuda_knearests_tpu.io import generate_uniform
+    from cuda_knearests_tpu.oracle import KdTreeOracle, native_available
+
+    if not native_available():
+        pytest.skip("numpy-brute oracle fallback would need ~6 GiB at 1M")
+    n = 1_000_000
+    pts = generate_uniform(n, seed=4)
+    sp = ShardedKnnProblem.prepare(pts, n_devices=8, config=KnnConfig(k=10))
+    nbrs, d2, cert = sp.solve()
+    assert cert.all()
+    rng = np.random.default_rng(9)
+    sample = np.sort(rng.choice(n, 3000, replace=False).astype(np.int32))
+    oracle = KdTreeOracle(pts)
+    ref_ids, ref_d2 = oracle.knn(pts[sample], 10, exclude_ids=sample)
+    for row, qi in enumerate(sample):
+        if set(nbrs[qi].tolist()) == set(ref_ids[row].tolist()):
+            continue
+        # a disagreeing row is acceptable ONLY as an exact f32 tie: the
+        # engine's sorted distances must equal the oracle's
+        dd = ((pts[qi].astype(np.float64)
+               - pts[nbrs[qi]].astype(np.float64)) ** 2).sum(-1)
+        np.testing.assert_allclose(np.sort(dd), ref_d2[row].astype(np.float64),
+                                   rtol=1e-6, err_msg=f"query {qi}")
+
+
 def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
