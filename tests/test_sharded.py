@@ -193,6 +193,42 @@ def test_sharded_stats(uniform_10k):
             assert cl["qcap"] >= 1 and cl["ccap"] >= 6
 
 
+def test_sharded_degenerate_inputs():
+    """Tiny/degenerate point sets through the full mesh path: n < k, a
+    single point, identical points, and an all-one-slab distribution (7 of 8
+    chips empty) must all survive and stay exact."""
+    rng = np.random.default_rng(3)
+    # n < k and n < ndev
+    tiny = (rng.random((5, 3)) * 1000).astype(np.float32)
+    nbrs, _, cert = ShardedKnnProblem.prepare(
+        tiny, n_devices=8, config=KnnConfig(k=10)).solve()
+    assert nbrs.shape == (5, 10) and cert.all()
+    assert (nbrs[:, :4] >= 0).all() and (nbrs[:, 4:] == -1).all()
+    # single point
+    one = np.float32([[500.0, 500.0, 500.0]])
+    nbrs, _, cert = ShardedKnnProblem.prepare(
+        one, n_devices=4, config=KnnConfig(k=3)).solve()
+    assert (nbrs == -1).all() and cert.all()
+    # identical points: k neighbors each, none itself
+    same = np.full((30, 3), 777.0, np.float32)
+    nbrs, d2, cert = ShardedKnnProblem.prepare(
+        same, n_devices=4, config=KnnConfig(k=4)).solve()
+    assert cert.all() and (d2 == 0.0).all()
+    for r in range(30):
+        assert r not in nbrs[r].tolist()
+        assert len(set(nbrs[r].tolist())) == 4
+    # everything in one thin z-slab: most chips own nothing
+    slab = (rng.random((4000, 3)) * np.float32([1000, 1000, 40])).astype(
+        np.float32)
+    nbrs, _, cert = ShardedKnnProblem.prepare(
+        slab, n_devices=8, config=KnnConfig(k=5)).solve()
+    assert cert.all() and (nbrs >= 0).all()
+    q = rng.integers(0, 4000, 10)
+    ref = brute_knn_np(slab, q, 5)
+    for row, qi in enumerate(q):
+        assert set(ref[row].tolist()) == set(nbrs[qi].tolist())
+
+
 @pytest.mark.slow
 def test_sharded_1m_exact_sampled():
     """Scale exactness: 1M uniform points over 8 emulated devices, sampled
