@@ -161,12 +161,21 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=("own", "cand", "lo", "hi"),
+    data_fields=("own", "cand", "lo", "hi", "pk"),
     meta_fields=("radius", "qcap", "qcap_pad", "ccap", "route"),
 )
 @dataclasses.dataclass(frozen=True)
 class ClassPlan:
-    """Device-side schedule for one class: cell tables + certificate boxes."""
+    """Device-side schedule for one class: cell tables + certificate boxes.
+
+    ``pk`` holds the prepacked kernel inputs (q, cx, cy, cz, qid3, cid3, the
+    pallas_solve._pack_inputs layout) for pallas-routed classes.  Packing is
+    static per problem, so doing it at plan time keeps the steady-state solve
+    to kernel + epilogue -- the same prepare/solve split that took the legacy
+    path from 1879 ms to 317 ms (DESIGN.md section 2); measured on v5e, the
+    in-solve re-pack cost the adaptive path 3.3x (708 ms vs 215 ms on the
+    900k north star).  None = pack in-solve (dense/streamed routes, and the
+    sharded per-chip solve whose arrays live inside shard_map)."""
 
     own: jax.Array    # (Sc, s^3) i32, -1 pad
     cand: jax.Array   # (Sc, (s+2*radius)^3) i32, -1 pad
@@ -177,6 +186,7 @@ class ClassPlan:
     qcap_pad: int
     ccap: int
     route: str        # 'pallas' | 'dense' | 'streamed'
+    pk: tuple | None = None
 
     @property
     def use_pallas(self) -> bool:
@@ -254,17 +264,33 @@ def build_adaptive_plan(grid: GridHash, cfg: KnnConfig,
         cand = _box_cell_ids(sc_c, -spec.radius, spec.radius, s, dim)
         lo = ((sc_c * s - spec.radius) * w).astype(np.float32)
         hi = ((sc_c * s + s + spec.radius) * w).astype(np.float32)
-        classes.append(ClassPlan(
+        cp = ClassPlan(
             own=jnp.asarray(own), cand=jnp.asarray(cand),
             lo=jnp.asarray(lo), hi=jnp.asarray(hi),
             radius=spec.radius, qcap=spec.qcap, qcap_pad=spec.qcap_pad,
-            ccap=spec.ccap, route=spec.route))
+            ccap=spec.ccap, route=spec.route)
+        if spec.route == "pallas":
+            cp = dataclasses.replace(cp, pk=_prepack_kernel_inputs(
+                grid.points, grid.cell_starts, grid.cell_counts,
+                cp.own, cp.cand, cp.qcap_pad, cp.ccap))
+        classes.append(cp)
 
     inv_flat, inv_box = _invert_partition(
         tuple(classes), grid.cell_starts, grid.cell_counts, grid.n_points)
     return AdaptivePlan(classes=tuple(classes), inv_flat=inv_flat,
                         inv_box=inv_box, class_of_sc=jnp.asarray(class_of),
                         row_of_sc=jnp.asarray(row_of), n_points=grid.n_points)
+
+
+@functools.partial(jax.jit, static_argnames=("qcap", "ccap"))
+def _prepack_kernel_inputs(points, starts, counts, own, cand,
+                           qcap: int, ccap: int):
+    """Once-per-problem slot packing + coordinate gathers for one class."""
+    from .pallas_solve import _pack_inputs
+
+    _, _, q, cx, cy, cz, qid3, cid3 = _pack_inputs(
+        points, starts, counts, own, cand, qcap, ccap)
+    return q, cx, cy, cz, qid3, cid3
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
@@ -459,8 +485,11 @@ def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
     flat dists/ids, ascending -- same layout contract as _streamed_class."""
     from .pallas_solve import _pack_inputs, _pallas_topk
 
-    _, _, q, cx, cy, cz, qid3, cid3 = _pack_inputs(
-        points, starts, counts, cp.own, cp.cand, cp.qcap_pad, cp.ccap)
+    if cp.pk is not None:
+        q, cx, cy, cz, qid3, cid3 = cp.pk
+    else:
+        _, _, q, cx, cy, cz, qid3, cid3 = _pack_inputs(
+            points, starts, counts, cp.own, cp.cand, cp.qcap_pad, cp.ccap)
     out_d, out_i = _pallas_topk(q, cx, cy, cz, qid3, cid3, cp.qcap_pad,
                                 cp.ccap, k, exclude_self, interpret)
     flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
@@ -529,12 +558,17 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
     if route == "pallas":
         from .pallas_solve import _PAD_C, _PAD_Q, _pallas_topk
 
-        c_idx, c_ok = pack_cells(cp.cand, starts, counts, cp.ccap)
-        axes = points.T
-        cx, cy, cz = (jnp.take(axes[ax], c_idx, axis=0)
-                      .reshape(cp.n_sc, 1, cp.ccap) for ax in range(3))
-        cid3 = jnp.where(c_ok, c_idx, _PAD_C).astype(jnp.int32).reshape(
-            cp.n_sc, 1, cp.ccap)
+        if cp.pk is not None:
+            # candidate half of the class's prepacked self-solve inputs --
+            # identical by construction (same cand table, same ccap)
+            _, cx, cy, cz, _, cid3 = cp.pk
+        else:
+            c_idx, c_ok = pack_cells(cp.cand, starts, counts, cp.ccap)
+            axes = points.T
+            cx, cy, cz = (jnp.take(axes[ax], c_idx, axis=0)
+                          .reshape(cp.n_sc, 1, cp.ccap) for ax in range(3))
+            cid3 = jnp.where(c_ok, c_idx, _PAD_C).astype(jnp.int32).reshape(
+                cp.n_sc, 1, cp.ccap)
         qid3 = jnp.full((cp.n_sc, 1, q2cap), _PAD_Q, jnp.int32)
         out_d, out_i = _pallas_topk(q, cx, cy, cz, qid3, cid3, q2cap, cp.ccap,
                                     k, False, interpret)

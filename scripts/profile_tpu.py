@@ -1,0 +1,65 @@
+"""One-off TPU profiling: adaptive vs legacy solve on the 900k north star.
+
+Run on the live chip:  python scripts/profile_tpu.py
+"""
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.getcwd())  # PYTHONPATH breaks axon plugin discovery
+
+import jax
+import numpy as np
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.io import get_dataset
+
+
+def steady(fn, iters=5):
+    fn()  # warmup/compile
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def run(tag, cfg, points):
+    t0 = time.perf_counter()
+    p = KnnProblem.prepare(points, cfg)
+    jax.block_until_ready(jax.tree_util.tree_leaves(
+        (p.grid.points, p.aplan, p.plan)))
+    prep_s = time.perf_counter() - t0
+
+    def s():
+        res = p.solve()
+        jax.block_until_ready((res.neighbors, res.dists_sq, res.certified))
+
+    sol = steady(s)
+    n = points.shape[0]
+    extra = ""
+    if p.aplan is not None:
+        extra = " classes=" + ",".join(
+            f"{c.route}(r={c.radius},Sc={c.n_sc},q={c.qcap_pad},c={c.ccap})"
+            for c in p.aplan.classes)
+    cert = float(np.asarray(p.result.certified).mean())
+    print(f"{tag}: prepare {prep_s:.3f}s solve {sol * 1e3:.1f}ms "
+          f"qps {n / sol / 1e6:.3f}M cert {cert:.4f}{extra}", flush=True)
+
+
+def main():
+    points = get_dataset("900k_blue_cube.xyz")
+    print(f"platform={jax.devices()[0].platform} n={points.shape[0]}",
+          flush=True)
+    base = KnnConfig(k=10)
+    run("adaptive sc3 (default)", base, points)
+    run("legacy   sc3", dataclasses.replace(base, adaptive=False), points)
+    run("legacy   sc4", dataclasses.replace(base, adaptive=False, supercell=4),
+        points)
+    run("adaptive sc4", dataclasses.replace(base, supercell=4), points)
+
+
+if __name__ == "__main__":
+    main()
