@@ -78,6 +78,27 @@ def select_radii(points_cum: np.ndarray, cells_cum: np.ndarray, k: int,
 _DENSE_TILE_BYTES = 128 << 20
 
 
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("qx", "qy", "qz", "cx", "cy", "cz", "qid3", "cid3"),
+    meta_fields=(),
+)
+@dataclasses.dataclass(frozen=True)
+class ClassPack:
+    """Prepacked kernel inputs for one pallas-routed class (the named twin of
+    pallas_solve._pack_inputs' tail): per-axis (Sc, 1, qcap)/(Sc, 1, ccap)
+    coordinate lane blocks + slot-id blocks."""
+
+    qx: jax.Array
+    qy: jax.Array
+    qz: jax.Array
+    cx: jax.Array
+    cy: jax.Array
+    cz: jax.Array
+    qid3: jax.Array
+    cid3: jax.Array
+
+
 @dataclasses.dataclass(frozen=True)
 class ClassSpec:
     """Host-side description of one capacity class (all-static)."""
@@ -168,8 +189,8 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
 class ClassPlan:
     """Device-side schedule for one class: cell tables + certificate boxes.
 
-    ``pk`` holds the prepacked kernel inputs (q, cx, cy, cz, qid3, cid3, the
-    pallas_solve._pack_inputs layout) for pallas-routed classes.  Packing is
+    ``pk`` holds the prepacked kernel inputs (a ClassPack) for pallas-routed
+    classes.  Packing is
     static per problem, so doing it at plan time keeps the steady-state solve
     to kernel + epilogue -- the same prepare/solve split that took the legacy
     path from 1879 ms to 317 ms (DESIGN.md section 2); measured on v5e, the
@@ -186,7 +207,7 @@ class ClassPlan:
     qcap_pad: int
     ccap: int
     route: str        # 'pallas' | 'dense' | 'streamed'
-    pk: tuple | None = None
+    pk: "ClassPack | None" = None
 
     @property
     def use_pallas(self) -> bool:
@@ -288,9 +309,10 @@ def _prepack_kernel_inputs(points, starts, counts, own, cand,
     """Once-per-problem slot packing + coordinate gathers for one class."""
     from .pallas_solve import _pack_inputs
 
-    _, _, q, cx, cy, cz, qid3, cid3 = _pack_inputs(
+    _, _, qx, qy, qz, cx, cy, cz, qid3, cid3 = _pack_inputs(
         points, starts, counts, own, cand, qcap, ccap)
-    return q, cx, cy, cz, qid3, cid3
+    return ClassPack(qx=qx, qy=qy, qz=qz, cx=cx, cy=cy, cz=cz,
+                     qid3=qid3, cid3=cid3)
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
@@ -486,12 +508,15 @@ def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
     from .pallas_solve import _pack_inputs, _pallas_topk
 
     if cp.pk is not None:
-        q, cx, cy, cz, qid3, cid3 = cp.pk
+        pk = cp.pk
+        qx, qy, qz, cx, cy, cz = pk.qx, pk.qy, pk.qz, pk.cx, pk.cy, pk.cz
+        qid3, cid3 = pk.qid3, pk.cid3
     else:
-        _, _, q, cx, cy, cz, qid3, cid3 = _pack_inputs(
+        _, _, qx, qy, qz, cx, cy, cz, qid3, cid3 = _pack_inputs(
             points, starts, counts, cp.own, cp.cand, cp.qcap_pad, cp.ccap)
-    out_d, out_i = _pallas_topk(q, cx, cy, cz, qid3, cid3, cp.qcap_pad,
-                                cp.ccap, k, exclude_self, interpret)
+    out_d, out_i = _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3,
+                                cp.qcap_pad, cp.ccap, k, exclude_self,
+                                interpret)
     flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
     flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)
     return flat_d, flat_i
@@ -554,14 +579,14 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
     slots = jnp.arange(q2cap, dtype=jnp.int32)
     qs_idx = rstarts[:, None] + slots[None, :]               # (Sc, q2cap)
     qs_ok = slots[None, :] < rcounts[:, None]
-    q = jnp.take(qsorted, jnp.where(qs_ok, qs_idx, 0), axis=0)
+    safe_qs = jnp.where(qs_ok, qs_idx, 0)
     if route == "pallas":
         from .pallas_solve import _PAD_C, _PAD_Q, _pallas_topk
 
         if cp.pk is not None:
             # candidate half of the class's prepacked self-solve inputs --
             # identical by construction (same cand table, same ccap)
-            _, cx, cy, cz, _, cid3 = cp.pk
+            cx, cy, cz, cid3 = cp.pk.cx, cp.pk.cy, cp.pk.cz, cp.pk.cid3
         else:
             c_idx, c_ok = pack_cells(cp.cand, starts, counts, cp.ccap)
             axes = points.T
@@ -569,15 +594,21 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                           .reshape(cp.n_sc, 1, cp.ccap) for ax in range(3))
             cid3 = jnp.where(c_ok, c_idx, _PAD_C).astype(jnp.int32).reshape(
                 cp.n_sc, 1, cp.ccap)
+        # per-axis query lane blocks, same layout rationale as _pack_inputs
+        qaxes = qsorted.T
+        qxq, qyq, qzq = (jnp.take(qaxes[ax], safe_qs, axis=0)
+                         .reshape(cp.n_sc, 1, q2cap) for ax in range(3))
         qid3 = jnp.full((cp.n_sc, 1, q2cap), _PAD_Q, jnp.int32)
-        out_d, out_i = _pallas_topk(q, cx, cy, cz, qid3, cid3, q2cap, cp.ccap,
-                                    k, False, interpret)
+        out_d, out_i = _pallas_topk(qxq, qyq, qzq, cx, cy, cz, qid3, cid3,
+                                    q2cap, cp.ccap, k, False, interpret)
         flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
         flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)
     elif route == "dense":
+        q = jnp.take(qsorted, safe_qs, axis=0)
         flat_d, flat_i = _dense_query_topk(points, starts, counts, cp.cand,
                                            q, qs_ok, k, cp.ccap)
     else:
+        q = jnp.take(qsorted, safe_qs, axis=0)
         q_excl = jnp.full((cp.n_sc, q2cap), -2, jnp.int32)   # exclude nothing
         flat_d, flat_i = _streamed_topk(points, starts, counts, cp.cand,
                                         q, qs_ok, q_excl, k, cp.ccap, tile)

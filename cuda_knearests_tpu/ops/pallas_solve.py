@@ -55,15 +55,19 @@ _UNROLL_K_MAX = 64
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=("q", "cx", "cy", "cz", "qid3", "cid3", "q_idx", "q_ok",
-                 "lo", "hi", "inv_flat", "inv_sc"),
+    data_fields=("qx", "qy", "qz", "cx", "cy", "cz", "qid3", "cid3",
+                 "q_idx", "q_ok", "lo", "hi", "inv_flat", "inv_sc"),
     meta_fields=("qcap", "ccap", "s_total"),
 )
 @dataclasses.dataclass(frozen=True)
 class PallasPack:
     """Static per-problem kernel inputs: packed CSR slots + gathered coords.
 
-    q:        (S, qcap, 3) f32 query coords per supercell (pad rows garbage).
+    qx/qy/qz: (S, 1, qcap) f32 query coords, one lane block per axis (pad
+              slots garbage).  Per-axis like the candidates: a (S, qcap, 3)
+              block would put 3 on the TPU lane axis and pad it to 128 --
+              a measured 42.7x HBM expansion that OOMed the 10M-point
+              single-chip solve (2 x 7.63 GB of padding for 183 MB of data).
     cx/cy/cz: (S, 1, ccap) f32 candidate coords, one lane block per axis.
     qid3:     (S, 1, qcap) i32 stored-point id per query slot (_PAD_Q pads).
     cid3:     (S, 1, ccap) i32 stored-point id per candidate slot (_PAD_C pads).
@@ -77,7 +81,9 @@ class PallasPack:
     inv_sc:   (n,) i32 -- inv_flat // qcap (the owning supercell per point).
     """
 
-    q: jax.Array
+    qx: jax.Array
+    qy: jax.Array
+    qz: jax.Array
     cx: jax.Array
     cy: jax.Array
     cz: jax.Array
@@ -94,10 +100,10 @@ class PallasPack:
     s_total: int
 
 
-def _kernel(q_ref, cx_ref, cy_ref, cz_ref, qid_ref, cid_ref,
+def _kernel(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref, cid_ref,
             out_d_ref, out_i_ref, *, k: int, exclude_self: bool):
-    """One supercell: (Q,3) queries x (1,C) candidate axes -> ascending (k,Q)
-    best distances and stored-point ids.
+    """One supercell: per-axis (1,Q) query x (1,C) candidate lane blocks ->
+    ascending (k,Q) best distances and stored-point ids.
 
     Padded candidate lanes carry garbage coordinates; they are masked here by
     their _PAD_C id (cheaper than a FAR-coordinate fill pass over HBM).  The
@@ -108,8 +114,8 @@ def _kernel(q_ref, cx_ref, cy_ref, cz_ref, qid_ref, cid_ref,
     """
     d2 = None
     # same x,y,z accumulation order as knearests.cu:125
-    for ax, c_ref in enumerate((cx_ref, cy_ref, cz_ref)):
-        qa = q_ref[0, :, ax].reshape(-1, 1)   # (Q, 1)
+    for q_ref, c_ref in ((qx_ref, cx_ref), (qy_ref, cy_ref), (qz_ref, cz_ref)):
+        qa = q_ref[0, 0, :].reshape(-1, 1)    # (Q, 1)
         ca = c_ref[0, 0, :].reshape(1, -1)    # (1, C)
         diff = qa - ca
         d2 = diff * diff if d2 is None else d2 + diff * diff
@@ -150,7 +156,7 @@ def vmem_bytes_estimate(qcap: int, ccap: int, k: int) -> int:
     q_pad = -(-qcap // 128) * 128
     k_pad = -(-k // 8) * 8
     tile = q_pad * ccap                       # d2 (+ the masked copy is fused)
-    inputs = q_pad * 128 + 8 * ccap + q_pad + ccap
+    inputs = 4 * q_pad + 4 * ccap             # 3 coord blocks + 1 id block each
     outputs = 2 * k_pad * q_pad
     return 4 * (2 * tile + inputs + outputs)
 
@@ -159,16 +165,20 @@ def pallas_fits(qcap: int, ccap: int, k: int) -> bool:
     return vmem_bytes_estimate(qcap, ccap, k) <= _VMEM_BUDGET
 
 
-def _pallas_topk(q, cx, cy, cz, qid3, cid3, qcap: int, ccap: int, k: int,
-                 exclude_self: bool, interpret: bool):
+def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
+                 k: int, exclude_self: bool, interpret: bool):
     """Launch the kernel over a flat supercell grid.  Returns ((S,k,Q) dists,
     (S,k,Q) ids) -- raw, untransposed."""
-    s_total = q.shape[0]
+    s_total = qx.shape[0]
     return pl.pallas_call(
         functools.partial(_kernel, k=k, exclude_self=exclude_self),
         grid=(s_total,),
         in_specs=[
-            pl.BlockSpec((1, qcap, 3), lambda b: (b, 0, 0),
+            pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -192,7 +202,7 @@ def _pallas_topk(q, cx, cy, cz, qid3, cid3, qcap: int, ccap: int, k: int,
             jax.ShapeDtypeStruct((s_total, k, qcap), jnp.int32),
         ],
         interpret=interpret,
-    )(q, cx, cy, cz, qid3, cid3)
+    )(qx, qy, qz, cx, cy, cz, qid3, cid3)
 
 
 def _pack_inputs(points: jax.Array, starts: jax.Array, counts: jax.Array,
@@ -201,8 +211,8 @@ def _pack_inputs(points: jax.Array, starts: jax.Array, counts: jax.Array,
     in kernel layout.  Single source of truth for the packing contract, used
     by build_pack (cached single-chip) and the adaptive class solvers.
 
-    Returns (q_idx, q_ok, q, cx, cy, cz, qid3, cid3) with qcap rounded to the
-    output lane multiple (128)."""
+    Returns (q_idx, q_ok, qx, qy, qz, cx, cy, cz, qid3, cid3) with qcap
+    rounded to the output lane multiple (128)."""
     s_total = own.shape[0]
     qcap = -(-qcap // 128) * 128
     q_idx, q_ok = pack_cells(own, starts, counts, qcap)
@@ -210,18 +220,19 @@ def _pack_inputs(points: jax.Array, starts: jax.Array, counts: jax.Array,
     # Pad rows keep garbage (point-0) coords on both sides: padded candidates
     # are masked inside the kernel by their _PAD_C id, and padded query rows
     # are dropped by the q_ok scatter in the epilogue -- no FAR fill passes.
-    q = jnp.take(points, q_idx, axis=0)
-    # Candidate coordinates one axis at a time as (S, 1, C): the lane axis (C)
-    # never moves -- no 100-MB-scale transpose pass -- and each fits the TPU
-    # block-shape rules.
+    # Coordinates one axis at a time as (S, 1, cap) on BOTH sides: the slot
+    # axis stays on the 128-lane dimension, so there is no transpose pass and
+    # no 3-wide minor axis for the TPU tiler to pad 42.7x (see PallasPack).
     axes = points.T  # (3, n)
+    qx, qy, qz = (jnp.take(axes[ax], q_idx, axis=0).reshape(s_total, 1, qcap)
+                  for ax in range(3))
     cx, cy, cz = (jnp.take(axes[ax], c_idx, axis=0).reshape(s_total, 1, ccap)
                   for ax in range(3))
     qid3 = jnp.where(q_ok, q_idx, _PAD_Q).astype(jnp.int32).reshape(
         s_total, 1, qcap)
     cid3 = jnp.where(c_ok, c_idx, _PAD_C).astype(jnp.int32).reshape(
         s_total, 1, ccap)
-    return q_idx, q_ok, q, cx, cy, cz, qid3, cid3
+    return q_idx, q_ok, qx, qy, qz, cx, cy, cz, qid3, cid3
 
 
 @jax.jit
@@ -231,18 +242,18 @@ def build_pack(points: jax.Array, starts: jax.Array, counts: jax.Array,
     s_total = plan.n_chunks * plan.batch
     own = plan.own_cells.reshape(s_total, -1)
     cand = plan.cand_cells.reshape(s_total, -1)
-    q_idx, q_ok, q, cx, cy, cz, qid3, cid3 = _pack_inputs(
+    q_idx, q_ok, qx, qy, qz, cx, cy, cz, qid3, cid3 = _pack_inputs(
         points, starts, counts, own, cand, plan.qcap, plan.ccap)
     # Invert the slot partition once at prepare time (every stored point owns
     # exactly one valid slot), so steady-state solves gather instead of
     # scatter.  This is the only scatter left, and it runs once per problem.
     n = points.shape[0]
-    qcap = q.shape[1]
+    qcap = qx.shape[2]
     flat_ids = jnp.arange(s_total * qcap, dtype=jnp.int32)
     safe = jnp.where(q_ok.reshape(-1), q_idx.reshape(-1), n)
     inv_flat = jnp.zeros((n,), jnp.int32).at[safe].set(flat_ids, mode="drop")
     return PallasPack(
-        q=q, cx=cx, cy=cy, cz=cz, qid3=qid3, cid3=cid3,
+        qx=qx, qy=qy, qz=qz, cx=cx, cy=cy, cz=cz, qid3=qid3, cid3=cid3,
         q_idx=q_idx, q_ok=q_ok,
         lo=plan.box_lo.reshape(s_total, 3), hi=plan.box_hi.reshape(s_total, 3),
         inv_flat=inv_flat, inv_sc=inv_flat // qcap,
@@ -261,7 +272,8 @@ def _solve_packed(pack: PallasPack, points: jax.Array, k: int,
     (smaller than the padded (S, Q, k) block), and the query coordinate of
     sorted row r is just points[r] -- no scatter, no padded-side compute.
     """
-    out_d, out_i = _pallas_topk(pack.q, pack.cx, pack.cy, pack.cz,
+    out_d, out_i = _pallas_topk(pack.qx, pack.qy, pack.qz,
+                                pack.cx, pack.cy, pack.cz,
                                 pack.qid3, pack.cid3, pack.qcap, pack.ccap, k,
                                 exclude_self, interpret)
 

@@ -77,12 +77,18 @@ def _query_packed(queries_sorted: jax.Array, sc_starts: jax.Array,
     slots = jnp.arange(q2cap, dtype=jnp.int32)
     qs_idx = sc_starts[:, None] + slots[None, :]
     qs_ok = slots[None, :] < sc_counts[:, None]
-    q = jnp.take(queries_sorted, jnp.where(qs_ok, qs_idx, 0), axis=0)
+    safe_qs = jnp.where(qs_ok, qs_idx, 0)
+    # per-axis (S, 1, q2cap) lane blocks, like the pack's candidates -- a
+    # (S, q2cap, 3) gather would put 3 on the TPU lane axis (42.7x padding)
+    qaxes = queries_sorted.T
+    qx, qy, qz = (jnp.take(qaxes[ax], safe_qs, axis=0)
+                  .reshape(s_total, 1, q2cap) for ax in range(3))
     # exclude_self is by *stored index*; external queries have none, so the id
     # block is all-_PAD_Q and exclusion is compiled out.
     qid3 = jnp.full((s_total, 1, q2cap), _PAD_Q, jnp.int32)
 
-    out_d, out_i = _pallas_topk(q, pack.cx, pack.cy, pack.cz, qid3, pack.cid3,
+    out_d, out_i = _pallas_topk(qx, qy, qz, pack.cx, pack.cy, pack.cz,
+                                qid3, pack.cid3,
                                 q2cap, pack.ccap, k, exclude_hint, interpret)
     flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
     flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)

@@ -108,10 +108,10 @@ def test_clustered_exact_and_certified():
 
 
 def test_adaptive_matches_legacy_xla(blue_8k):
-    pa = KnnProblem.prepare(blue_8k, KnnConfig(k=12))
+    pa = KnnProblem.prepare(blue_8k, KnnConfig(k=9))
     pa.solve()
-    px = KnnProblem.prepare(blue_8k, KnnConfig(k=12, adaptive=False,
-                                               backend="xla"))
+    px = KnnProblem.prepare(blue_8k, KnnConfig(k=9, adaptive=False,
+                                              backend="xla"))
     px.solve()
     assert np.array_equal(pa.get_knearests_original(),
                           px.get_knearests_original())
@@ -119,10 +119,10 @@ def test_adaptive_matches_legacy_xla(blue_8k):
 
 def test_interpret_kernel_classes_match_streamed(blue_8k):
     """Same data, kernel classes (interpret) vs streamed classes: identical."""
-    pk = KnnProblem.prepare(blue_8k, KnnConfig(k=7, interpret=True))
+    pk = KnnProblem.prepare(blue_8k, KnnConfig(k=9, interpret=True))
     pk.solve()
     assert any(c.use_pallas for c in pk.aplan.classes)
-    ps = KnnProblem.prepare(blue_8k, KnnConfig(k=7))  # cpu: streamed
+    ps = KnnProblem.prepare(blue_8k, KnnConfig(k=9))  # cpu: streamed
     ps.solve()
     assert not any(c.use_pallas for c in ps.aplan.classes)
     assert np.array_equal(pk.get_knearests_original(),

@@ -18,7 +18,7 @@ def test_sharded_checkpoint_roundtrip(blue_8k, tmp_path):
     """Sharded resume: the checkpoint carries the input contract; re-prepare
     is deterministic, so resumed results match -- including onto a different
     mesh size."""
-    cfg = KnnConfig(k=6)
+    cfg = KnnConfig(k=10)
     p1 = ShardedKnnProblem.prepare(blue_8k, n_devices=4, config=cfg)
     n1, d1, c1 = p1.solve()
     path = str(tmp_path / "shard_ckpt")

@@ -44,7 +44,7 @@ def test_grid_csr_invariants(data):
     assert (pos < starts[cids_sorted] + counts[cids_sorted]).all()
 
 
-@settings(max_examples=10, deadline=None)
+@settings(max_examples=6, deadline=None)
 @given(st.data())
 def test_solve_selects_true_nearest_distances(data):
     """Selection correctness under ties/duplicates: the sorted distance rows

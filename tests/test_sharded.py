@@ -31,7 +31,7 @@ def test_halo_too_deep_raises(uniform_10k):
                                   config=KnnConfig(k=10, ring_radius=30))
 
 
-@pytest.mark.parametrize("ndev", [1, 2, 8])
+@pytest.mark.parametrize("ndev", [1, 8])
 def test_sharded_matches_single_chip(uniform_10k, ndev):
     cfg = KnnConfig(k=10)
     sp = ShardedKnnProblem.prepare(uniform_10k, n_devices=ndev, config=cfg)
@@ -45,11 +45,11 @@ def test_sharded_matches_single_chip(uniform_10k, ndev):
 
 
 def test_sharded_exact_vs_brute(blue_8k, rng):
-    sp = ShardedKnnProblem.prepare(blue_8k, n_devices=8, config=KnnConfig(k=15))
+    sp = ShardedKnnProblem.prepare(blue_8k, n_devices=8, config=KnnConfig(k=10))
     nbrs, d2, cert = sp.solve()
     assert cert.all()
     q = rng.integers(0, len(blue_8k), 48)
-    ref = brute_knn_np(blue_8k, q, 15)
+    ref = brute_knn_np(blue_8k, q, 10)
     for row, qi in enumerate(q):
         assert set(ref[row].tolist()) == set(nbrs[qi].tolist())
     assert (np.diff(d2, axis=1) >= 0).all()
@@ -59,7 +59,7 @@ def test_sharded_boundary_queries_certified(uniform_10k):
     """Queries in slab-face cells are the ones that need the halo; with halo
     depth == ring radius they must certify at the same rate as the interior
     (here: all of them)."""
-    cfg = KnnConfig(k=6)
+    cfg = KnnConfig(k=10)
     sp = ShardedKnnProblem.prepare(uniform_10k, n_devices=4, config=cfg)
     nbrs, d2, cert = sp.solve()
     assert cert.all()
@@ -72,9 +72,9 @@ def test_sharded_pallas_matches_xla(blue_8k):
     chunked XLA scan bit-for-bit, including halo-crossing neighbors."""
     cfg_x = KnnConfig(k=8, sc_batch=16, backend="xla")
     cfg_p = KnnConfig(k=8, sc_batch=16, backend="pallas", interpret=True)
-    nx, dx, cx = ShardedKnnProblem.prepare(blue_8k, n_devices=8,
+    nx, dx, cx = ShardedKnnProblem.prepare(blue_8k, n_devices=2,
                                            config=cfg_x).solve()
-    np_, dp, cp = ShardedKnnProblem.prepare(blue_8k, n_devices=8,
+    np_, dp, cp = ShardedKnnProblem.prepare(blue_8k, n_devices=2,
                                             config=cfg_p).solve()
     np.testing.assert_array_equal(nx, np_)
     np.testing.assert_array_equal(dx, dp)
@@ -87,7 +87,7 @@ def test_distributed_helpers_and_custom_mesh(blue_8k):
     init_distributed()  # single-process: must be a safe no-op
     mesh = z_mesh()
     assert mesh.devices.size == 8 and mesh.axis_names == ("z",)
-    sp = ShardedKnnProblem.prepare(blue_8k, mesh=mesh, config=KnnConfig(k=6))
+    sp = ShardedKnnProblem.prepare(blue_8k, mesh=mesh, config=KnnConfig(k=10))
     nbrs, d2, cert = sp.solve()
     assert cert.all() and (nbrs >= 0).all()
 
@@ -168,21 +168,21 @@ def test_sharded_query_matches_brute(blue_8k, rng):
     exact vs numpy brute force (incl. queries near slab boundaries)."""
     from cuda_knearests_tpu.io import generate_uniform
 
-    sp = ShardedKnnProblem.prepare(blue_8k, n_devices=4, config=KnnConfig(k=8))
+    sp = ShardedKnnProblem.prepare(blue_8k, n_devices=4, config=KnnConfig(k=10))
     queries = generate_uniform(300, seed=41)
-    nbrs, d2 = sp.query(queries, k=8)
-    assert nbrs.shape == (300, 8)
+    nbrs, d2 = sp.query(queries, k=10)
+    assert nbrs.shape == (300, 10)
     for i in rng.integers(0, 300, 20):
         dd = ((queries[i] - blue_8k) ** 2).sum(-1)
-        assert set(np.argsort(dd, kind="stable")[:8]) == set(nbrs[i].tolist()), i
+        assert set(np.argsort(dd, kind="stable")[:10]) == set(nbrs[i].tolist()), i
     assert (np.diff(d2, axis=1) >= 0).all()
     with pytest.raises(ValueError, match="exceeds the prepared k"):
-        sp.query(queries, k=9)
+        sp.query(queries, k=11)
 
 
 def test_sharded_stats(uniform_10k):
     sp = ShardedKnnProblem.prepare(uniform_10k, n_devices=4,
-                                   config=KnnConfig(k=6))
+                                   config=KnnConfig(k=10))
     s = sp.print_stats()
     assert s["n_devices"] == 4 and s["n_points"] == len(uniform_10k)
     assert len(s["chips"]) == 4
@@ -190,7 +190,7 @@ def test_sharded_stats(uniform_10k):
     for c in s["chips"]:
         for cl in c["classes"]:
             assert cl["route"] in ("pallas", "dense", "streamed")
-            assert cl["qcap"] >= 1 and cl["ccap"] >= 6
+            assert cl["qcap"] >= 1 and cl["ccap"] >= 10
 
 
 def test_sharded_degenerate_inputs():
@@ -262,3 +262,51 @@ def test_sharded_1m_exact_sampled():
 def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
+
+
+# -- slow-profile coverage restorations --------------------------------------
+# The default profile unified k/ndev across tests so compile caches are
+# shared (suite-time budget, VERDICT round-2 item 7); the dropped
+# configurations stay on record here and run with `pytest -m slow` / `-m ""`.
+
+@pytest.mark.slow
+def test_sharded_matches_single_chip_middle_mesh(uniform_10k):
+    """ndev=2: the two-slab halo topology (each chip has exactly one
+    neighbor) dropped from the default parametrization."""
+    cfg = KnnConfig(k=10)
+    sp = ShardedKnnProblem.prepare(uniform_10k, n_devices=2, config=cfg)
+    nbrs, d2, cert = sp.solve()
+    assert cert.all()
+    p = KnnProblem.prepare(uniform_10k, cfg)
+    p.solve()
+    ref = p.get_knearests_original()
+    for i in range(0, len(uniform_10k), 97):
+        assert set(ref[i].tolist()) == set(nbrs[i].tolist()), f"point {i}"
+
+
+@pytest.mark.slow
+def test_sharded_pallas_matches_xla_full_mesh(blue_8k):
+    """8-device variant of the kernel-vs-XLA bit-for-bit equivalence (the
+    default profile runs it at 2 devices)."""
+    cfg_x = KnnConfig(k=8, sc_batch=16, backend="xla")
+    cfg_p = KnnConfig(k=8, sc_batch=16, backend="pallas", interpret=True)
+    nx, dx, cx = ShardedKnnProblem.prepare(blue_8k, n_devices=8,
+                                           config=cfg_x).solve()
+    np_, dp, cp = ShardedKnnProblem.prepare(blue_8k, n_devices=8,
+                                            config=cfg_p).solve()
+    np.testing.assert_array_equal(nx, np_)
+    np.testing.assert_array_equal(dx, dp)
+    assert cx.all() and cp.all()
+
+
+@pytest.mark.slow
+def test_sharded_exact_vs_brute_large_k(blue_8k, rng):
+    """k=15 (> the unified default 10) against numpy brute force."""
+    sp = ShardedKnnProblem.prepare(blue_8k, n_devices=8,
+                                   config=KnnConfig(k=15))
+    nbrs, d2, cert = sp.solve()
+    assert cert.all()
+    q = rng.integers(0, len(blue_8k), 48)
+    ref = brute_knn_np(blue_8k, q, 15)
+    for row, qi in enumerate(q):
+        assert set(ref[row].tolist()) == set(nbrs[qi].tolist())
