@@ -195,8 +195,9 @@ class ClassPlan:
     to kernel + epilogue -- the same prepare/solve split that took the legacy
     path from 1879 ms to 317 ms (DESIGN.md section 2); measured on v5e, the
     in-solve re-pack cost the adaptive path 3.3x (708 ms vs 215 ms on the
-    900k north star).  None = pack in-solve (dense/streamed routes, and the
-    sharded per-chip solve whose arrays live inside shard_map)."""
+    900k north star).  None = pack in-solve (dense/streamed routes; the
+    sharded engine prepacks per chip in _chip_ready_state against the
+    halo-extended arrays)."""
 
     own: jax.Array    # (Sc, s^3) i32, -1 pad
     cand: jax.Array   # (Sc, (s+2*radius)^3) i32, -1 pad

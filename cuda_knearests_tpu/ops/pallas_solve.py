@@ -156,7 +156,9 @@ def vmem_bytes_estimate(qcap: int, ccap: int, k: int) -> int:
     q_pad = -(-qcap // 128) * 128
     k_pad = -(-k // 8) * 8
     tile = q_pad * ccap                       # d2 (+ the masked copy is fused)
-    inputs = 4 * q_pad + 4 * ccap             # 3 coord blocks + 1 id block each
+    # 3 coord + 1 id block per side, each a (1, 1, N) VMEM tile occupying
+    # 8 sublanes x N lanes
+    inputs = 4 * 8 * q_pad + 4 * 8 * ccap
     outputs = 2 * k_pad * q_pad
     return 4 * (2 * tile + inputs + outputs)
 

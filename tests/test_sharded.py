@@ -310,3 +310,18 @@ def test_sharded_exact_vs_brute_large_k(blue_8k, rng):
     ref = brute_knn_np(blue_8k, q, 15)
     for row, qi in enumerate(q):
         assert set(ref[row].tolist()) == set(nbrs[qi].tolist())
+
+
+def test_query_on_empty_slab_chip():
+    """A query whose owner chip has an empty class schedule (no points in
+    that slab) must resolve exactly via the oracle, not crash."""
+    rng = np.random.default_rng(21)
+    pts = rng.random((4000, 3)).astype(np.float32) * [1000.0, 1000.0, 180.0]
+    pts = np.clip(pts, 0.0, 1000.0).astype(np.float32)
+    sp = ShardedKnnProblem.prepare(pts, n_devices=4, config=KnnConfig(k=10))
+    q = np.float32([[500.0, 500.0, 900.0], [10.0, 10.0, 50.0]])
+    ids, d2 = sp.query(q, k=10)
+    for j in range(2):
+        dd = ((q[j] - pts) ** 2).sum(-1)
+        assert set(ids[j].tolist()) == set(
+            np.argsort(dd, kind="stable")[:10].tolist()), j
