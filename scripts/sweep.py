@@ -31,9 +31,13 @@ for method, sc, batch in itertools.product(["diff", "dot"], [4, 6, 8], [64, 256]
             block((res.neighbors, res.dists_sq))
             times.append(time.perf_counter() - t0)
         s = min(times)
+        caps = (f"qcap={problem.plan.qcap} ccap={problem.plan.ccap} "
+                f"chunks={problem.plan.n_chunks}" if problem.plan else
+                "classes=" + ",".join(
+                    f"{c.route}:{c.qcap_pad}x{c.ccap}"
+                    for c in problem.aplan.classes))
         print(f"method={method} sc={sc} batch={batch}: solve={s*1e3:8.1f} ms "
-              f"qps={n/s:10.0f} prep={prep_s*1e3:6.0f} ms "
-              f"qcap={problem.plan.qcap} ccap={problem.plan.ccap} "
-              f"chunks={problem.plan.n_chunks} cert={float(np.asarray(res.certified).mean()):.4f}")
+              f"qps={n/s:10.0f} prep={prep_s*1e3:6.0f} ms {caps} "
+              f"cert={float(np.asarray(res.certified).mean()):.4f}")
     except Exception as e:  # noqa: BLE001
         print(f"method={method} sc={sc} batch={batch}: FAILED {type(e).__name__}: {e}")
