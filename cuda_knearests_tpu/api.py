@@ -36,6 +36,36 @@ from .utils import stats as _stats
 from .utils.memory import from_device
 
 
+def radius_mask_from_knn(ids: np.ndarray, d2: np.ndarray, radius: float,
+                         cap: int):
+    """Shared tail of the query_radius surfaces (single-chip and sharded):
+    mask exact k-NN rows beyond ``radius``.  The k-NN rows are globally exact,
+    so the mask is exact for any radius; the only possible incompleteness is
+    the cap itself, flagged per query via ``truncated``.  Returns (ids with
+    -1 beyond count, d2 with inf beyond, counts, truncated)."""
+    in_range = d2 <= np.float32(radius) ** 2
+    counts = in_range.sum(axis=1).astype(np.int32)
+    truncated = counts >= cap
+    return (np.where(in_range, ids, -1), np.where(in_range, d2, np.inf),
+            counts, truncated)
+
+
+def edges_from_neighbors(nbrs: np.ndarray, symmetric: bool = False
+                         ) -> np.ndarray:
+    """(n, k) neighbor table (original ids, -1 = none) -> COO edge list
+    (E, 2).  ``symmetric`` adds reverse edges and deduplicates.  Shared by
+    the single-chip and sharded get_edges surfaces."""
+    n, k = nbrs.shape
+    src = np.repeat(np.arange(n, dtype=np.int32), k)
+    dst = nbrs.reshape(-1)
+    keep = dst >= 0
+    edges = np.stack([src[keep], dst[keep]], axis=1)
+    if symmetric:
+        und = np.concatenate([edges, edges[:, ::-1]])
+        edges = np.unique(und, axis=0)
+    return edges
+
+
 def _pad_pow2(x: np.ndarray, fill: int, minimum: int = 8) -> np.ndarray:
     m = max(minimum, 1 << (int(x.size) - 1).bit_length()) if x.size else minimum
     out = np.full((m,), fill, x.dtype)
@@ -198,11 +228,7 @@ class KnnProblem:
             raise ValueError(
                 f"max_neighbors={cap} exceeds the prepared k={self.config.k}")
         ids, d2 = self.query(queries, k=cap)
-        in_range = d2 <= np.float32(radius) ** 2
-        counts = in_range.sum(axis=1).astype(np.int32)
-        truncated = counts >= cap
-        return (np.where(in_range, ids, -1), np.where(in_range, d2, np.inf),
-                counts, truncated)
+        return radius_mask_from_knn(ids, d2, radius, cap)
 
     # -- result extraction (reference: kn_get_*, knearests.cu:406-437) ----------
 
@@ -242,16 +268,7 @@ class KnnProblem:
         adds reverse edges and deduplicates (an undirected graph).
         """
         self._require_solved()
-        nbrs = self.get_knearests_original()
-        n, k = nbrs.shape
-        src = np.repeat(np.arange(n, dtype=np.int32), k)
-        dst = nbrs.reshape(-1)
-        keep = dst >= 0
-        edges = np.stack([src[keep], dst[keep]], axis=1)
-        if symmetric:
-            und = np.concatenate([edges, edges[:, ::-1]])
-            edges = np.unique(und, axis=0)
-        return edges
+        return edges_from_neighbors(self.get_knearests_original(), symmetric)
 
     def print_stats(self):
         """Occupancy histogram + certification + memory (reference:

@@ -87,7 +87,14 @@ _DENSE_TILE_BYTES = 128 << 20
 class ClassPack:
     """Prepacked kernel inputs for one pallas-routed class (the named twin of
     pallas_solve._pack_inputs' tail): per-axis (Sc, 1, qcap)/(Sc, 1, ccap)
-    coordinate lane blocks + slot-id blocks."""
+    coordinate lane blocks + slot-id blocks.
+
+    Reuse contract: these blocks are gathers of the *exact* points/starts/
+    counts arrays passed to _prepack_kernel_inputs -- a consumer reusing them
+    (e.g. _query_class's candidate half) must be solving against that same
+    CSR.  Mixing a plan with re-gridded data would compute wrong neighbors
+    that still certify; reuse sites assert the derivable half of the contract
+    (block shapes vs the plan's caps) at trace time."""
 
     qx: jax.Array
     qy: jax.Array
@@ -510,6 +517,11 @@ def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
 
     if cp.pk is not None:
         pk = cp.pk
+        # ClassPack reuse contract: blocks must match this plan's caps
+        assert pk.cx.shape == (cp.n_sc, 1, cp.ccap), (
+            f"ClassPack/plan mismatch: pk blocks {pk.cx.shape} vs plan "
+            f"(n_sc={cp.n_sc}, ccap={cp.ccap}); was this plan built against "
+            f"a different grid?")
         qx, qy, qz, cx, cy, cz = pk.qx, pk.qy, pk.qz, pk.cx, pk.cy, pk.cz
         qid3, cid3 = pk.qid3, pk.cid3
     else:
@@ -586,7 +598,12 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
 
         if cp.pk is not None:
             # candidate half of the class's prepacked self-solve inputs --
-            # identical by construction (same cand table, same ccap)
+            # identical by construction (same cand table, same ccap); see
+            # the ClassPack reuse contract
+            assert cp.pk.cx.shape == (cp.n_sc, 1, cp.ccap), (
+                f"ClassPack/plan mismatch: pk blocks {cp.pk.cx.shape} vs "
+                f"plan (n_sc={cp.n_sc}, ccap={cp.ccap}); was this plan built "
+                f"against a different grid?")
             cx, cy, cz, cid3 = cp.pk.cx, cp.pk.cy, cp.pk.cz, cp.pk.cid3
         else:
             c_idx, c_ok = pack_cells(cp.cand, starts, counts, cp.ccap)

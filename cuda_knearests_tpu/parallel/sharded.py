@@ -516,6 +516,8 @@ class ShardedKnnProblem:
                                                         repr=False)
     _ready_cache: Dict[int, tuple] = dataclasses.field(default_factory=dict,
                                                        repr=False)
+    _solved_cache: Optional[tuple] = dataclasses.field(default=None,
+                                                       repr=False)
 
     def _oracle(self):
         """Host kd-tree over the full set, built once per problem (the exact
@@ -618,7 +620,15 @@ class ShardedKnnProblem:
     def _chip_ready(self, d: int):
         """Chip d's static solve state (halo-extended arrays, prepacked
         classes, local-row inversion), built once per problem and cached --
-        the sharded analog of the single-chip plan-time prepack."""
+        the sharded analog of the single-chip plan-time prepack.
+
+        Footprint: the cache pins roughly an extra copy of the chip's
+        halo-extended point set plus the per-class prepacked coordinate/id
+        blocks in that chip's HBM for the problem's lifetime (both
+        ``solve_device()`` and ``query()`` build it).  That is the price of
+        the 3.3x prepack win (DESIGN.md section 4b); memory-tight or
+        query-heavy workloads can release it between batches with
+        :meth:`drop_ready`."""
         if not self.chip_plans[d].classes:
             raise ValueError(f"chip {d} has an empty class schedule")
         if d not in self._ready_cache:
@@ -629,6 +639,16 @@ class ShardedKnnProblem:
                 inp["hi_pts"], inp["hi_ids"], inp["hi_counts"],
                 self.chip_plans[d].classes, hcap=self.meta.hcap)
         return self._ready_cache[d]
+
+    def drop_ready(self, chip: Optional[int] = None) -> None:
+        """Release the cached per-chip solve state (see _chip_ready's
+        footprint note) -- all chips, or one mesh position.  The next
+        solve/query rebuilds it (one extend + prepack program per chip; the
+        underlying build outputs in ``self.dev`` are untouched)."""
+        if chip is None:
+            self._ready_cache.clear()
+        else:
+            self._ready_cache.pop(chip, None)
 
     def solve_device(self):
         """Run every process-local chip's adaptive solve, results
@@ -737,6 +757,45 @@ class ShardedKnnProblem:
             out_d[bad] = b_d
         return out_i, out_d
 
+    def query_radius(self, queries, radius: float,
+                     max_neighbors: Optional[int] = None):
+        """All stored points within ``radius`` of each query (capped) -- the
+        sharded twin of api.KnnProblem.query_radius, thin over query().
+
+        The k-NN rows are globally exact (certificate or oracle resolution),
+        so the radius mask is exact for any radius; the only possible
+        incompleteness is the cap, flagged per query via ``truncated``.
+        Returns (ids (m, cap) original indexing, -1 beyond count; d2 (m, cap)
+        ascending, inf beyond; counts (m,); truncated (m,))."""
+        from ..api import radius_mask_from_knn
+
+        cap = self.config.k if max_neighbors is None else int(max_neighbors)
+        if cap > self.config.k:
+            raise ValueError(
+                f"max_neighbors={cap} exceeds the prepared k={self.config.k}")
+        ids, d2 = self.query(queries, k=cap)
+        return radius_mask_from_knn(ids, d2, radius, cap)
+
+    def get_edges(self, symmetric: bool = False, device_out=None,
+                  solved=None) -> np.ndarray:
+        """kNN graph as a COO edge list (E, 2) of original point ids -- the
+        sharded twin of api.KnnProblem.get_edges, thin over solve().
+
+        Like the single-chip twin after ``solve()``, the no-arg call is a
+        cheap readback: solve() memoizes its assembled triple on the problem,
+        so only the first call (on a never-solved problem) pays a full solve.
+        Pass ``solved`` (a ``solve()`` triple) or ``device_out`` (a
+        ``solve_device()`` dict) to use other results explicitly."""
+        from ..api import edges_from_neighbors
+
+        if solved is None:
+            if device_out is not None:
+                solved = self.solve(device_out=device_out)
+            else:
+                solved = self._solved_cache or self.solve()
+        neighbors = solved[0]
+        return edges_from_neighbors(neighbors, symmetric)
+
     def stats(self) -> dict:
         """Decomposition + per-chip schedule diagnostics, machine-readable --
         the multi-chip extension of api.KnnProblem.stats() (C6 parity,
@@ -836,4 +895,8 @@ class ShardedKnnProblem:
             neighbors[bad] = b_ids
             d2[bad] = b_d2
             cert[bad] = True
+        # memoize for readback-style consumers (get_edges); arrays are
+        # returned by reference -- treat them as immutable, like the
+        # single-chip result object
+        self._solved_cache = (neighbors, d2, cert)
         return neighbors, d2, cert

@@ -20,9 +20,11 @@ a backend.
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 
@@ -58,6 +60,58 @@ def _probe_default_backend(timeout_s: float) -> str | None:
     return None
 
 
+_CACHE_TTL_ENV = "BENCH_PROBE_CACHE_TTL_S"
+_CACHE_TTL_DEFAULT = 60.0
+
+
+def _probe_cache_path() -> str:
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(),
+                        f"cuda_knearests_tpu_probe_{uid}.json")
+
+
+def _probe_env_key() -> str:
+    """The JAX_PLATFORMS pin the probe answered for.  A cached result is only
+    valid for the same pin: a healthy 'tpu' stamped under JAX_PLATFORMS=axon
+    says nothing about what an unset-env process would initialize -- serving
+    it across pins would skip the probe for a backend that was never checked
+    (the unbounded-init hang this module exists to prevent)."""
+    return os.environ.get("JAX_PLATFORMS", "")
+
+
+def _read_healthy_probe_cache(ttl_s: float) -> str | None:
+    """A healthy probe result persisted within the last ttl_s seconds for the
+    SAME JAX_PLATFORMS pin, or None.  Failures are never written here, so a
+    hit always means 'a real backend init succeeded moments ago'.  The file
+    must be owned by this uid -- a fixed predictable /tmp path is otherwise
+    forgeable by any local user (sticky-bit /tmp keeps our os.replace from
+    evicting a planted file)."""
+    path = _probe_cache_path()
+    try:
+        if hasattr(os, "getuid") and os.stat(path).st_uid != os.getuid():
+            return None
+        with open(path) as f:
+            d = json.load(f)
+        if (d.get("platform") and d.get("env_key") == _probe_env_key()
+                and 0.0 <= time.time() - d["t"] <= ttl_s):
+            return str(d["platform"])
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return None
+
+
+def _write_healthy_probe_cache(platform: str) -> None:
+    path = _probe_cache_path()
+    try:
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        with os.fdopen(fd, "w") as f:
+            json.dump({"platform": platform, "env_key": _probe_env_key(),
+                       "t": time.time()}, f)
+        os.replace(tmp, path)  # atomic vs concurrent readers
+    except OSError:
+        pass
+
+
 def acquire_backend(tries: int | None = None, timeout_s: float | None = None,
                     probe=None):
     """Bounded retry-with-backoff around backend acquisition.
@@ -70,16 +124,30 @@ def acquire_backend(tries: int | None = None, timeout_s: float | None = None,
     because a pinned-but-dead accelerator is exactly the hang scenario.
     BENCH_PROBE_TRIES / BENCH_PROBE_TIMEOUT_S override the retry bounds.
 
-    The probe is deliberately NOT cached across invocations: a transport can
-    die between runs, and a stale "healthy" record would send the parent
-    straight into an unbounded in-process backend init -- the exact hang this
-    function exists to prevent.  Healthy accelerators therefore pay one
-    subprocess backend init per entry-point run; callers that want zero
-    overhead can pin JAX_PLATFORMS explicitly.
+    Probe caching: only *healthy* results are cached, in a cross-process tmp
+    file, for a short TTL (BENCH_PROBE_CACHE_TTL_S, default 60 s; 0 disables),
+    keyed by the JAX_PLATFORMS pin they answered for.  A second entry-point
+    run within the TTL skips the subprocess backend init (which costs 10-30 s
+    over a remote-tunnel accelerator).  Failures are never cached -- a dead
+    transport is always re-probed.
+
+    Tradeoff, stated plainly: a cache hit proceeds straight to in-process
+    backend init, so if the transport dies *within the TTL* of a healthy
+    probe, the caller hangs unbounded -- the same race that already exists in
+    the seconds between any probe and the parent's own init, widened to at
+    most TTL seconds.  Interactive entry points accept that for the 2x
+    startup saving; unattended automation that needs a hard bound per run
+    should set BENCH_PROBE_CACHE_TTL_S=0 (scripts/tpu_watch.py does) or pin
+    JAX_PLATFORMS explicitly.
     """
     explicit = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
     if explicit == "cpu":
         return "cpu", None
+    ttl_s = float(os.environ.get(_CACHE_TTL_ENV, _CACHE_TTL_DEFAULT))
+    if ttl_s > 0:
+        cached = _read_healthy_probe_cache(ttl_s)
+        if cached:
+            return cached, None
     # 2 tries x 75s bounds the dead-transport worst case at ~155s -- inside
     # the bench's end-to-end wall budget -- while the 75s first-try timeout
     # still tolerates a slow healthy accelerator init.
@@ -93,6 +161,8 @@ def acquire_backend(tries: int | None = None, timeout_s: float | None = None,
     for i in range(tries):
         platform = probe(timeout_s)
         if platform:
+            if ttl_s > 0:
+                _write_healthy_probe_cache(platform)
             return platform, None
         if i + 1 < tries:
             time.sleep(delay)
