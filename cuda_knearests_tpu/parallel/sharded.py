@@ -518,6 +518,8 @@ class ShardedKnnProblem:
                                                        repr=False)
     _solved_cache: Optional[tuple] = dataclasses.field(default=None,
                                                        repr=False)
+    _device_out_cache: Optional[dict] = dataclasses.field(default=None,
+                                                          repr=False)
 
     def _oracle(self):
         """Host kd-tree over the full set, built once per problem (the exact
@@ -582,8 +584,24 @@ class ShardedKnnProblem:
                  "hi_pts", "hi_ids", "hi_counts")
         dev = dict(zip(names, out))
 
-        # per-chip adaptive planning from the (small) cell-count readback
-        counts_all = np.asarray(jax.device_get(dev["counts"]))
+        # per-chip adaptive planning from the (small) cell-count readback.
+        # Multi-host: device_get needs a fully-addressable array, and chips at
+        # process seams need their DCN-neighbor's counts for halo sizing
+        # (_plan_chip reads counts_all[d-1]/[d+1]) -- allgather the per-chip
+        # count blocks (4 bytes/cell) so every process plans every chip.
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            local = sorted(
+                (int(sh.index[0].start or 0),
+                 np.asarray(sh.data).reshape(sh.data.shape[1:]))
+                for sh in dev["counts"].addressable_shards)
+            loc_block = np.stack([blk for _, blk in local])
+            counts_all = np.asarray(
+                multihost_utils.process_allgather(loc_block)).reshape(
+                    ndev, *loc_block.shape[1:])
+        else:
+            counts_all = np.asarray(jax.device_get(dev["counts"]))
         # explicit backend='xla' pins every class to the streamed route, like
         # the single-chip pick_backend policy
         on_kernel = (config.backend != "xla"
@@ -647,8 +665,11 @@ class ShardedKnnProblem:
         underlying build outputs in ``self.dev`` are untouched)."""
         if chip is None:
             self._ready_cache.clear()
+            self._device_out_cache = None
         else:
             self._ready_cache.pop(chip, None)
+            if self._device_out_cache is not None:
+                self._device_out_cache.pop(chip, None)
 
     def solve_device(self):
         """Run every process-local chip's adaptive solve, results
@@ -676,6 +697,8 @@ class ShardedKnnProblem:
                 ext_counts, classes, inv_loc, lo_rows, hi_rows,
                 cfg.k, cfg.exclude_self, meta.domain, cfg.interpret,
                 cfg.stream_tile)
+        # memoized for stats() margin telemetry (released by drop_ready)
+        self._device_out_cache = outs
         return outs
 
     def query(self, queries, k: Optional[int] = None
@@ -802,19 +825,37 @@ class ShardedKnnProblem:
         /root/reference/knearests.cu:440-466)."""
         from ..utils.stats import occupancy_stats
 
+        from ..utils.stats import _margin_sq_np, margin_summary
+
         meta = self.meta
         chips = []
         for d in self.local_chips():
-            counts = np.asarray(jax.device_get(self._chip_inputs(d)["counts"]))
+            inp = self._chip_inputs(d)
+            counts = np.asarray(jax.device_get(inp["counts"]))
             plan = self.chip_plans[d]
-            chips.append({
+            row = {
                 "chip": d,
                 "n_points": int(counts.sum()),
                 "occupancy": occupancy_stats(counts),
                 "classes": [{"radius": cp.radius, "n_supercells": cp.n_sc,
                              "qcap": cp.qcap, "ccap": cp.ccap,
                              "route": cp.route} for cp in plan.classes],
-            })
+            }
+            # per-chip achieved-margin telemetry (the fixed max-visited-ring
+            # analog, knearests.cu:378-390) when a solve has run and the
+            # chip's prepared state is still cached
+            out = (self._device_out_cache or {}).get(d)
+            if out is not None and d in self._ready_cache:
+                (spts, *_rest, lo_rows, hi_rows) = self._ready_cache[d]
+                sids = np.asarray(jax.device_get(inp["sids"]))
+                real = sids >= 0
+                kth = np.asarray(jax.device_get(out[1]))[real, -1]
+                msq = _margin_sq_np(
+                    np.asarray(jax.device_get(spts))[real],
+                    np.asarray(jax.device_get(lo_rows))[real],
+                    np.asarray(jax.device_get(hi_rows))[real], meta.domain)
+                row["margin"] = margin_summary(kth, msq)
+            chips.append(row)
         return {
             "n_points": self.n_points,
             "n_devices": meta.ndev,
@@ -842,6 +883,11 @@ class ShardedKnnProblem:
                 print(f"  class r={cl['radius']}: {cl['n_supercells']} "
                       f"supercells, qcap {cl['qcap']}, ccap {cl['ccap']} "
                       f"[{cl['route']}]")
+            if c.get("margin", {}).get("n"):
+                m = c["margin"]
+                print(f"  margin ratio: p50 {m['p50']:.3f}, "
+                      f"p99 {m['p99']:.3f}, max {m['max']:.3f}; "
+                      f"{m['decertified']} decertified")
         return s
 
     def permutation(self) -> np.ndarray:

@@ -32,6 +32,84 @@ def occupancy_stats(cell_counts: np.ndarray) -> Dict[str, Any]:
     }
 
 
+def _margin_sq_np(q: np.ndarray, lo: np.ndarray, hi: np.ndarray,
+                  domain: float) -> np.ndarray:
+    """Squared margin from each query row to the complement of its dilated
+    box (numpy twin of ops.solve._margin_sq, per-row shapes (n, 3))."""
+    with np.errstate(invalid="ignore"):
+        m_lo = np.where(lo <= 0.0, np.inf, q - lo)
+        m_hi = np.where(hi >= domain, np.inf, hi - q)
+        m = np.maximum(np.minimum(m_lo, m_hi).min(axis=-1), 0.0)
+    return np.where(np.isinf(m), np.inf, m * m)
+
+
+def margin_summary(kth_sq: np.ndarray, margin_sq: np.ndarray
+                   ) -> Dict[str, Any]:
+    """Per-query achieved-margin telemetry: ratio = kth_dist / margin.
+
+    The fixed analog of the reference's "Max visited ring" convergence stat
+    (/root/reference/knearests.cu:378-390 -- racy and diagnostic-only there):
+    ratio r in [0, 1) means the query's k-th neighbor used fraction r of its
+    certificate margin; r close to 1 means the planner's radius choice
+    (ops/adaptive.py) barely held, r >= 1 means the query decertified and was
+    resolved by the exact fallback.  An infinite margin (box unconstrained on
+    every axis by the domain boundary) can never decertify -> ratio 0.
+    """
+    kth = np.asarray(kth_sq, np.float64)
+    msq = np.asarray(margin_sq, np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ratio = np.sqrt(kth / msq)
+    ratio = np.where(np.isinf(msq), 0.0, ratio)     # unconstrained: safe
+    ratio = np.where(np.isnan(ratio), 1.0, ratio)   # 0/0: exactly at bound
+    n = ratio.size
+    if n == 0:
+        return {"n": 0}
+    edges = np.linspace(0.0, 1.0, 11)
+    hist = np.histogram(ratio[ratio < 1.0], bins=edges)[0]
+    over = int((ratio >= 1.0).sum())
+    return {
+        "n": int(n),
+        "mean": float(np.mean(np.minimum(ratio, 1.0))),
+        "p50": float(np.percentile(ratio, 50)),
+        "p90": float(np.percentile(ratio, 90)),
+        "p99": float(np.percentile(ratio, 99)),
+        "max": float(ratio.max()),
+        "histogram": {f"{edges[i]:.1f}-{edges[i + 1]:.1f}": int(hist[i])
+                      for i in range(10)},
+        "decertified": over,
+    }
+
+
+def problem_margins(problem) -> Dict[str, Any] | None:
+    """Achieved-margin summary for a solved api.KnnProblem, or None when the
+    planner shape keeps no per-query boxes (legacy XLA plan without a pack).
+    Boxes come from the same schedule the certificate used: adaptive classes
+    (inv_box) or the legacy PallasPack (inv_sc)."""
+    if problem.result is None:
+        return None
+    import jax
+
+    grid = problem.grid
+    kth = np.asarray(jax.device_get(problem.result.dists_sq))[:, -1]
+    aplan = getattr(problem, "aplan", None)
+    pack = getattr(problem, "pack", None)
+    if aplan is not None:
+        lo = np.concatenate([np.asarray(jax.device_get(cp.lo))
+                             for cp in aplan.classes], axis=0)
+        hi = np.concatenate([np.asarray(jax.device_get(cp.hi))
+                             for cp in aplan.classes], axis=0)
+        inv = np.asarray(jax.device_get(aplan.inv_box))
+    elif pack is not None:
+        lo = np.asarray(jax.device_get(pack.lo))
+        hi = np.asarray(jax.device_get(pack.hi))
+        inv = np.asarray(jax.device_get(pack.inv_sc))
+    else:
+        return None
+    q = np.asarray(jax.device_get(grid.points))
+    msq = _margin_sq_np(q, lo[inv], hi[inv], grid.domain)
+    return margin_summary(kth, msq)
+
+
 def problem_stats(problem) -> Dict[str, Any]:
     """Full stats for an api.KnnProblem (post-solve fields optional).
 
@@ -73,6 +151,9 @@ def problem_stats(problem) -> Dict[str, Any]:
         cert = np.asarray(problem.result.certified)
         out["certified_fraction"] = float(cert.mean()) if cert.size else 1.0
         out["uncertified"] = int((~cert).sum())
+        margins = problem_margins(problem)
+        if margins is not None:
+            out["margin"] = margins
     return out
 
 
@@ -100,5 +181,10 @@ def print_stats(problem) -> Dict[str, Any]:
     if "certified_fraction" in s:
         print(f"certified: {100.0 * s['certified_fraction']:.4f}% "
               f"({s['uncertified']} fallback queries)")
+    if "margin" in s and s["margin"].get("n"):
+        m = s["margin"]
+        print(f"achieved margin ratio (kth_dist/margin; 1.0 = decertify): "
+              f"p50 {m['p50']:.3f}, p90 {m['p90']:.3f}, p99 {m['p99']:.3f}, "
+              f"max {m['max']:.3f}; {m['decertified']} decertified")
     print(f"device memory: {s['device_bytes'] / 1e6:.1f} MB")
     return s

@@ -36,7 +36,11 @@ def _utc() -> str:
 
 
 def run_and_record(argv: list[str], out_path: str, timeout_s: float) -> int:
-    """Run a bench command, persist an rc-stamped artifact of its stdout."""
+    """Run a bench command, persist an rc-stamped artifact of its stdout.
+    A previously captured-good artifact short-circuits (rc 0, no run) and is
+    never overwritten by a worse retry."""
+    if _artifact_good(out_path):
+        return 0
     t0 = time.time()
     try:
         r = subprocess.run(argv, capture_output=True, text=True,
@@ -119,10 +123,8 @@ def main(argv=None) -> int:
             os.environ["BENCH_PROBE_CACHE_TTL_S"] = "0"
             ns_path = os.path.join(outdir, f"{args.tag}_tpu_north_star.json")
             all_path = os.path.join(outdir, f"{args.tag}_tpu_all_rows.json")
-            if not _artifact_good(ns_path):
-                run_and_record([py, bench], ns_path, timeout_s=1800)
-            if not _artifact_good(all_path):
-                run_and_record([py, bench, "--all"], all_path, timeout_s=3600)
+            run_and_record([py, bench], ns_path, timeout_s=1800)
+            run_and_record([py, bench, "--all"], all_path, timeout_s=3600)
             if _artifact_good(ns_path) and _artifact_good(all_path):
                 print("[tpu_watch] record captured", flush=True)
                 return 0

@@ -1,0 +1,66 @@
+"""Worker for the real 2-process multi-host test (test_multihost.py).
+
+Each process: 4 emulated CPU devices, jax.distributed over a localhost
+coordinator, SPMD sharded build + per-process solve_device() for its
+addressable slabs, results dumped per chip for the parent to merge and
+verify.  Run: python multihost_worker.py <process_id> <port> <outdir>
+"""
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+outdir = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cuda_knearests_tpu.parallel.distributed import init_distributed, z_mesh
+
+init_distributed(coordinator_address=f"localhost:{port}", num_processes=2,
+                 process_id=pid)
+
+import jax
+import numpy as np
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.local_devices()) == 4
+assert len(jax.devices()) == 8
+
+from cuda_knearests_tpu import KnnConfig
+from cuda_knearests_tpu.io import generate_uniform
+from cuda_knearests_tpu.parallel.sharded import ShardedKnnProblem
+
+points = generate_uniform(20_000, seed=77)  # identical on both processes
+sp = ShardedKnnProblem.prepare(points, config=KnnConfig(k=8), mesh=z_mesh())
+
+chips = sp.local_chips()
+assert len(chips) == 4, f"process {pid} sees chips {chips}"
+expect = list(range(pid * 4, pid * 4 + 4))
+assert chips == expect, f"process {pid}: {chips} != {expect}"
+
+outs = sp.solve_device()
+
+# single-controller surfaces must refuse, with guidance, on a multi-host mesh
+for fn in (sp.solve, sp.permutation):
+    try:
+        fn()
+    except RuntimeError as e:
+        assert "multi-host" in str(e), e
+    else:
+        raise AssertionError(f"{fn.__name__}() must raise on multi-host")
+
+for d in chips:
+    out = outs[d]
+    if out is None:
+        continue
+    sids = np.asarray(jax.device_get(sp._chip_inputs(d)["sids"]))
+    nbr = np.asarray(jax.device_get(out[0]))
+    d2 = np.asarray(jax.device_get(out[1]))
+    cert = np.asarray(jax.device_get(out[2]))
+    real = sids >= 0
+    np.savez(os.path.join(outdir, f"proc{pid}_chip{d}.npz"),
+             sids=sids[real], nbr=nbr[real], d2=d2[real], cert=cert[real])
+
+print(f"WORKER_OK {pid} chips={chips}", flush=True)

@@ -90,8 +90,9 @@ def test_probe_cache_expires_and_never_caches_failure(monkeypatch, tmp_path):
     monkeypatch.setenv("BENCH_PROBE_CACHE_TTL_S", "60")
     monkeypatch.setattr(plat, "_probe_cache_path", lambda: str(cache))
 
-    # stale healthy record -> must be ignored, probe must run
-    cache.write_text(_json.dumps({"platform": "tpu",
+    # stale healthy record (matching env_key, so only the TTL rejects it)
+    # -> must be ignored, probe must run
+    cache.write_text(_json.dumps({"platform": "tpu", "env_key": "",
                                   "t": _time.time() - 3600}))
     calls = []
 

@@ -81,3 +81,57 @@ def test_device_properties_listing():
     props = device_properties()
     assert len(props) == 8  # conftest forces the 8-device emulated CPU mesh
     assert all(p["platform"] == "cpu" for p in props)
+
+
+def test_margin_telemetry_single_chip(blue_8k):
+    """Achieved-margin ratios (kth_dist/margin) appear in stats() after a
+    solve -- the fixed analog of the reference's racy "Max visited ring"
+    (knearests.cu:378-390; VERDICT r3 missing #3).  Certified queries must
+    sit strictly inside their margin (ratio <= 1), the histogram must cover
+    every query, and the summary must be consistent."""
+    p = KnnProblem.prepare(blue_8k, KnnConfig(k=10))
+    p.solve()
+    s = p.stats()
+    m = s["margin"]
+    assert m["n"] == len(blue_8k)
+    assert sum(m["histogram"].values()) + m["decertified"] == m["n"]
+    assert 0.0 <= m["p50"] <= m["p90"] <= m["p99"] <= m["max"]
+    # everything certified on this fixture -> nothing at/over the bound
+    assert s["certified_fraction"] == 1.0
+    assert m["decertified"] == 0 and m["max"] <= 1.0
+
+
+def test_margin_summary_edge_cases():
+    """Unit semantics: infinite margin can never decertify (ratio 0), 0/0 is
+    exactly-at-bound, ratio >= 1 counts as decertified."""
+    from cuda_knearests_tpu.utils.stats import margin_summary
+
+    kth = np.float64([4.0, 1.0, 0.0, 9.0])
+    msq = np.float64([16.0, np.inf, 0.0, 4.0])
+    m = margin_summary(kth, msq)
+    assert m["n"] == 4
+    # ratios: 0.5, 0.0 (inf margin), 1.0 (0/0), 1.5 -> two decertified
+    assert m["decertified"] == 2
+    assert abs(m["max"] - 1.5) < 1e-12
+    assert sum(m["histogram"].values()) == 2
+    assert margin_summary(np.empty(0), np.empty(0)) == {"n": 0}
+
+
+def test_margin_telemetry_sharded(blue_8k):
+    """Per-chip margin blocks appear in sharded stats() after
+    solve_device(), and drop_ready() releases the cached telemetry state."""
+    from cuda_knearests_tpu.parallel.sharded import ShardedKnnProblem
+
+    sp = ShardedKnnProblem.prepare(blue_8k, n_devices=4,
+                                   config=KnnConfig(k=8))
+    sp.solve_device()
+    s = sp.print_stats()
+    per_chip = [c["margin"] for c in s["chips"] if "margin" in c]
+    assert per_chip, "no chip reported margin telemetry"
+    total = sum(m["n"] for m in per_chip)
+    assert total == len(blue_8k)
+    for m in per_chip:
+        assert sum(m["histogram"].values()) + m["decertified"] == m["n"]
+    sp.drop_ready()
+    s2 = sp.stats()
+    assert all("margin" not in c for c in s2["chips"])
