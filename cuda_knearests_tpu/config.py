@@ -95,6 +95,19 @@ class KnnConfig:
       max_classes: cap on adaptive capacity classes (one compiled launch each).
       stream_tile: candidate-axis tile of the streamed (non-kernel) class
         solver; bounds its peak memory independently of ccap.
+      kernel: top-k extraction strategy inside the Pallas kernel.  'kpass' =
+        k min-and-mask sweeps of the full (Q, C) distance tile (the
+        shared-memory-heap analog, knearests.cu:127-133).  'blocked' =
+        two-stage reduce: per-128-lane-block ascending top-m computed from
+        coordinates in registers (the distance tile is never materialized),
+        then the k-pass runs on the (Q, G*m) survivor pool -- O(C*m + k*G*m)
+        VMEM traffic instead of O(k*C).  Exactness holds via a per-query
+        deficit certificate (a block whose m-th kept value could hide a
+        better candidate decertifies the row, which then resolves through
+        the standard exact fallback); candidate slots are interleaved across
+        blocks at pack time so the spatially-clustered near candidates
+        spread evenly and deficits stay rare.  'auto' = 'blocked' where the
+        survivor pool comfortably covers k (see blocked_topm), else 'kpass'.
     """
 
     k: int = DEFAULT_K
@@ -110,8 +123,42 @@ class KnnConfig:
     adaptive: bool = True
     max_classes: int = 4
     stream_tile: int = 2048
+    kernel: str = "kpass"
 
     def resolved_ring_radius(self) -> int:
         if self.ring_radius is not None:
             return max(1, int(self.ring_radius))
         return default_ring_radius(self.k, self.density)
+
+
+def blocked_topm(k: int, ccap: int) -> int:
+    """Per-block kept count m for the 'blocked' kernel, or 0 when the blocked
+    route is ineligible for this (k, ccap).
+
+    m barely affects the kernel's VMEM traffic (stage-1 extraction passes
+    run on in-register blocks; coordinates are read once per block either
+    way), so it is chosen for deficit rate, not bandwidth: measured on
+    15k blue noise with G=9 blocks, m=4 flagged 1.8% of queries at k=10 and
+    9.7% at k=20, while ceil(k/G)+4 flagged 0.00% / 0.05%.  Eligibility
+    requires the survivor pool (m*G entries) to cover k three times over --
+    a pool close to k puts the selected k-th near the pool maximum and
+    flags almost every block -- and at least 2 blocks (else blocked IS
+    kpass with overhead)."""
+    g = ccap // 128
+    if ccap % 128 != 0 or g < 2:
+        return 0
+    m = min(-(-k // g) + 4, 12)
+    return m if m * g >= 3 * k else 0
+
+
+def resolve_kernel(kernel: str, k: int, ccap: int) -> str:
+    """'auto' -> 'blocked' when eligible (see blocked_topm), else 'kpass'."""
+    if kernel not in ("auto", "blocked", "kpass"):
+        raise ValueError(
+            f"unknown kernel {kernel!r}: expected 'auto', 'blocked' or "
+            f"'kpass'")  # a typo must not silently benchmark the wrong body
+    if kernel == "auto":
+        return "blocked" if blocked_topm(k, ccap) else "kpass"
+    if kernel == "blocked" and not blocked_topm(k, ccap):
+        return "kpass"  # ineligible shape: degrade to exact-anyway kpass
+    return kernel

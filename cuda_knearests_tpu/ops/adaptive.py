@@ -492,13 +492,13 @@ def _dense_query_topk(points: jax.Array, starts: jax.Array, counts: jax.Array,
 
 def _class_flat(points: jax.Array, starts: jax.Array, counts: jax.Array,
                 cp: ClassPlan, k: int, exclude_self: bool, tile: int,
-                interpret: bool):
+                interpret: bool, kernel: str = "kpass"):
     """Route one class's self-solve to its solver.  Returns
     (Sc * qcap_pad, k) flat dists/ids, ascending -- the shared layout
     contract of all three routes."""
     if cp.route == "pallas":
         return _pallas_class(points, starts, counts, cp, k, exclude_self,
-                             interpret)
+                             interpret, kernel)
     if cp.route == "dense":
         return _dense_self(points, starts, counts, cp.own, cp.cand,
                            cp.qcap_pad, k, cp.ccap, exclude_self)
@@ -510,7 +510,8 @@ def _class_flat(points: jax.Array, starts: jax.Array, counts: jax.Array,
 
 
 def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
-                  cp: ClassPlan, k: int, exclude_self: bool, interpret: bool):
+                  cp: ClassPlan, k: int, exclude_self: bool, interpret: bool,
+                  kernel: str = "kpass"):
     """Fused-kernel class solver (the hot route).  Returns (Sc * qcap_pad, k)
     flat dists/ids, ascending -- same layout contract as _streamed_class."""
     from .pallas_solve import _pack_inputs, _pallas_topk
@@ -527,23 +528,27 @@ def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
     else:
         _, _, qx, qy, qz, cx, cy, cz, qid3, cid3 = _pack_inputs(
             points, starts, counts, cp.own, cp.cand, cp.qcap_pad, cp.ccap)
+    from ..config import resolve_kernel
+
     out_d, out_i = _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3,
                                 cp.qcap_pad, cp.ccap, k, exclude_self,
-                                interpret)
+                                interpret,
+                                resolve_kernel(kernel, k, cp.ccap))
     flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
     flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)
     return flat_d, flat_i
 
 
 @functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
-                                             "interpret", "tile"))
+                                             "interpret", "tile", "kernel"))
 def _solve_adaptive(points: jax.Array, starts: jax.Array, counts: jax.Array,
                     plan: AdaptivePlan, k: int, exclude_self: bool,
-                    domain: float, interpret: bool, tile: int):
+                    domain: float, interpret: bool, tile: int,
+                    kernel: str = "kpass"):
     flats_d, flats_i, los, his = [], [], [], []
     for cp in plan.classes:
         fd, fi = _class_flat(points, starts, counts, cp, k, exclude_self,
-                             tile, interpret)
+                             tile, interpret, kernel)
         flats_d.append(fd)
         flats_i.append(fi)
         los.append(cp.lo)
@@ -552,13 +557,16 @@ def _solve_adaptive(points: jax.Array, starts: jax.Array, counts: jax.Array,
     flat_i = jnp.concatenate(flats_i, axis=0)
     row_d = jnp.take(flat_d, plan.inv_flat, axis=0)          # (n, k)
     row_i = jnp.take(flat_i, plan.inv_flat, axis=0)
+    # raw k-th BEFORE sanitization: blocked-kernel deficit rows carry NaN
+    # there, and NaN <= margin is false even for an infinite margin
+    raw_kth = row_d[:, k - 1]
     ok = jnp.isfinite(row_d)
     row_i = jnp.where(ok, row_i, INVALID_ID)
     row_d = jnp.where(ok, row_d, jnp.inf)
     lo = jnp.take(jnp.concatenate(los, axis=0), plan.inv_box, axis=0)
     hi = jnp.take(jnp.concatenate(his, axis=0), plan.inv_box, axis=0)
-    cert = row_d[:, k - 1] <= _margin_sq(points[:, None, :], lo, hi,
-                                         domain)[:, 0]
+    cert = raw_kth <= _margin_sq(points[:, None, :], lo, hi,
+                                 domain)[:, 0]
     return row_i, row_d, cert
 
 
@@ -571,19 +579,22 @@ def solve_adaptive(grid: GridHash, cfg: KnnConfig,
         plan = build_adaptive_plan(grid, cfg)
     nbr, d2, cert = _solve_adaptive(
         grid.points, grid.cell_starts, grid.cell_counts, plan, cfg.k,
-        cfg.exclude_self, grid.domain, cfg.interpret, cfg.stream_tile)
+        cfg.exclude_self, grid.domain, cfg.interpret, cfg.stream_tile,
+        cfg.kernel)
     return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert)
 
 
 # -- external queries through the class schedule ------------------------------
 
 @functools.partial(jax.jit, static_argnames=("q2cap", "k", "route",
-                                             "domain", "interpret", "tile"))
+                                             "domain", "interpret", "tile",
+                                             "kernel"))
 def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                  cp: ClassPlan, qsorted: jax.Array, rstarts: jax.Array,
                  rcounts: jax.Array, inv: jax.Array, rows_sel: jax.Array,
                  q2cap: int, k: int, route: str, domain: float,
-                 interpret: bool, tile: int, ids_map: jax.Array | None = None):
+                 interpret: bool, tile: int, ids_map: jax.Array | None = None,
+                 kernel: str = "kpass"):
     """One class's external-query launch: build the per-supercell query block
     from the row-bucketed queries, run the class solver (kernel or streamed),
     gather each query's row back, and certify against the class's dilated
@@ -616,9 +627,12 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
         qaxes = qsorted.T
         qxq, qyq, qzq = (jnp.take(qaxes[ax], safe_qs, axis=0)
                          .reshape(cp.n_sc, 1, q2cap) for ax in range(3))
+        from ..config import resolve_kernel
+
         qid3 = jnp.full((cp.n_sc, 1, q2cap), _PAD_Q, jnp.int32)
         out_d, out_i = _pallas_topk(qxq, qyq, qzq, cx, cy, cz, qid3, cid3,
-                                    q2cap, cp.ccap, k, False, interpret)
+                                    q2cap, cp.ccap, k, False, interpret,
+                                    resolve_kernel(kernel, k, cp.ccap))
         flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
         flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)
     elif route == "dense":
@@ -632,6 +646,8 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                                         q, qs_ok, q_excl, k, cp.ccap, tile)
     row_d = jnp.take(flat_d, inv, axis=0)                    # (m_c, k)
     row_i = jnp.take(flat_i, inv, axis=0)
+    # raw k-th BEFORE sanitization (blocked-kernel deficit rows carry NaN)
+    raw_kth = row_d[:, k - 1]
     ok = jnp.isfinite(row_d)
     row_i = jnp.where(ok, row_i, INVALID_ID)
     row_d = jnp.where(ok, row_d, jnp.inf)
@@ -644,8 +660,8 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
             INVALID_ID)
     lo = jnp.take(cp.lo, rows_sel, axis=0)                   # (m_c, 3)
     hi = jnp.take(cp.hi, rows_sel, axis=0)
-    cert = row_d[:, k - 1] <= _margin_sq(qsorted[:, None, :], lo, hi,
-                                         domain)[:, 0]
+    cert = raw_kth <= _margin_sq(qsorted[:, None, :], lo, hi,
+                                 domain)[:, 0]
     return row_i, row_d, cert
 
 
@@ -686,7 +702,7 @@ def launch_class_query(points, starts, counts, cp: ClassPlan,
         jnp.asarray(queries_sel[order]), jnp.asarray(rstarts),
         jnp.asarray(rcounts), jnp.asarray(inv),
         jnp.asarray(rows_sorted.astype(np.int32)), q2cap, k,
-        route, domain, cfg.interpret, cfg.stream_tile, ids_map)
+        route, domain, cfg.interpret, cfg.stream_tile, ids_map, cfg.kernel)
     return order, r_i, r_d, r_c
 
 

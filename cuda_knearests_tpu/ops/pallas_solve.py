@@ -109,8 +109,9 @@ def _kernel(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref, cid_ref,
     their _PAD_C id (cheaper than a FAR-coordinate fill pass over HBM).  The
     k-pass min-and-mask is the reference heap's functional twin: pass i finds
     the i-th nearest and masks it out of the tile.  The winner's id is
-    extracted by a masked min over the candidate-id lanes -- cid is ascending
-    over slots, so ties resolve to the lowest slot, exactly like a stable sort.
+    extracted by a masked min over the candidate-id lanes, so value ties
+    resolve to the lowest stored-point id, exactly like a stable sort over
+    ids (slot order is irrelevant -- _pack_inputs may interleave it).
     """
     d2 = None
     # same x,y,z accumulation order as knearests.cu:125
@@ -150,6 +151,96 @@ def _kernel(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref, cid_ref,
         jax.lax.fori_loop(0, k, body, d2)
 
 
+def _kernel_blocked(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref,
+                    cid_ref, out_d_ref, out_i_ref, *, k: int, m: int,
+                    exclude_self: bool):
+    """Blocked two-stage top-k (config.kernel='blocked').
+
+    Stage 1 walks the candidate lanes one 128-lane block at a time: each
+    block's (Q, 128) squared-distance tile is computed from the coordinate
+    lane blocks on the spot (same x,y,z accumulation order as
+    knearests.cu:125) and reduced to its ascending top-m by m min-and-mask
+    passes while it lives in registers -- the full (Q, C) distance tile is
+    never materialized, so VMEM traffic drops from O(k*C) tile sweeps to one
+    coordinate read per block plus the (Q, G*m) survivor pool.
+
+    Stage 2 runs the classic k-pass min-and-mask on the survivor pool.
+    Exactness: every candidate a block did NOT keep is >= that block's
+    smallest REMAINING value (``rem``, computed after the m-th extraction's
+    mask; inf when the block kept everything it had).  The result can
+    therefore be wrong only if some rem is strictly below the selected k-th
+    value t -- a hidden candidate could land in (rem, t).  Such rows get
+    their k-th distance NaN'd, which fails the completeness certificate in
+    every epilogue (NaN <= margin is false even for an infinite margin), so
+    they resolve through the standard exact fallback.  Pack-time slot
+    interleaving (_pack_inputs) spreads spatially-adjacent candidates across
+    blocks to keep that event rare.
+
+    Tie semantics: winners are chosen by minimum stored-point id among
+    value ties, like the kpass kernel.  A hidden candidate exactly tying t
+    (rem == t) does NOT flag: the reported distances are still the true k
+    smallest, and the id set may differ from a full scan only inside exact
+    ties at the k-th distance -- id flips inside exact ties are accepted
+    throughout this framework (differential tests compare tie-aware).
+    """
+    c_total = cx_ref.shape[2]
+    n_blocks = c_total // 128
+    qa = [r[0, 0, :].reshape(-1, 1) for r in (qx_ref, qy_ref, qz_ref)]
+    qi = qid_ref[0, 0, :].reshape(-1, 1) if exclude_self else None
+
+    kept_d, kept_i, rems = [], [], []
+    for g in range(n_blocks):
+        sl = pl.ds(g * 128, 128)
+        d2b = None
+        for q_col, c_ref in zip(qa, (cx_ref, cy_ref, cz_ref)):
+            cb = c_ref[0, 0, sl].reshape(1, -1)
+            diff = q_col - cb
+            d2b = diff * diff if d2b is None else d2b + diff * diff
+        cib = cid_ref[0, 0, sl].reshape(1, -1)
+        drop = cib == _PAD_C
+        if exclude_self:
+            drop = drop | (qi == cib)
+        d2b = jnp.where(drop, jnp.inf, d2b)
+        for j in range(m):
+            mv = jnp.min(d2b, axis=1)
+            sel = d2b == mv[:, None]
+            bid = jnp.min(jnp.where(sel, cib, _BIG_ID), axis=1)
+            kept_d.append(mv)
+            kept_i.append(bid)
+            d2b = jnp.where(sel & (cib == bid[:, None]), jnp.inf, d2b)
+        # smallest value the block did NOT keep (inf when it kept all it
+        # had) -- the exact lower bound on anything hidden in this block
+        rems.append(jnp.min(d2b, axis=1))
+
+    pool_d = jnp.stack(kept_d, axis=1)                    # (Q, G*m)
+    pool_i = jnp.stack(kept_i, axis=1)
+    rem = jnp.stack(rems, axis=1)                         # (Q, G)
+
+    t = None
+    for i in range(k):
+        mv = jnp.min(pool_d, axis=1)
+        sel = pool_d == mv[:, None]
+        bid = jnp.min(jnp.where(sel, pool_i, _BIG_ID), axis=1)
+        if i + 1 < k:
+            out_d_ref[0, i, :] = mv
+            out_i_ref[0, i, :] = bid
+            pool_d = jnp.where(sel & (pool_i == bid[:, None]), jnp.inf,
+                               pool_d)
+        else:
+            t = mv
+            out_i_ref[0, i, :] = bid
+    # Deficit certificate: hidden candidates in block g are >= rem[g] (the
+    # smallest value that block did not keep; inf when it kept everything),
+    # so the result can be wrong only if some rem < t strictly -- a hidden
+    # value could then land in (rem, t).  rem == t hides at most exact ties
+    # at the k-th distance (see docstring); rem == inf never flags, so
+    # blocks holding <= m real candidates and fully-padded blocks certify
+    # through the normal margin check.  Flagged rows get NaN at k-1, fail
+    # every certificate, and resolve via the exact fallback.
+    deficit = jnp.any(rem < t[:, None], axis=1)
+    out_d_ref[0, k - 1, :] = jnp.where(deficit, jnp.nan, t)
+
+
 def vmem_bytes_estimate(qcap: int, ccap: int, k: int) -> int:
     """Rough per-program VMEM need: d2 tile + in/out blocks (f32/i32 = 4B),
     with lane/sublane padding accounted."""
@@ -168,12 +259,23 @@ def pallas_fits(qcap: int, ccap: int, k: int) -> bool:
 
 
 def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
-                 k: int, exclude_self: bool, interpret: bool):
+                 k: int, exclude_self: bool, interpret: bool,
+                 kernel: str = "kpass"):
     """Launch the kernel over a flat supercell grid.  Returns ((S,k,Q) dists,
-    (S,k,Q) ids) -- raw, untransposed."""
+    (S,k,Q) ids) -- raw, untransposed.  ``kernel`` picks the extraction
+    strategy ('kpass' | 'blocked', see config.KnnConfig.kernel); ineligible
+    blocked shapes silently take the kpass body."""
+    from ..config import blocked_topm
+
     s_total = qx.shape[0]
+    m = blocked_topm(k, ccap) if kernel == "blocked" else 0
+    if m:
+        body = functools.partial(_kernel_blocked, k=k, m=m,
+                                 exclude_self=exclude_self)
+    else:
+        body = functools.partial(_kernel, k=k, exclude_self=exclude_self)
     return pl.pallas_call(
-        functools.partial(_kernel, k=k, exclude_self=exclude_self),
+        body,
         grid=(s_total,),
         in_specs=[
             pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
@@ -219,6 +321,19 @@ def _pack_inputs(points: jax.Array, starts: jax.Array, counts: jax.Array,
     qcap = -(-qcap // 128) * 128
     q_idx, q_ok = pack_cells(own, starts, counts, qcap)
     c_idx, c_ok = pack_cells(cand, starts, counts, ccap)
+    g = ccap // 128
+    if ccap % 128 == 0 and g > 1:
+        # Interleave candidate slots across 128-lane blocks (slot r*G+g ->
+        # lane g*128+r): CSR packing puts spatially-adjacent candidates in
+        # adjacent slots, which would concentrate every query's near
+        # neighbors into one or two lane blocks and make the blocked
+        # kernel's per-block top-m overflow (deficit) routinely.  Round-robin
+        # spreads them evenly.  Order-insensitive consumers (the kpass
+        # kernel, tie-breaks by min id) are unaffected.
+        c_idx = c_idx.reshape(s_total, 128, g).transpose(0, 2, 1).reshape(
+            s_total, ccap)
+        c_ok = c_ok.reshape(s_total, 128, g).transpose(0, 2, 1).reshape(
+            s_total, ccap)
     # Pad rows keep garbage (point-0) coords on both sides: padded candidates
     # are masked inside the kernel by their _PAD_C id, and padded query rows
     # are dropped by the q_ok scatter in the epilogue -- no FAR fill passes.
@@ -263,9 +378,10 @@ def build_pack(points: jax.Array, starts: jax.Array, counts: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
-                                             "interpret"))
+                                             "interpret", "kernel"))
 def _solve_packed(pack: PallasPack, points: jax.Array, k: int,
-                  exclude_self: bool, domain: float, interpret: bool = False):
+                  exclude_self: bool, domain: float, interpret: bool = False,
+                  kernel: str = "kpass"):
     """Steady-state solve: kernel launch + un-pad gather + certificates.
     Returns ((n,k) ids, (n,k) d2, (n,) certified), sorted indexing.
 
@@ -277,20 +393,23 @@ def _solve_packed(pack: PallasPack, points: jax.Array, k: int,
     out_d, out_i = _pallas_topk(pack.qx, pack.qy, pack.qz,
                                 pack.cx, pack.cy, pack.cz,
                                 pack.qid3, pack.cid3, pack.qcap, pack.ccap, k,
-                                exclude_self, interpret)
+                                exclude_self, interpret, kernel)
 
     flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)       # (S*Q, k) ascending
     flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)
     row_d = jnp.take(flat_d, pack.inv_flat, axis=0)        # (n, k)
     row_i = jnp.take(flat_i, pack.inv_flat, axis=0)
+    # Certificate from the RAW k-th value, before sanitization: the blocked
+    # kernel marks deficit rows with NaN there, and NaN <= margin is false
+    # even for an infinite margin (inf would wrongly certify).
+    raw_kth = row_d[:, k - 1]
     ok = jnp.isfinite(row_d)
     row_i = jnp.where(ok, row_i, INVALID_ID)
     row_d = jnp.where(ok, row_d, jnp.inf)
 
     lo = jnp.take(pack.lo, pack.inv_sc, axis=0)            # (n, 3)
     hi = jnp.take(pack.hi, pack.inv_sc, axis=0)
-    cert = row_d[:, k - 1] <= _margin_sq(points[:, None, :], lo, hi,
-                                         domain)[:, 0]
+    cert = raw_kth <= _margin_sq(points[:, None, :], lo, hi, domain)[:, 0]
     return row_i, row_d, cert
 
 
@@ -308,6 +427,9 @@ def solve_pallas(grid: GridHash, cfg, plan: SolvePlan | None = None,
             f"VMEM budget; use a smaller config.supercell or backend='xla'")
     if pack is None:
         pack = build_pack(grid.points, grid.cell_starts, grid.cell_counts, plan)
+    from ..config import resolve_kernel
+
     nbr, d2, cert = _solve_packed(pack, grid.points, cfg.k, cfg.exclude_self,
-                                  grid.domain, cfg.interpret)
+                                  grid.domain, cfg.interpret,
+                                  resolve_kernel(cfg.kernel, cfg.k, pack.ccap))
     return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert)

@@ -415,11 +415,11 @@ def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
 
 
 @functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
-                                             "interpret", "tile"))
+                                             "interpret", "tile", "kernel"))
 def _chip_solve(spts, ext_pts, ext_ids, ext_starts, ext_counts,
                 classes: Tuple[ClassPlan, ...], inv_loc, lo_rows, hi_rows,
                 k: int, exclude_self: bool, domain: float, interpret: bool,
-                tile: int):
+                tile: int, kernel: str = "kpass"):
     """One chip's steady-state solve over its prepared state: per-class
     launches (prepacked kernel inputs for pallas routes), one local-row
     gather, original-id translation through the exchanged id blocks, and the
@@ -429,13 +429,15 @@ def _chip_solve(spts, ext_pts, ext_ids, ext_starts, ext_counts,
     flats_d, flats_i = [], []
     for cp in classes:
         fd, fi = _class_flat(ext_pts, ext_starts, ext_counts, cp, k,
-                             exclude_self, tile, interpret)
+                             exclude_self, tile, interpret, kernel)
         flats_d.append(fd)
         flats_i.append(fi)
     flat_d = jnp.concatenate(flats_d, axis=0)
     flat_i = jnp.concatenate(flats_i, axis=0)
     row_d = jnp.take(flat_d, inv_loc, axis=0)                # (pcap, k)
     row_i = jnp.take(flat_i, inv_loc, axis=0)
+    # raw k-th BEFORE sanitization (blocked-kernel deficit rows carry NaN)
+    raw_kth = row_d[:, k - 1]
     ok = jnp.isfinite(row_d)
     row_i = jnp.where(ok, row_i, INVALID_ID)
     row_d = jnp.where(ok, row_d, jnp.inf)
@@ -445,8 +447,8 @@ def _chip_solve(spts, ext_pts, ext_ids, ext_starts, ext_counts,
         row_i >= 0,
         jnp.take(ext_ids, jnp.clip(row_i, 0, n_ext - 1), axis=0),
         INVALID_ID)
-    cert = row_d[:, k - 1] <= _margin_sq(spts[:, None, :], lo_rows, hi_rows,
-                                         domain)[:, 0]
+    cert = raw_kth <= _margin_sq(spts[:, None, :], lo_rows, hi_rows,
+                                 domain)[:, 0]
     return nbr_orig, row_d, cert
 
 
@@ -596,6 +598,20 @@ class ShardedKnnProblem:
                 (int(sh.index[0].start or 0),
                  np.asarray(sh.data).reshape(sh.data.shape[1:]))
                 for sh in dev["counts"].addressable_shards)
+            # the (nproc, local, ...) -> (ndev, ...) reshape below is only
+            # valid when the mesh is process-major (process p owns the
+            # contiguous chips [p*local, (p+1)*local), as z_mesh guarantees);
+            # anything else would silently plan every chip from another
+            # chip's occupancy
+            nloc = len(local)
+            expect0 = jax.process_index() * nloc
+            got = [idx for idx, _ in local]
+            if got != list(range(expect0, expect0 + nloc)):
+                raise ValueError(
+                    f"multi-host mesh is not process-major: process "
+                    f"{jax.process_index()} owns mesh positions {got}, "
+                    f"expected {list(range(expect0, expect0 + nloc))}; "
+                    f"build the mesh with parallel.distributed.z_mesh()")
             loc_block = np.stack([blk for _, blk in local])
             counts_all = np.asarray(
                 multihost_utils.process_allgather(loc_block)).reshape(
@@ -696,7 +712,7 @@ class ShardedKnnProblem:
                 spts, ext_pts, ext_ids, ext_starts,
                 ext_counts, classes, inv_loc, lo_rows, hi_rows,
                 cfg.k, cfg.exclude_self, meta.domain, cfg.interpret,
-                cfg.stream_tile)
+                cfg.stream_tile, cfg.kernel)
         # memoized for stats() margin telemetry (released by drop_ready)
         self._device_out_cache = outs
         return outs

@@ -51,9 +51,15 @@ def margin_summary(kth_sq: np.ndarray, margin_sq: np.ndarray
     (/root/reference/knearests.cu:378-390 -- racy and diagnostic-only there):
     ratio r in [0, 1) means the query's k-th neighbor used fraction r of its
     certificate margin; r close to 1 means the planner's radius choice
-    (ops/adaptive.py) barely held, r >= 1 means the query decertified and was
-    resolved by the exact fallback.  An infinite margin (box unconstrained on
-    every axis by the domain boundary) can never decertify -> ratio 0.
+    (ops/adaptive.py) barely held.  r >= 1 ("decertified") means the EXACT
+    k-th distance exceeds the margin, i.e. the grid route could never have
+    certified this query.  Note this is computed from final (post-fallback)
+    distances, so transient in-kernel decertifications that the fallback
+    found to be fine (e.g. blocked-kernel deficits) do not count -- it
+    measures the planner's geometry, not the runtime fallback rate (that is
+    ``certified_fraction``/``uncertified`` in problem_stats).  An infinite
+    margin (box unconstrained on every axis by the domain boundary) can
+    never decertify -> ratio 0.
     """
     kth = np.asarray(kth_sq, np.float64)
     msq = np.asarray(margin_sq, np.float64)

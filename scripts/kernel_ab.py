@@ -1,0 +1,68 @@
+"""A/B the Pallas top-k kernels (kpass vs blocked) on the live chip.
+
+Prints one JSON line per (config, kernel): steady-state solve seconds,
+queries/s, and the PRE-fallback certified fraction (deficits show up here;
+the end-to-end result is exact either way).  Run on a healthy accelerator:
+
+    python scripts/kernel_ab.py [--quick]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # PYTHONPATH breaks axon plugin discovery
+
+import jax
+import numpy as np
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.io import get_dataset
+
+
+def steady(fn, iters=5):
+    fn()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="k=10 only")
+    args = ap.parse_args()
+    platform = jax.devices()[0].platform
+    blue = get_dataset("900k_blue_cube.xyz")
+    ks = (10,) if args.quick else (10, 20)
+    for k in ks:
+        for kern in ("kpass", "blocked"):
+            from cuda_knearests_tpu.ops.adaptive import solve_adaptive
+
+            cfg = KnnConfig(k=k, kernel=kern)
+            p = KnnProblem.prepare(blue, cfg)
+            raw = solve_adaptive(p.grid, cfg, p.aplan)
+            pre_cert = float(np.asarray(raw.certified).mean())
+
+            def run():
+                r = p.solve()
+                jax.block_until_ready((r.neighbors, r.dists_sq, r.certified))
+
+            t = steady(run)
+            print(json.dumps({
+                "config": f"north star 900k (k={k})", "kernel": kern,
+                "solve_s": round(t, 4),
+                "value": round(blue.shape[0] / t, 1),
+                "unit": "queries/sec",
+                "pre_fallback_certified": round(pre_cert, 6),
+                "platform": platform,
+            }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,13 +63,6 @@ def run_and_record(argv: list[str], out_path: str, timeout_s: float) -> int:
               "wall_s": round(time.time() - t0, 1), "lines": lines,
               "stderr_tail": stderr[-2000:]}
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    if _artifact_good(out_path) and not (
-            rc == 0 and lines
-            and all(ln.get("platform") not in (None, "", "cpu", "unknown")
-                    for ln in lines)):
-        # never clobber a captured-good record with a failed or CPU-fallback
-        # retry; keep the evidence next to it
-        out_path = out_path.replace(".json", ".failed.json")
     with open(out_path, "w") as f:
         json.dump(record, f, indent=1)
     print(f"[tpu_watch] {out_path}: rc={rc} lines={len(lines)} "
@@ -123,9 +116,13 @@ def main(argv=None) -> int:
             os.environ["BENCH_PROBE_CACHE_TTL_S"] = "0"
             ns_path = os.path.join(outdir, f"{args.tag}_tpu_north_star.json")
             all_path = os.path.join(outdir, f"{args.tag}_tpu_all_rows.json")
+            ab_path = os.path.join(outdir, f"{args.tag}_tpu_kernel_ab.json")
             run_and_record([py, bench], ns_path, timeout_s=1800)
             run_and_record([py, bench, "--all"], all_path, timeout_s=3600)
-            if _artifact_good(ns_path) and _artifact_good(all_path):
+            run_and_record(
+                [py, os.path.join(REPO, "scripts", "kernel_ab.py")],
+                ab_path, timeout_s=2400)
+            if all(_artifact_good(p) for p in (ns_path, all_path, ab_path)):
                 print("[tpu_watch] record captured", flush=True)
                 return 0
             # chip answered the probe but the run failed -- transport may
