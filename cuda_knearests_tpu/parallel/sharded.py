@@ -359,10 +359,10 @@ def _assemble_ext(spts, sids, counts, lo_pts, lo_ids, lo_counts,
     return ext_pts, ext_ids, ext_starts, ext_counts
 
 
-@functools.partial(jax.jit, static_argnames=("hcap",))
+@functools.partial(jax.jit, static_argnames=("hcap", "k"))
 def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
                       hi_pts, hi_ids, hi_counts,
-                      classes: Tuple[ClassPlan, ...], hcap: int):
+                      classes: Tuple[ClassPlan, ...], hcap: int, k: int):
     """One chip's static solve state, built once per problem (the sharded
     analog of the single-chip plan-time prepack).
 
@@ -372,18 +372,23 @@ def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
     solves are then per-class launches + one row gather, with no per-solve
     packing or scatter (measured 3.3x on the single-chip path, DESIGN.md).
 
-    Returns (spts, ext arrays, classes-with-pk, inv_loc (pcap,),
-    lo_rows/hi_rows (pcap, 3) certificate boxes per local row).
+    Returns (spts, ext arrays, classes-with-pk,
+    inv_loc = (inv_base (pcap,), inv_istride (pcap,)) raw-output index maps
+    for the local rows, lo_rows/hi_rows (pcap, 3) certificate boxes per
+    local row).
     """
     pcap = spts.shape[0]
     ext_pts, ext_ids, ext_starts, ext_counts = _assemble_ext(
         spts, sids, counts, lo_pts, lo_ids, lo_counts, hi_pts, hi_ids,
         hi_counts, hcap)
 
+    from ..ops.adaptive import _class_inverse_update
+
     n_ext = ext_pts.shape[0]
-    inv_flat = jnp.zeros((n_ext,), jnp.int32)
+    inv_base = jnp.zeros((n_ext,), jnp.int32)
+    inv_istride = jnp.ones((n_ext,), jnp.int32)
     inv_box = jnp.zeros((n_ext,), jnp.int32)
-    flat_off = box_off = 0
+    elem_off = box_off = 0
     packed = []
     for cp in classes:
         if cp.route == "pallas":
@@ -391,18 +396,13 @@ def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
                 ext_pts, ext_starts, ext_counts, cp.own, cp.cand,
                 cp.qcap_pad, cp.ccap))
         packed.append(cp)
-        # invert this class's slot partition (local rows only own slots here:
-        # own cells never cover halo layers)
-        q_idx, q_ok = pack_cells(cp.own, ext_starts, ext_counts, cp.qcap_pad)
-        slot = (jnp.arange(cp.n_sc * cp.qcap_pad, dtype=jnp.int32)
-                .reshape(cp.n_sc, cp.qcap_pad))
-        safe = jnp.where(q_ok, q_idx, n_ext)
-        inv_flat = inv_flat.at[safe].set(flat_off + slot, mode="drop")
-        rows = jnp.broadcast_to(
-            jnp.arange(cp.n_sc, dtype=jnp.int32)[:, None], q_idx.shape)
-        inv_box = inv_box.at[safe].set(box_off + rows, mode="drop")
-        flat_off += cp.n_sc * cp.qcap_pad
-        box_off += cp.n_sc
+        # invert this class's slot partition (local rows only own slots
+        # here: own cells never cover halo layers) via the shared layout
+        # encoder -- one source of truth for the raw-output index maps
+        inv_base, inv_istride, inv_box, elem_off, box_off = (
+            _class_inverse_update(inv_base, inv_istride, inv_box, cp,
+                                  ext_starts, ext_counts, n_ext, k,
+                                  elem_off, box_off))
 
     loc = slice(hcap, hcap + pcap)
     box_loc = inv_box[loc]
@@ -411,7 +411,7 @@ def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
     hi_rows = jnp.take(jnp.concatenate([cp.hi for cp in classes], axis=0),
                        box_loc, axis=0)
     return (spts, ext_pts, ext_ids, ext_starts, ext_counts, tuple(packed),
-            inv_flat[loc], lo_rows, hi_rows)
+            (inv_base[loc], inv_istride[loc]), lo_rows, hi_rows)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
@@ -432,10 +432,13 @@ def _chip_solve(spts, ext_pts, ext_ids, ext_starts, ext_counts,
                              exclude_self, tile, interpret, kernel)
         flats_d.append(fd)
         flats_i.append(fi)
-    flat_d = jnp.concatenate(flats_d, axis=0)
+    flat_d = jnp.concatenate(flats_d, axis=0)                # 1-D raw concat
     flat_i = jnp.concatenate(flats_i, axis=0)
-    row_d = jnp.take(flat_d, inv_loc, axis=0)                # (pcap, k)
-    row_i = jnp.take(flat_i, inv_loc, axis=0)
+    inv_base, inv_istride = inv_loc
+    idx = (inv_base[:, None]
+           + jnp.arange(k, dtype=jnp.int32)[None, :] * inv_istride[:, None])
+    row_d = jnp.take(flat_d, idx)                            # (pcap, k)
+    row_i = jnp.take(flat_i, idx)
     # raw k-th BEFORE sanitization (blocked-kernel deficit rows carry NaN)
     raw_kth = row_d[:, k - 1]
     ok = jnp.isfinite(row_d)
@@ -671,7 +674,8 @@ class ShardedKnnProblem:
                 inp["spts"], inp["sids"], inp["counts"],
                 inp["lo_pts"], inp["lo_ids"], inp["lo_counts"],
                 inp["hi_pts"], inp["hi_ids"], inp["hi_counts"],
-                self.chip_plans[d].classes, hcap=self.meta.hcap)
+                self.chip_plans[d].classes, hcap=self.meta.hcap,
+                k=self.config.k)
         return self._ready_cache[d]
 
     def drop_ready(self, chip: Optional[int] = None) -> None:
