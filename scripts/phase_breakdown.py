@@ -1,0 +1,100 @@
+"""Solve-phase breakdown on the live chip (VERDICT r3 next #4).
+
+Splits the north-star (and optionally 10M) solve into measurable phases by
+timing nested subsets of the computation:
+
+  kernel    -- the per-class Pallas launches alone (prepacked inputs)
+  +epilogue -- _solve_adaptive: kernel + raw-layout row gather + certificate
+  +sync     -- KnnProblem.solve(): adds the certified-count readback and
+               fallback gate (host sync)
+
+Each line is JSON with per-phase milliseconds and the derived percentage
+table for DESIGN.md.  The deltas are indicative, not exact -- XLA fuses each
+program independently -- but they answer the question the reference answers
+with nvprof + -lineinfo (CMakeLists.txt:13): where does the solve time go?
+
+Run on a healthy accelerator: python scripts/phase_breakdown.py [--ten-m]
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # PYTHONPATH breaks axon plugin discovery
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import numpy as np
+
+from kernel_ab import steady  # shared steady-state timing methodology
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.io import get_dataset, generate_uniform
+
+
+def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
+    from cuda_knearests_tpu.ops.adaptive import (_class_flat, _solve_adaptive)
+
+    platform = jax.devices()[0].platform
+    p = KnnProblem.prepare(points, cfg)
+    plan = p.aplan
+    grid = p.grid
+
+    kernel_only = jax.jit(
+        lambda pts, st, ct, classes: [
+            _class_flat(pts, st, ct, cp, cfg.k, cfg.exclude_self,
+                        cfg.stream_tile, cfg.interpret, cfg.kernel)
+            for cp in classes])
+
+    def t_kernel():
+        out = kernel_only(grid.points, grid.cell_starts, grid.cell_counts,
+                          plan.classes)
+        jax.block_until_ready(out)
+
+    def t_epilogue():
+        out = _solve_adaptive(grid.points, grid.cell_starts,
+                              grid.cell_counts, plan, cfg.k,
+                              cfg.exclude_self, grid.domain, cfg.interpret,
+                              cfg.stream_tile, cfg.kernel)
+        jax.block_until_ready(out)
+
+    def t_full():
+        r = p.solve()
+        jax.block_until_ready((r.neighbors, r.dists_sq, r.certified))
+
+    ms_k = steady(t_kernel) * 1e3
+    ms_e = steady(t_epilogue) * 1e3
+    ms_f = steady(t_full) * 1e3
+    n = points.shape[0]
+    print(json.dumps({
+        "config": tag, "platform": platform, "kernel": cfg.kernel,
+        "n_points": int(n),
+        "kernel_ms": round(ms_k, 2),
+        "kernel_plus_epilogue_ms": round(ms_e, 2),
+        "full_solve_ms": round(ms_f, 2),
+        "epilogue_ms": round(ms_e - ms_k, 2),
+        "sync_fallback_ms": round(ms_f - ms_e, 2),
+        "kernel_pct": round(100 * ms_k / ms_f, 1),
+        "epilogue_pct": round(100 * (ms_e - ms_k) / ms_f, 1),
+        "sync_pct": round(100 * (ms_f - ms_e) / ms_f, 1),
+        "qps": round(n / (ms_f / 1e3), 1),
+    }), flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ten-m", action="store_true",
+                    help="also profile the 10M single-chip config")
+    args = ap.parse_args()
+    blue = get_dataset("900k_blue_cube.xyz")
+    for kern in ("kpass", "blocked"):
+        breakdown(f"north star 900k k=10 [{kern}]", blue,
+                  KnnConfig(k=10, kernel=kern))
+    if args.ten_m:
+        breakdown("uniform 10M k=10 [kpass]", generate_uniform(
+            10_000_000, seed=10), KnnConfig(k=10))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

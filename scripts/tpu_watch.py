@@ -122,7 +122,12 @@ def main(argv=None) -> int:
             run_and_record(
                 [py, os.path.join(REPO, "scripts", "kernel_ab.py")],
                 ab_path, timeout_s=2400)
-            if all(_artifact_good(p) for p in (ns_path, all_path, ab_path)):
+            ph_path = os.path.join(outdir, f"{args.tag}_tpu_phases.json")
+            run_and_record(
+                [py, os.path.join(REPO, "scripts", "phase_breakdown.py"),
+                 "--ten-m"], ph_path, timeout_s=2400)
+            if all(_artifact_good(p)
+                   for p in (ns_path, all_path, ab_path, ph_path)):
                 print("[tpu_watch] record captured", flush=True)
                 return 0
             # chip answered the probe but the run failed -- transport may
