@@ -147,7 +147,7 @@ def blocked_topm(k: int, ccap: int) -> int:
     g = ccap // 128
     if ccap % 128 != 0 or g < 2:
         return 0
-    m = min(-(-k // g) + 4, 12)
+    m = min(max(-(-k // g) + 4, -(-3 * k // g)), 16)
     return m if m * g >= 3 * k else 0
 
 

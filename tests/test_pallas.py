@@ -150,7 +150,8 @@ def test_blocked_topm_policy():
 
     assert blocked_topm(10, 1152) == 6       # ceil(10/9)+4
     assert blocked_topm(20, 1152) == 7
-    assert blocked_topm(50, 1152) == 0       # pool < 3k -> kpass
+    assert blocked_topm(50, 1152) == 0       # pool < 3k even at m=16 -> kpass
+    assert blocked_topm(50, 2304) == 9       # m bumps to cover 3k (G=18)
     assert blocked_topm(10, 128) == 0        # single block
     assert blocked_topm(10, 1000) == 0       # not 128-aligned
     assert resolve_kernel("auto", 10, 1152) == "blocked"
