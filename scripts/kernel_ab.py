@@ -38,29 +38,51 @@ def main() -> int:
     args = ap.parse_args()
     platform = jax.devices()[0].platform
     blue = get_dataset("900k_blue_cube.xyz")
+
+    def measure(tag: str, cfg: KnnConfig) -> None:
+        from cuda_knearests_tpu.config import resolve_kernel
+        from cuda_knearests_tpu.ops.adaptive import solve_adaptive
+
+        p = KnnProblem.prepare(blue, cfg)
+        raw = solve_adaptive(p.grid, cfg, p.aplan)
+        pre_cert = float(np.asarray(raw.certified).mean())
+
+        def run():
+            r = p.solve()
+            jax.block_until_ready((r.neighbors, r.dists_sq, r.certified))
+
+        t = steady(run)
+        # record what actually RAN, not just what was requested: both
+        # degradations (blocked->kpass via resolve_kernel, pallas->other
+        # routes via the planner) are silent by design and would otherwise
+        # mislabel the A/B rows
+        classes = [{"route": c.route, "ccap": c.ccap,
+                    "resolved_kernel": (resolve_kernel(cfg.kernel, cfg.k,
+                                                       c.ccap)
+                                        if c.route == "pallas" else None)}
+                   for c in p.aplan.classes]
+        print(json.dumps({
+            "config": tag, "kernel_requested": cfg.kernel,
+            "classes": classes,
+            "supercell": cfg.supercell,
+            "solve_s": round(t, 4),
+            "value": round(blue.shape[0] / t, 1),
+            "unit": "queries/sec",
+            "pre_fallback_certified": round(pre_cert, 6),
+            "platform": platform,
+        }), flush=True)
+
     ks = (10,) if args.quick else (10, 20)
     for k in ks:
         for kern in ("kpass", "blocked"):
-            from cuda_knearests_tpu.ops.adaptive import solve_adaptive
-
-            cfg = KnnConfig(k=k, kernel=kern)
-            p = KnnProblem.prepare(blue, cfg)
-            raw = solve_adaptive(p.grid, cfg, p.aplan)
-            pre_cert = float(np.asarray(raw.certified).mean())
-
-            def run():
-                r = p.solve()
-                jax.block_until_ready((r.neighbors, r.dists_sq, r.certified))
-
-            t = steady(run)
-            print(json.dumps({
-                "config": f"north star 900k (k={k})", "kernel": kern,
-                "solve_s": round(t, 4),
-                "value": round(blue.shape[0] / t, 1),
-                "unit": "queries/sec",
-                "pre_fallback_certified": round(pre_cert, 6),
-                "platform": platform,
-            }), flush=True)
+            measure(f"north star 900k (k={k})", KnnConfig(k=k, kernel=kern))
+    if not args.quick:
+        # blocked shifts the cost balance toward per-block fixed work, so a
+        # bigger supercell (more candidates amortized per tile) may win where
+        # kpass measured best at sc=3 -- capture the curve while the chip is up
+        for sc in (4, 5):
+            measure(f"north star 900k (k=10, sc={sc})",
+                    KnnConfig(k=10, kernel="blocked", supercell=sc))
     return 0
 
 

@@ -157,3 +157,19 @@ def test_query_single_and_boundary(prepared):
     for i in range(len(qs)):
         dd = ((qs[i] - points) ** 2).sum(-1)
         assert set(np.argsort(dd, kind="stable")[:10]) == set(nbrs[i].tolist())
+
+
+def test_query_blocked_kernel_matches_kpass(prepared):
+    """External queries through the class schedule give identical answers
+    under both kernel extraction strategies (interpret mode)."""
+    from cuda_knearests_tpu.io import generate_uniform
+
+    points, _ = prepared
+    queries = generate_uniform(200, seed=91)
+    outs = {}
+    for kern in ("kpass", "blocked"):
+        p = KnnProblem.prepare(points, KnnConfig(
+            k=10, backend="pallas", interpret=True, kernel=kern))
+        outs[kern] = p.query(queries, k=10)
+    np.testing.assert_array_equal(outs["kpass"][0], outs["blocked"][0])
+    np.testing.assert_array_equal(outs["kpass"][1], outs["blocked"][1])

@@ -398,3 +398,19 @@ def test_sharded_drop_ready_releases_and_rebuilds(blue_8k):
     some = next(iter(sp._ready_cache))
     sp.drop_ready(some)
     assert some not in sp._ready_cache
+
+
+def test_sharded_blocked_kernel_matches_xla(blue_8k):
+    """The blocked two-stage kernel rides the per-chip class schedule too:
+    sharded results with kernel='blocked' (interpret) must match the XLA
+    scan bit-for-bit, including halo-crossing neighbors."""
+    cfg_x = KnnConfig(k=8, sc_batch=16, backend="xla")
+    cfg_b = KnnConfig(k=8, sc_batch=16, backend="pallas", interpret=True,
+                      kernel="blocked")
+    nx, dx, cx = ShardedKnnProblem.prepare(blue_8k, n_devices=2,
+                                           config=cfg_x).solve()
+    nb, db, cb = ShardedKnnProblem.prepare(blue_8k, n_devices=2,
+                                           config=cfg_b).solve()
+    np.testing.assert_array_equal(nx, nb)
+    np.testing.assert_array_equal(dx, db)
+    assert cx.all() and cb.all()
