@@ -84,7 +84,11 @@ class KnnConfig:
         or leave them best-effort ('none').
       backend: 'pallas' = fused VMEM kernel (ops/pallas_solve.py), 'xla' = pure
         XLA supercell scan (ops/solve.py), 'auto' = pallas on TPU when the tile
-        fits VMEM, else xla.
+        fits VMEM, else xla.  'oracle' = answer through the native C++ kd-tree
+        (the reference's own CPU path promoted to a first-class engine): exact
+        by construction, all rows certified, and the fastest exact CPU route
+        (~3x the grid's dense route on the 900k north star) -- the right
+        choice on accelerator-less hosts; no accelerator involvement at all.
       interpret: run Pallas kernels in interpreter mode (CPU testing).
       adaptive: partition supercells into per-radius capacity classes sized
         from local ring occupancy (ops/adaptive.py) -- the planner analog of

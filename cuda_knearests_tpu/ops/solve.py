@@ -306,6 +306,13 @@ def pick_backend(cfg: KnnConfig, qcap: int, ccap: int) -> str:
             raise ValueError(
                 "backend='pallas' computes 'diff' distances only; use "
                 "dist_method='diff' or backend='xla'")
+        if cfg.backend == "oracle":
+            # the oracle engine is handled entirely in api.KnnProblem; a
+            # grid path asked to run it must refuse rather than silently
+            # substitute the grid engine
+            raise ValueError(
+                "backend='oracle' is a single-chip host engine "
+                "(api.KnnProblem); this path has no oracle route")
         return cfg.backend
     if cfg.dist_method == "dot":
         return "xla"  # the kernel has no 'dot' arithmetic; honor the request

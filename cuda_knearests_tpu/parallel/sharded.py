@@ -544,6 +544,10 @@ class ShardedKnnProblem:
         from ..io import validate_points
 
         config = config or KnnConfig()
+        if config.backend == "oracle":
+            raise ValueError(
+                "backend='oracle' is a single-chip host engine; the sharded "
+                "path runs grid engines only ('auto'/'pallas'/'xla')")
         if mesh is None:
             n_devices = n_devices or len(jax.devices())
             mesh = jax.make_mesh((n_devices,), ("z",))

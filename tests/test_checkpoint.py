@@ -88,3 +88,24 @@ def test_checkpoint_query_after_resume(tmp_path):
     for i in (0, 50, 99):
         dd = ((queries[i] - points) ** 2).sum(-1)
         assert set(np.argsort(dd, kind="stable")[:6]) == set(nbrs[i].tolist())
+
+
+def test_oracle_backend_checkpoint_roundtrip(tmp_path, blue_8k):
+    """A saved backend='oracle' problem must rebuild its kd-tree on load --
+    solve() and query() work after the round-trip."""
+    import numpy as np
+
+    from cuda_knearests_tpu import (KnnConfig, KnnProblem, load_problem,
+                                    save_problem)
+
+    p = KnnProblem.prepare(blue_8k, KnnConfig(k=8, backend="oracle"))
+    p.solve()
+    path = str(tmp_path / "oracle_ckpt")
+    save_problem(p, path)
+    q = load_problem(path)
+    r = q.solve()
+    assert np.asarray(r.certified).all()
+    np.testing.assert_array_equal(p.get_knearests_original(),
+                                  q.get_knearests_original())
+    qi, qd = q.query(blue_8k[:10] + 0.5, k=8)
+    assert qi.shape == (10, 8)
