@@ -227,6 +227,15 @@ def bench_north_star() -> dict:
     return out
 
 
+def _engine_suffix(problem) -> str:
+    """Row-label suffix when the measured engine differs from the one the
+    config name describes (the CPU-host oracle swap): artifacts from
+    different rounds must never compare different engines under identical
+    labels."""
+    return (" [engine: native kd-tree]"
+            if problem.config.backend == "oracle" else "")
+
+
 def bench_config(name: str) -> dict:
     """One of the BASELINE.json configs by short name."""
     import jax
@@ -243,21 +252,24 @@ def bench_config(name: str) -> dict:
     if name == "grid_300k_k10":
         points = get_dataset("pts300K.xyz")
         qps, s, prob = _solve_qps(points, KnnConfig(k=10))
-        return {"config": "uniform-grid kNN on pts300K.xyz (k=10, single-chip)",
+        return {"config": "uniform-grid kNN on pts300K.xyz (k=10, single-chip)"
+                          + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
                 "solve_s": round(s, 4), "n_points": points.shape[0]}
     if name == "blue_900k_k20":
         points = get_dataset("900k_blue_cube.xyz")
         qps, s, prob = _solve_qps(points, KnnConfig(k=20))
-        return {"config": "blue-noise 900k_blue_cube.xyz (k=20, single-chip)",
+        return {"config": "blue-noise 900k_blue_cube.xyz (k=20, single-chip)"
+                          + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
                 "solve_s": round(s, 4), "n_points": points.shape[0]}
     if name == "batched_300k_k50":
         points = get_dataset("pts300K.xyz")
         qps, s, prob = _solve_qps(points, KnnConfig(k=50))
-        return {"config": "all-points-as-queries batched kNN (N=300K, k=50)",
+        return {"config": "all-points-as-queries batched kNN (N=300K, k=50)"
+                          + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
                 "solve_s": round(s, 4), "n_points": points.shape[0]}

@@ -669,6 +669,11 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                 f"against a different grid?")
             cx, cy, cz, cid3 = cp.pk.cx, cp.pk.cy, cp.pk.cz, cp.pk.cid3
         else:
+            # this pack skips _pack_inputs' slot interleave, which the
+            # blocked kernel's per-block top-m depends on (without it, near
+            # candidates concentrate in one block and deficits become
+            # routine) -- force the order-insensitive kpass body here
+            kernel = "kpass"
             c_idx, c_ok = pack_cells(cp.cand, starts, counts, cp.ccap)
             axes = points.T
             cx, cy, cz = (jnp.take(axes[ax], c_idx, axis=0)
@@ -687,6 +692,9 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                                     resolve_kernel(kernel, k, cp.ccap))
         # gather straight from the raw (Sc, k, q2cap) layout (no transpose):
         # query at (row, rank) reads elem row*k*q2cap + i*q2cap + rank
+        assert cp.n_sc * k * q2cap <= 2**31 - 1, (
+            "raw query output exceeds int32 indexing; reduce the query "
+            "batch or k")
         base = (inv // q2cap) * (k * q2cap) + inv % q2cap
         qidx = (base[:, None]
                 + jnp.arange(k, dtype=jnp.int32)[None, :] * q2cap)

@@ -185,11 +185,14 @@ def _kernel_blocked(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref,
     """
     c_total = cx_ref.shape[2]
     n_blocks = c_total // 128
+    q_lanes = qx_ref.shape[2]
     qa = [r[0, 0, :].reshape(-1, 1) for r in (qx_ref, qy_ref, qz_ref)]
     qi = qid_ref[0, 0, :].reshape(-1, 1) if exclude_self else None
 
-    kept_d, kept_i, rems = [], [], []
-    for g in range(n_blocks):
+    def block_topm(g):
+        """One block's ascending top-m + its smallest remaining value, all
+        sublane-major ((m, Q) kept, (1, Q) rem) so the rolled path can
+        dynamic-update rows (sublane offsets; lane offsets stay static)."""
         sl = pl.ds(g * 128, 128)
         d2b = None
         for q_col, c_ref in zip(qa, (cx_ref, cy_ref, cz_ref)):
@@ -201,34 +204,68 @@ def _kernel_blocked(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref,
         if exclude_self:
             drop = drop | (qi == cib)
         d2b = jnp.where(drop, jnp.inf, d2b)
+        kd, ki = [], []
         for j in range(m):
             mv = jnp.min(d2b, axis=1)
             sel = d2b == mv[:, None]
             bid = jnp.min(jnp.where(sel, cib, _BIG_ID), axis=1)
-            kept_d.append(mv)
-            kept_i.append(bid)
+            kd.append(mv)
+            ki.append(bid)
             d2b = jnp.where(sel & (cib == bid[:, None]), jnp.inf, d2b)
         # smallest value the block did NOT keep (inf when it kept all it
         # had) -- the exact lower bound on anything hidden in this block
-        rems.append(jnp.min(d2b, axis=1))
+        return (jnp.stack(kd, axis=0), jnp.stack(ki, axis=0),
+                jnp.min(d2b, axis=1).reshape(1, -1))
 
-    pool_d = jnp.stack(kept_d, axis=1)                    # (Q, G*m)
-    pool_i = jnp.stack(kept_i, axis=1)
-    rem = jnp.stack(rems, axis=1)                         # (Q, G)
+    # Mosaic compile cost scales with unrolled op count; the kpass kernel
+    # rolls above _UNROLL_K_MAX passes for the same reason.  Stage 1 is
+    # n_blocks*m extraction passes: unroll small schedules (registers, no
+    # carry), roll big ones over the block index with a (G*m, Q) pool carry.
+    if n_blocks * m + k <= _UNROLL_K_MAX:
+        blocks = [block_topm(g) for g in range(n_blocks)]
+        pool_d = jnp.concatenate([b[0] for b in blocks], axis=0)  # (G*m, Q)
+        pool_i = jnp.concatenate([b[1] for b in blocks], axis=0)
+        rem = jnp.concatenate([b[2] for b in blocks], axis=0)     # (G, Q)
+    else:
+        def s1_body(g, carry):
+            pool_d, pool_i, rem = carry
+            kd, ki, r = block_topm(g)
+            return (jax.lax.dynamic_update_slice(pool_d, kd, (g * m, 0)),
+                    jax.lax.dynamic_update_slice(pool_i, ki, (g * m, 0)),
+                    jax.lax.dynamic_update_slice(rem, r, (g, 0)))
 
-    t = None
-    for i in range(k):
-        mv = jnp.min(pool_d, axis=1)
-        sel = pool_d == mv[:, None]
-        bid = jnp.min(jnp.where(sel, pool_i, _BIG_ID), axis=1)
-        if i + 1 < k:
-            out_d_ref[0, i, :] = mv
+        pool_d, pool_i, rem = jax.lax.fori_loop(0, n_blocks, s1_body, (
+            jnp.full((n_blocks * m, q_lanes), jnp.inf, jnp.float32),
+            jnp.full((n_blocks * m, q_lanes), _PAD_C, jnp.int32),
+            jnp.full((n_blocks, q_lanes), jnp.inf, jnp.float32)))
+
+    def extract(pool_d):
+        mv = jnp.min(pool_d, axis=0)                              # (Q,)
+        sel = pool_d == mv[None, :]
+        bid = jnp.min(jnp.where(sel, pool_i, _BIG_ID), axis=0)
+        masked = jnp.where(sel & (pool_i == bid[None, :]), jnp.inf, pool_d)
+        return mv, bid, masked
+
+    if k <= _UNROLL_K_MAX:
+        t = None
+        for i in range(k):
+            mv, bid, masked = extract(pool_d)
             out_i_ref[0, i, :] = bid
-            pool_d = jnp.where(sel & (pool_i == bid[:, None]), jnp.inf,
-                               pool_d)
-        else:
-            t = mv
-            out_i_ref[0, i, :] = bid
+            if i + 1 < k:
+                out_d_ref[0, i, :] = mv
+                pool_d = masked
+            else:
+                t = mv
+    else:
+        def s2_body(i, pool_d):
+            mv, bid, masked = extract(pool_d)
+            out_d_ref[0, pl.ds(i, 1), :] = mv.reshape(1, -1)
+            out_i_ref[0, pl.ds(i, 1), :] = bid.reshape(1, -1)
+            return masked
+
+        pool_d = jax.lax.fori_loop(0, k - 1, s2_body, pool_d)
+        t, bid, _ = extract(pool_d)
+        out_i_ref[0, k - 1, :] = bid
     # Deficit certificate: hidden candidates in block g are >= rem[g] (the
     # smallest value that block did not keep; inf when it kept everything),
     # so the result can be wrong only if some rem < t strictly -- a hidden
@@ -237,7 +274,7 @@ def _kernel_blocked(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref,
     # blocks holding <= m real candidates and fully-padded blocks certify
     # through the normal margin check.  Flagged rows get NaN at k-1, fail
     # every certificate, and resolve via the exact fallback.
-    deficit = jnp.any(rem < t[:, None], axis=1)
+    deficit = jnp.any(rem < t[None, :], axis=0)
     out_d_ref[0, k - 1, :] = jnp.where(deficit, jnp.nan, t)
 
 
@@ -401,6 +438,11 @@ def _solve_packed(pack: PallasPack, points: jax.Array, k: int,
     # the (S,k,Q)->(S*Q,k) transposes that used to precede the row gather
     # (VERDICT r3 weak #2: they survived in the hot path).
     qcap = pack.qcap
+    if pack.s_total * k * qcap > 2**31 - 1:
+        raise ValueError(
+            f"raw kernel output exceeds int32 indexing "
+            f"({pack.s_total * k * qcap} elements): shard the problem or "
+            f"reduce k")  # wrapped indices would gather wrong-yet-certifiable rows
     lane = pack.inv_flat % qcap
     base = pack.inv_sc * (k * qcap) + lane                 # (n,)
     idx = base[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :] * qcap

@@ -865,20 +865,36 @@ class ShardedKnnProblem:
                              "qcap": cp.qcap, "ccap": cp.ccap,
                              "route": cp.route} for cp in plan.classes],
             }
-            # per-chip achieved-margin telemetry (the fixed max-visited-ring
+            # Per-chip achieved-margin telemetry (the fixed max-visited-ring
             # analog, knearests.cu:378-390) when a solve has run and the
-            # chip's prepared state is still cached
+            # chip's prepared state is still cached.  margin_summary's
+            # contract is post-fallback ("measures the planner's geometry"):
+            # prefer the assembled solve() rows; before assembly, only a
+            # fully-certified chip can report (pre-fallback outputs would
+            # count resolvable in-kernel decertifications, e.g. blocked-
+            # kernel deficits, as geometric failures).
             out = (self._device_out_cache or {}).get(d)
             if out is not None and d in self._ready_cache:
                 (spts, *_rest, lo_rows, hi_rows) = self._ready_cache[d]
                 sids = np.asarray(jax.device_get(inp["sids"]))
                 real = sids >= 0
-                kth = np.asarray(jax.device_get(out[1]))[real, -1]
-                msq = _margin_sq_np(
-                    np.asarray(jax.device_get(spts))[real],
-                    np.asarray(jax.device_get(lo_rows))[real],
-                    np.asarray(jax.device_get(hi_rows))[real], meta.domain)
-                row["margin"] = margin_summary(kth, msq)
+                kth = None
+                if self._solved_cache is not None:
+                    kth = np.asarray(
+                        self._solved_cache[1])[sids[real], -1]
+                else:
+                    cert = np.asarray(jax.device_get(out[2]))[real]
+                    if cert.all():
+                        kth = np.asarray(jax.device_get(out[1]))[real, -1]
+                    else:
+                        row["margin_pending_fallback"] = int((~cert).sum())
+                if kth is not None:
+                    msq = _margin_sq_np(
+                        np.asarray(jax.device_get(spts))[real],
+                        np.asarray(jax.device_get(lo_rows))[real],
+                        np.asarray(jax.device_get(hi_rows))[real],
+                        meta.domain)
+                    row["margin"] = margin_summary(kth, msq)
             chips.append(row)
         return {
             "n_points": self.n_points,

@@ -84,7 +84,7 @@ def _artifact_good(path: str) -> bool:
     lines = d.get("lines") or []
     return (d.get("rc") == 0 and len(lines) > 0
             and all(ln.get("platform") not in (None, "", "cpu", "unknown")
-                    for ln in lines))
+                    and "error" not in ln for ln in lines))
 
 
 def main(argv=None) -> int:

@@ -158,3 +158,23 @@ def test_blocked_topm_policy():
     assert resolve_kernel("auto", 50, 1152) == "kpass"
     assert resolve_kernel("blocked", 50, 1152) == "kpass"  # silent degrade
     assert resolve_kernel("kpass", 10, 1152) == "kpass"
+
+
+@pytest.mark.slow
+def test_blocked_kernel_matches_kpass_large_fixture():
+    """Blocked == kpass at class shapes close to the north star's (60k blue
+    noise -> larger ccap/G than the default fixtures), with zero deficits
+    under the production m policy."""
+    from cuda_knearests_tpu.ops.adaptive import solve_adaptive
+
+    pts = generate_blue_noise(60_000, seed=41)
+    outs = {}
+    for kern in ("kpass", "blocked"):
+        cfg = KnnConfig(k=10, backend="pallas", interpret=True, kernel=kern)
+        p = KnnProblem.prepare(pts, cfg)
+        if kern == "blocked":
+            raw = solve_adaptive(p.grid, cfg, p.aplan)
+            assert np.asarray(raw.certified).all(), "unexpected deficits"
+        p.solve()
+        outs[kern] = p.get_knearests_original()
+    np.testing.assert_array_equal(outs["kpass"], outs["blocked"])
