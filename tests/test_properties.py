@@ -105,3 +105,33 @@ def test_normalize_always_satisfies_contract(vals):
         a = ex_in / ex_in.max()
         b = ex_out / max(ex_out.max(), 1e-12)
         np.testing.assert_allclose(a, b, atol=5e-3)
+
+
+@settings(max_examples=5, deadline=None)
+@given(st.data())
+def test_blocked_kernel_tie_semantics(data):
+    """Under heavy exact ties/duplicates (quantized coordinates), the blocked
+    kernel's distance rows must match kpass exactly; ids may flip only inside
+    exact ties at equal distance, and every reported id must realize its
+    reported distance (the kernel's documented tie contract)."""
+    n = data.draw(st.sampled_from((200, 500)))
+    k = data.draw(st.sampled_from((4, 8)))
+    pts = _points(data.draw, n, quantize=True)  # scale-10 grid: dense ties
+
+    rows = {}
+    for kern in ("kpass", "blocked"):
+        p = KnnProblem.prepare(pts, KnnConfig(
+            k=k, backend="pallas", interpret=True, kernel=kern))
+        p.solve()
+        d2 = np.empty_like(p.get_dists_sq())
+        d2[p.get_permutation()] = p.get_dists_sq()
+        rows[kern] = (p.get_knearests_original(), d2)
+    nb_k, d2_k = rows["kpass"]
+    nb_b, d2_b = rows["blocked"]
+    np.testing.assert_array_equal(d2_k, d2_b)  # distances: bit-identical
+    for qi in range(0, n, max(1, n // 25)):
+        ids = nb_b[qi][nb_b[qi] >= 0]
+        assert len(set(ids.tolist())) == ids.size  # no duplicate neighbors
+        real = ((pts[ids] - pts[qi]) ** 2).sum(-1).astype(np.float32)
+        np.testing.assert_allclose(real, d2_b[qi][: ids.size],
+                                   rtol=0, atol=0)  # ids realize distances
