@@ -138,9 +138,10 @@ class KnnProblem:
 
         backend='oracle' answers through the native C++ kd-tree instead of
         the grid engine (exact by construction, all rows certified) -- the
-        reference's own CPU path promoted to a first-class engine, and the
-        fastest exact CPU route (measured ~3x the grid's dense route on the
-        900k north star, DESIGN.md section 5)."""
+        reference's own CPU path (its kd-tree solve phase,
+        /root/reference/test_knearests.cu:194-214) promoted to a first-class
+        engine, and the fastest exact CPU route (measured ~3x the grid's
+        dense route on the 900k north star, DESIGN.md section 5)."""
         if self.config.backend == "oracle":
             ids, d2 = self._oracle.knn_all_points(self.config.k) \
                 if self.config.exclude_self else self._oracle.knn(
