@@ -81,7 +81,12 @@ class KnnConfig:
         reference's ``if (ptr == point_in) continue`` (knearests.cu:123) --
         coordinate duplicates of the query are still reported.
       fallback: resolve uncertified queries exactly by tiled brute force ('brute'),
-        or leave them best-effort ('none').
+        or leave them best-effort ('none').  With 'none', kernel='blocked'/'auto'
+        is forced to 'kpass' (see effective_kernel): a blocked-kernel deficit
+        row loses its trailing entries outright (INVALID_ID/inf) where kpass
+        returns a near-correct best-effort neighbor, so without the exact
+        fallback to resolve deficits the blocked body would be a silent
+        per-row quality regression.
       backend: 'pallas' = fused VMEM kernel (ops/pallas_solve.py), 'xla' = pure
         XLA supercell scan (ops/solve.py), 'auto' = pallas on TPU when the tile
         fits VMEM, else xla.  'oracle' = answer through the native C++ kd-tree
@@ -127,12 +132,25 @@ class KnnConfig:
     adaptive: bool = True
     max_classes: int = 4
     stream_tile: int = 2048
-    kernel: str = "kpass"
+    kernel: str = "kpass"  # solvers read effective_kernel(), not this field
 
     def resolved_ring_radius(self) -> int:
         if self.ring_radius is not None:
             return max(1, int(self.ring_radius))
         return default_ring_radius(self.k, self.density)
+
+    def effective_kernel(self) -> str:
+        """The kernel string solvers should resolve from (every solver call
+        site reads this, never the raw ``kernel`` field).  fallback='none'
+        pins blocked/auto to 'kpass': blocked deficit rows resolve through
+        the exact fallback; without one they'd silently lose their trailing
+        entries (INVALID_ID/inf) where kpass keeps a near-correct
+        best-effort neighbor (see the fallback field docs).  Unknown kernel
+        strings pass through unchanged so resolve_kernel's typo guard still
+        fires."""
+        if self.fallback == "none" and self.kernel in ("blocked", "auto"):
+            return "kpass"
+        return self.kernel
 
 
 def blocked_topm(k: int, ccap: int) -> int:

@@ -567,11 +567,14 @@ def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
 
     if cp.pk is not None:
         pk = cp.pk
-        # ClassPack reuse contract: blocks must match this plan's caps
-        assert pk.cx.shape == (cp.n_sc, 1, cp.ccap), (
-            f"ClassPack/plan mismatch: pk blocks {pk.cx.shape} vs plan "
-            f"(n_sc={cp.n_sc}, ccap={cp.ccap}); was this plan built against "
-            f"a different grid?")
+        # ClassPack reuse contract: blocks must match this plan's caps.
+        # ValueError, not assert: this guard must survive `python -O` (a
+        # mismatched pack would gather wrong-yet-certified neighbors)
+        if pk.cx.shape != (cp.n_sc, 1, cp.ccap):
+            raise ValueError(
+                f"ClassPack/plan mismatch: pk blocks {pk.cx.shape} vs plan "
+                f"(n_sc={cp.n_sc}, ccap={cp.ccap}); was this plan built "
+                f"against a different grid?")
         qx, qy, qz, cx, cy, cz = pk.qx, pk.qy, pk.qz, pk.cx, pk.cy, pk.cz
         qid3, cid3 = pk.qid3, pk.cid3
     else:
@@ -632,7 +635,7 @@ def solve_adaptive(grid: GridHash, cfg: KnnConfig,
     nbr, d2, cert = _solve_adaptive(
         grid.points, grid.cell_starts, grid.cell_counts, plan, cfg.k,
         cfg.exclude_self, grid.domain, cfg.interpret, cfg.stream_tile,
-        cfg.kernel)
+        cfg.effective_kernel())
     return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert)
 
 
@@ -662,11 +665,12 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
         if cp.pk is not None:
             # candidate half of the class's prepacked self-solve inputs --
             # identical by construction (same cand table, same ccap); see
-            # the ClassPack reuse contract
-            assert cp.pk.cx.shape == (cp.n_sc, 1, cp.ccap), (
-                f"ClassPack/plan mismatch: pk blocks {cp.pk.cx.shape} vs "
-                f"plan (n_sc={cp.n_sc}, ccap={cp.ccap}); was this plan built "
-                f"against a different grid?")
+            # the ClassPack reuse contract (ValueError: survives `python -O`)
+            if cp.pk.cx.shape != (cp.n_sc, 1, cp.ccap):
+                raise ValueError(
+                    f"ClassPack/plan mismatch: pk blocks {cp.pk.cx.shape} vs "
+                    f"plan (n_sc={cp.n_sc}, ccap={cp.ccap}); was this plan "
+                    f"built against a different grid?")
             cx, cy, cz, cid3 = cp.pk.cx, cp.pk.cy, cp.pk.cz, cp.pk.cid3
         else:
             # this pack skips _pack_inputs' slot interleave, which the
@@ -692,9 +696,12 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                                     resolve_kernel(kernel, k, cp.ccap))
         # gather straight from the raw (Sc, k, q2cap) layout (no transpose):
         # query at (row, rank) reads elem row*k*q2cap + i*q2cap + rank
-        assert cp.n_sc * k * q2cap <= 2**31 - 1, (
-            "raw query output exceeds int32 indexing; reduce the query "
-            "batch or k")
+        if cp.n_sc * k * q2cap > 2**31 - 1:
+            # ValueError, not assert: under `python -O` a wrapped int32
+            # index would gather wrong-yet-certified neighbors
+            raise ValueError(
+                "raw query output exceeds int32 indexing; reduce the query "
+                "batch or k")
         base = (inv // q2cap) * (k * q2cap) + inv % q2cap
         qidx = (base[:, None]
                 + jnp.arange(k, dtype=jnp.int32)[None, :] * q2cap)
@@ -769,7 +776,8 @@ def launch_class_query(points, starts, counts, cp: ClassPlan,
         jnp.asarray(queries_sel[order]), jnp.asarray(rstarts),
         jnp.asarray(rcounts), jnp.asarray(inv),
         jnp.asarray(rows_sorted.astype(np.int32)), q2cap, k,
-        route, domain, cfg.interpret, cfg.stream_tile, ids_map, cfg.kernel)
+        route, domain, cfg.interpret, cfg.stream_tile, ids_map,
+        cfg.effective_kernel())
     return order, r_i, r_d, r_c
 
 

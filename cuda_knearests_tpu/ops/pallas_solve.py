@@ -480,5 +480,6 @@ def solve_pallas(grid: GridHash, cfg, plan: SolvePlan | None = None,
 
     nbr, d2, cert = _solve_packed(pack, grid.points, cfg.k, cfg.exclude_self,
                                   grid.domain, cfg.interpret,
-                                  resolve_kernel(cfg.kernel, cfg.k, pack.ccap))
+                                  resolve_kernel(cfg.effective_kernel(),
+                                                 cfg.k, pack.ccap))
     return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert)

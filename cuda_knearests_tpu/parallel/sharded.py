@@ -613,12 +613,22 @@ class ShardedKnnProblem:
             nloc = len(local)
             expect0 = jax.process_index() * nloc
             got = [idx for idx, _ in local]
-            if got != list(range(expect0, expect0 + nloc)):
+            # raise *collectively*: allgather a per-process ok flag first so a
+            # bad mesh fails fast on every process with the message, instead
+            # of the passing processes entering the counts allgather and
+            # hanging until the distributed timeout
+            ok = got == list(range(expect0, expect0 + nloc))
+            all_ok = np.asarray(multihost_utils.process_allgather(
+                np.asarray([ok], dtype=np.bool_))).reshape(-1)
+            if not all_ok.all():
+                bad = [p for p, o in enumerate(all_ok) if not o]
+                mine = ("" if ok else
+                        f"; this process owns mesh positions {got}, expected "
+                        f"{list(range(expect0, expect0 + nloc))}")
                 raise ValueError(
-                    f"multi-host mesh is not process-major: process "
-                    f"{jax.process_index()} owns mesh positions {got}, "
-                    f"expected {list(range(expect0, expect0 + nloc))}; "
-                    f"build the mesh with parallel.distributed.z_mesh()")
+                    f"multi-host mesh is not process-major on process(es) "
+                    f"{bad}{mine}; build the mesh with "
+                    f"parallel.distributed.z_mesh()")
             loc_block = np.stack([blk for _, blk in local])
             counts_all = np.asarray(
                 multihost_utils.process_allgather(loc_block)).reshape(
@@ -720,7 +730,7 @@ class ShardedKnnProblem:
                 spts, ext_pts, ext_ids, ext_starts,
                 ext_counts, classes, inv_loc, lo_rows, hi_rows,
                 cfg.k, cfg.exclude_self, meta.domain, cfg.interpret,
-                cfg.stream_tile, cfg.kernel)
+                cfg.stream_tile, cfg.effective_kernel())
         # memoized for stats() margin telemetry (released by drop_ready)
         self._device_out_cache = outs
         return outs

@@ -64,6 +64,20 @@ _CACHE_TTL_ENV = "BENCH_PROBE_CACHE_TTL_S"
 _CACHE_TTL_DEFAULT = 60.0
 
 
+def _env_number(name, default, cast):
+    """Parse a numeric env knob; a malformed value must not crash every
+    entry point -- fall back to the default with a stderr note."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        print(f"ignoring malformed {name}={raw!r}; using {default}",
+              file=sys.stderr, flush=True)
+        return default
+
+
 def _probe_cache_path() -> str:
     uid = os.getuid() if hasattr(os, "getuid") else 0
     return os.path.join(tempfile.gettempdir(),
@@ -143,7 +157,7 @@ def acquire_backend(tries: int | None = None, timeout_s: float | None = None,
     explicit = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
     if explicit == "cpu":
         return "cpu", None
-    ttl_s = float(os.environ.get(_CACHE_TTL_ENV, _CACHE_TTL_DEFAULT))
+    ttl_s = _env_number(_CACHE_TTL_ENV, _CACHE_TTL_DEFAULT, float)
     if ttl_s > 0:
         cached = _read_healthy_probe_cache(ttl_s)
         if cached:
@@ -152,9 +166,9 @@ def acquire_backend(tries: int | None = None, timeout_s: float | None = None,
     # the bench's end-to-end wall budget -- while the 75s first-try timeout
     # still tolerates a slow healthy accelerator init.
     if tries is None:
-        tries = int(os.environ.get("BENCH_PROBE_TRIES", "2"))
+        tries = _env_number("BENCH_PROBE_TRIES", 2, int)
     if timeout_s is None:
-        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
+        timeout_s = _env_number("BENCH_PROBE_TIMEOUT_S", 75.0, float)
     if probe is None:
         probe = _probe_default_backend
     delay = 5.0

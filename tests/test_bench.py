@@ -54,6 +54,23 @@ def test_acquire_backend_falls_back_to_cpu(monkeypatch):
     assert jax.config.jax_platforms == "cpu"
 
 
+def test_malformed_env_knobs_fall_back_to_defaults(monkeypatch, tmp_path):
+    """Malformed BENCH_PROBE_CACHE_TTL_S / BENCH_PROBE_TRIES must not crash
+    acquire_backend; they fall back to defaults with a stderr note
+    (ADVICE r4)."""
+    from cuda_knearests_tpu.utils import platform as plat
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("BENCH_PROBE_CACHE_TTL_S", "not-a-number")
+    monkeypatch.setenv("BENCH_PROBE_TRIES", "two")
+    monkeypatch.setattr(plat, "_probe_cache_path",
+                        lambda: str(tmp_path / "probe.json"))
+    platform, note = plat.acquire_backend(timeout_s=0.1,
+                                          probe=lambda t: "tpu")
+    assert platform == "tpu"
+    assert note is None
+
+
 def test_probe_cache_skips_second_probe_within_ttl(monkeypatch, tmp_path):
     """A healthy probe result is reused by a second acquire within the TTL --
     the subprocess backend init (10-30 s over a tunnel) runs once, not per

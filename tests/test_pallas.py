@@ -164,6 +164,23 @@ def test_blocked_topm_policy():
     assert resolve_kernel("kpass", 10, 1152) == "kpass"
 
 
+def test_fallback_none_forces_kpass():
+    """Best-effort mode must not route through the blocked kernel: its
+    deficit rows lose trailing entries outright, while kpass keeps a
+    near-correct best-effort neighbor (ADVICE r4)."""
+    assert KnnConfig(kernel="blocked", fallback="none").effective_kernel() \
+        == "kpass"
+    assert KnnConfig(kernel="auto", fallback="none").effective_kernel() \
+        == "kpass"
+    assert KnnConfig(kernel="blocked", fallback="brute").effective_kernel() \
+        == "blocked"
+    assert KnnConfig(kernel="kpass", fallback="none").effective_kernel() \
+        == "kpass"
+    # typos must still reach resolve_kernel's guard, not silently pin kpass
+    assert KnnConfig(kernel="blcked", fallback="none").effective_kernel() \
+        == "blcked"
+
+
 @pytest.mark.slow
 def test_blocked_kernel_matches_kpass_large_fixture():
     """Blocked == kpass at class shapes close to the north star's (60k blue
