@@ -13,10 +13,18 @@ each, the north star last):
   5. sharded synthetic uniform 10M (k=10)          -- slab mesh over all chips
 
 The CUDA reference publishes no numbers (BASELINE.md) and no GPU exists in this
-environment to re-measure it, so ``vs_baseline`` is reported against the
-measurable bar this machine does have: the multithreaded exact CPU kd-tree
-oracle (the reference's own "knn cpu" phase, test_knearests.cu:198-214) on the
-same data -- values > 1 mean the accelerated path beats exact CPU search.
+environment to re-measure it, so ``vs_baseline`` is pinned -- identically every
+round (VERDICT r4 next #4) -- to the one measurable bar this machine has: the
+multithreaded exact CPU kd-tree oracle, build + query, same data, same machine
+(the reference's own "knn cpu" phase, test_knearests.cu:198-214).  Values > 1
+mean the accelerated path beats exact CPU search.  On CPU-fallback hosts the
+engine's fastest exact route IS that kd-tree; such rows stamp
+``vs_baseline: null`` (a same-engine ratio is not a result) and carry the
+engine/backend label instead.
+
+Every accelerated row also carries static-shape roofline fields
+(utils/roofline.py): moved bytes, achieved GB/s and GFLOP/s, and on TPU the
+percent of the v5e HBM peak -- the falsifiable form of "bandwidth-bound".
 
 Timing matches the reference's convention: compile/context cost excluded
 (steady-state min over repeats, the analog of test_knearests.cu:138-144
@@ -142,15 +150,38 @@ def _oracle_qps(points, k: int, sample_idx=None):
 
 def _brute_sample(points, idx, k: int):
     """Independent exact reference for sampled rows: plain numpy distance
-    sort, no kd-tree, no grid -- the recall source when the engine itself ran
-    as the kd-tree (oracle backend)."""
+    computation, no kd-tree, no grid -- the recall source when the engine
+    itself ran as the kd-tree (oracle backend).  Chunked + partition-then-
+    lexsort so a 4x larger default sample (VERDICT r4 weak #6) stays inside
+    the wall budget; ties resolve to the lowest stored id, the same
+    convention as the engine and the old full stable argsort."""
     import numpy as np
 
+    pts32 = np.asarray(points, np.float32)
+    pts64 = pts32.astype(np.float64)
+    n = pts32.shape[0]
     out = np.empty((idx.size, k), np.int64)
-    for row, qi in enumerate(idx):
-        d2 = ((points[qi] - points) ** 2).sum(-1)
-        d2[qi] = np.inf
-        out[row] = np.argsort(d2, kind="stable")[:k]
+    # Rank candidates by the f64 matmul identity |q-p|^2 = |q|^2+|p|^2-2q.p
+    # (one (chunk, n) temporary -- the broadcast (chunk, n, 3) form peaks ~7x
+    # higher), then RE-SCORE the survivors with the engine's own f32
+    # subtract-square-accumulate so ranking and lowest-id tie-breaks match
+    # the kernel bit-for-bit.  The k+32 partition margin means only a >32-way
+    # coincident-distance tie straddling the boundary (i.e. stacks of
+    # duplicate points) could deviate from the old full stable argsort.
+    pn = (pts64 * pts64).sum(1)
+    top = min(n - 1, k + 32)
+    chunk = max(1, int(4.0e7) // max(1, n))  # ~320MB f64 tile ceiling
+    for s in range(0, idx.size, chunk):
+        qi = idx[s:s + chunk]
+        d2 = pn[None, :] + pn[qi][:, None] - 2.0 * (pts64[qi] @ pts64.T)
+        d2[np.arange(qi.size), qi] = np.inf
+        part = np.argpartition(d2, top - 1, axis=1)[:, :top]
+        d32 = ((pts32[qi][:, None, :] - pts32[part]) ** 2).sum(
+            -1, dtype=np.float32)
+        d32[part == qi[:, None]] = np.inf
+        for row in range(qi.size):
+            order = np.lexsort((part[row], d32[row]))[:k]
+            out[s + row] = part[row][order]
     return out
 
 
@@ -190,10 +221,12 @@ def bench_north_star() -> dict:
     cpu_qps, _, (ref_ids, _) = _oracle_qps(points, k, sample_idx=sample)
     got = problem.get_knearests_original()
     if backend_used == "oracle":
-        # kd-tree vs kd-tree would be self-referential: check a (smaller)
-        # seeded sample against an independent numpy brute force instead,
-        # so the recall gate still measures something
-        bs = min(sample_n, int(os.environ.get("BENCH_BRUTE_SAMPLE", "1500")))
+        # kd-tree vs kd-tree would be self-referential: check a seeded
+        # sample against an independent numpy brute force instead.  On
+        # oracle rows this validates the harness (the engine IS the usual
+        # referee), so the default sample is 4x the old 1500 (VERDICT r4
+        # weak #6) -- the vectorized _brute_sample keeps it bounded.
+        bs = min(sample_n, int(os.environ.get("BENCH_BRUTE_SAMPLE", "6000")))
         bidx = np.sort(np.random.default_rng(77).choice(
             n, bs, replace=False).astype(np.int32))
         ref_ids = _brute_sample(points, bidx, k)
@@ -202,15 +235,24 @@ def bench_north_star() -> dict:
     else:
         recall = set_recall(got if sample is None else got[sample], ref_ids)
         recall_source = f"kd-tree({sample_n})"
+    from cuda_knearests_tpu.utils.roofline import (problem_traffic,
+                                                   roofline_fields)
+
     out = {
         "metric": "queries/sec/chip, all-points kNN on 900k_blue_cube.xyz (k=10)",
         "value": round(qps, 1),
         "unit": "queries/sec",
-        "vs_baseline": round(qps / cpu_qps, 3),
-        # with backend='oracle' the baseline is the same engine timed cold
-        # (build + query); solve excludes the prepare-time build, which is
-        # the entire delta -- stamped so nobody reads it as a grid win
-        **({"vs_baseline_note": "baseline = same kd-tree engine incl. build"}
+        # THE pinned bar (VERDICT r4 weak #3 / next #4), identical every
+        # round: the exact CPU kd-tree oracle, build + query, this machine
+        # (the reference's own "knn cpu" phase).  When the measured engine
+        # IS that kd-tree (CPU-fallback hosts), vs_baseline is withheld
+        # (null) -- a same-engine ratio is not a result; the build-vs-query
+        # split is still visible via cpu_oracle_qps.
+        "baseline_def": "CPU kd-tree oracle, build+query, same machine",
+        "vs_baseline": (None if backend_used == "oracle"
+                        else round(qps / cpu_qps, 3)),
+        **({"vs_baseline_note": "engine == baseline (kd-tree oracle); "
+                                "ratio withheld"}
            if backend_used == "oracle" else {}),
         "recall_at_10": round(recall, 6),
         "solve_s": round(solve_s, 4),
@@ -222,6 +264,10 @@ def bench_north_star() -> dict:
         "certified_fraction": float(
             np.asarray(problem.result.certified).mean()),
     }
+    import jax
+
+    out.update(roofline_fields(problem_traffic(problem), solve_s,
+                               jax.devices()[0].platform))
     if n < full_n:
         out["scaled_down_from"] = full_n
     return out
@@ -242,6 +288,11 @@ def bench_config(name: str) -> dict:
 
     from cuda_knearests_tpu import KnnConfig
     from cuda_knearests_tpu.io import get_dataset, generate_uniform
+    from cuda_knearests_tpu.utils.roofline import (problem_traffic,
+                                                   roofline_fields,
+                                                   sharded_traffic)
+
+    plat = jax.devices()[0].platform
 
     if name == "kdtree_cpu_20k":
         points = get_dataset("pts20K.xyz")
@@ -256,7 +307,8 @@ def bench_config(name: str) -> dict:
                           + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
-                "solve_s": round(s, 4), "n_points": points.shape[0]}
+                "solve_s": round(s, 4), "n_points": points.shape[0],
+                **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "blue_900k_k20":
         points = get_dataset("900k_blue_cube.xyz")
         qps, s, prob = _solve_qps(points, KnnConfig(k=20))
@@ -264,7 +316,8 @@ def bench_config(name: str) -> dict:
                           + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
-                "solve_s": round(s, 4), "n_points": points.shape[0]}
+                "solve_s": round(s, 4), "n_points": points.shape[0],
+                **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "batched_300k_k50":
         points = get_dataset("pts300K.xyz")
         qps, s, prob = _solve_qps(points, KnnConfig(k=50))
@@ -272,7 +325,8 @@ def bench_config(name: str) -> dict:
                           + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
-                "solve_s": round(s, 4), "n_points": points.shape[0]}
+                "solve_s": round(s, 4), "n_points": points.shape[0],
+                **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "sharded_10m_k10":
         import numpy as np
 
@@ -327,7 +381,9 @@ def bench_config(name: str) -> dict:
                "solve_s": round(s, 4), "n_points": n,
                "recall_at_10": round(recall, 6),
                "oracle_sampled": sample_n,
-               "certified_fraction": round(certified, 6)}
+               "certified_fraction": round(certified, 6),
+               **roofline_fields(sharded_traffic(sp), s, plat,
+                                 n_devices=ndev)}
         if n_target != 10_000_000:
             row["scaled_down_from"] = 10_000_000
         return row

@@ -1,0 +1,175 @@
+"""Static-shape traffic/FLOP accounting for the solve (VERDICT r4 weak #5).
+
+Every plan the engine builds has fully static shapes, so the bytes a solve
+must move and the distance FLOPs it must execute are computable host-side
+without instrumenting the kernels.  The bench stamps each row with these
+numbers divided by the measured steady-state solve seconds -- achieved GB/s
+and GFLOP/s -- and, on TPU hosts, the fraction of the v5e HBM roofline.
+That turns DESIGN.md section 2's "VMEM-bandwidth-bound" claim into a
+falsifiable number per run.
+
+Traffic model (per steady-state solve call; 4-byte f32/i32 elements):
+
+- HBM: kernel/solver *inputs* are read once (per-axis coordinate lane blocks
+  qx/qy/qz + qid and cx/cy/cz + cid -> 4*(qcap+ccap) elements per supercell)
+  and *outputs* written once (k dists + k ids per padded query slot), plus
+  the epilogue's gather of those outputs into the (n, k) result (read + write
+  2*n*k elements each).  This is the unavoidable traffic; XLA may re-fetch,
+  so achieved numbers are lower bounds on actual movement.
+- VMEM (Pallas routes only): the package's own kernel cost model
+  (config.py kernel docs).  Per query row, elements touched are
+    kpass:   k * ccap              (k min-and-mask sweeps of the (Q,C) tile)
+    blocked: ccap * m + k * g * m  (per-block top-m in registers + k-pass
+                                    over the (Q, g*m) survivor pool)
+  times 4 bytes, times qcap_pad * n_sc.  The round-5 kernel A/B measures
+  whether wall-clock tracks this model (DESIGN.md section 2b).
+- Dense/streamed (XLA) routes materialize the distance tile in
+  XLA-managed memory: counted as one write + one read of qcap*ccap
+  elements per supercell (XLA fuses the top-k extraction over tiles, so
+  this is again a documented lower bound).
+- FLOPs: 8 per (query, candidate) pair -- 3 subs, 3 muls, 2 adds
+  (knearests.cu:125's accumulation, identical here).
+
+Peaks: TPU v5e HBM = 819 GB/s (public spec, jax-ml.github.io/scaling-book).
+VMEM peak bandwidth is not publicly pinned; vmem numbers are reported as
+achieved GB/s only, with no pct-of-peak claim.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+V5E_HBM_GBPS = 819.0
+
+_BYTES = 4  # f32 coords/dists, i32 ids
+_FLOPS_PER_PAIR = 8
+
+
+def _class_counts(n_sc: int, qcap: int, ccap: int, route: str, k: int,
+                  kernel: str) -> dict:
+    """Static counts for one class-shaped launch (works for the legacy
+    single-plan pallas path too: that is one class with route='pallas')."""
+    from ..config import blocked_topm, resolve_kernel
+
+    pairs = n_sc * qcap * ccap
+    hbm = {
+        # inputs: 3 coord axes + 1 id lane block for each side, read once
+        "hbm_read": n_sc * 4 * (qcap + ccap) * _BYTES,
+        # outputs: k dists + k ids per padded query slot, written once
+        "hbm_write": n_sc * qcap * k * 2 * _BYTES,
+        "pairs": pairs,
+        "flops": pairs * _FLOPS_PER_PAIR,
+        "vmem": 0,
+    }
+    if route == "pallas":
+        kern = resolve_kernel(kernel, k, ccap)
+        if kern == "blocked":
+            m = blocked_topm(k, ccap)
+            g = ccap // 128
+            per_query = ccap * m + k * g * m
+        else:
+            per_query = k * ccap
+        hbm["vmem"] = n_sc * qcap * per_query * _BYTES
+    else:
+        # XLA tile materialization: one write + one read of the distance tile
+        hbm["hbm_read"] += pairs * _BYTES
+        hbm["hbm_write"] += pairs * _BYTES
+    return hbm
+
+
+def _accumulate(rows: list[dict], n_points: int, k: int) -> dict:
+    tot = {key: sum(r[key] for r in rows)
+           for key in ("hbm_read", "hbm_write", "pairs", "flops", "vmem")}
+    # epilogue: gather the raw per-slot outputs into the (n, k) result
+    # (read the gathered rows, write neighbors + dists)
+    epi = 2 * n_points * k * 2 * _BYTES
+    tot["hbm_read"] += epi // 2
+    tot["hbm_write"] += epi // 2
+    tot["hbm_total"] = tot["hbm_read"] + tot["hbm_write"]
+    return tot
+
+
+def adaptive_traffic(plan, k: int, kernel: str) -> dict:
+    """Per-solve static counts for an AdaptivePlan (all classes)."""
+    rows = [_class_counts(cp.n_sc, cp.qcap_pad, cp.ccap, cp.route, k, kernel)
+            for cp in plan.classes]
+    return _accumulate(rows, plan.n_points, k)
+
+
+def pack_traffic(pack, k: int, kernel: str) -> dict:
+    """Per-solve static counts for the legacy single-plan pallas path."""
+    n_sc = pack.qx.shape[0]
+    n_points = pack.inv_flat.shape[0]
+    rows = [_class_counts(n_sc, pack.qx.shape[2], pack.cx.shape[2],
+                          "pallas", k, kernel)]
+    return _accumulate(rows, n_points, k)
+
+
+def xla_plan_traffic(plan, n_points: int, k: int) -> dict:
+    """Per-solve static counts for the pure-XLA supercell scan."""
+    rows = [_class_counts(plan.n_chunks * plan.batch, plan.qcap, plan.ccap,
+                          "xla", k, "kpass")]
+    return _accumulate(rows, n_points, k)
+
+
+def problem_traffic(problem) -> Optional[dict]:
+    """Static traffic counts for a prepared single-chip KnnProblem, or None
+    when the engine has no device plan to account (oracle backend)."""
+    cfg = problem.config
+    if cfg.backend == "oracle":
+        return None
+    k, kernel = cfg.k, cfg.effective_kernel()
+    if getattr(problem, "aplan", None) is not None:
+        return adaptive_traffic(problem.aplan, k, kernel)
+    if getattr(problem, "pack", None) is not None:
+        return pack_traffic(problem.pack, k, kernel)
+    if getattr(problem, "plan", None) is not None:
+        return xla_plan_traffic(problem.plan, problem.grid.n_points, k)
+    return None
+
+
+def sharded_traffic(sp) -> Optional[dict]:
+    """Static traffic counts summed over a ShardedKnnProblem's chip plans.
+
+    Each chip plan is an adaptive class schedule against the halo-extended
+    point set; the per-chip counts simply sum (the halo exchange itself is
+    a prepare-time cost, not part of the timed solve)."""
+    cfg = sp.config
+    k, kernel = cfg.k, cfg.effective_kernel()
+    rows = [
+        _class_counts(cp.n_sc, cp.qcap_pad, cp.ccap, cp.route, k, kernel)
+        for plan in sp.chip_plans for cp in plan.classes]
+    if not rows:
+        return None
+    return _accumulate(rows, sp.n_points, k)
+
+
+def roofline_fields(traffic: Optional[dict], solve_s: float,
+                    platform: str, n_devices: int = 1) -> dict:
+    """Bench-row fields from static counts + measured steady-state seconds.
+
+    pct_hbm_roofline only appears on TPU hosts (the peak constant is the
+    v5e spec; a CPU host's memory peak is neither known nor claimed).
+    ``n_devices``: chips the traffic was spread over concurrently -- a
+    sharded solve's aggregate bytes/s compare against n_devices * the
+    single-chip peak, not one chip's."""
+    if not traffic or solve_s <= 0:
+        return {}
+    out = {
+        "moved_hbm_gb": round(traffic["hbm_total"] / 1e9, 4),
+        "achieved_hbm_gbps": round(traffic["hbm_total"] / solve_s / 1e9, 2),
+        "dist_gflop": round(traffic["flops"] / 1e9, 3),
+        "achieved_gflops": round(traffic["flops"] / solve_s / 1e9, 2),
+        "traffic_model": "static-shape lower bound (utils/roofline.py)",
+    }
+    if traffic.get("vmem"):
+        out["modeled_vmem_gb"] = round(traffic["vmem"] / 1e9, 4)
+        out["achieved_vmem_gbps"] = round(
+            traffic["vmem"] / solve_s / 1e9, 2)
+    if platform == "tpu":
+        out["pct_hbm_roofline"] = round(
+            100.0 * out["achieved_hbm_gbps"]
+            / (V5E_HBM_GBPS * max(1, n_devices)), 2)
+        if n_devices > 1:
+            out["roofline_basis"] = f"aggregate over {n_devices} chips"
+    return out

@@ -43,7 +43,7 @@ def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
     kernel_only = jax.jit(
         lambda pts, st, ct, classes: [
             _class_flat(pts, st, ct, cp, cfg.k, cfg.exclude_self,
-                        cfg.stream_tile, cfg.interpret, cfg.kernel)
+                        cfg.stream_tile, cfg.interpret, cfg.effective_kernel())
             for cp in classes])
 
     def t_kernel():
@@ -55,7 +55,7 @@ def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
         out = _solve_adaptive(grid.points, grid.cell_starts,
                               grid.cell_counts, plan, cfg.k,
                               cfg.exclude_self, grid.domain, cfg.interpret,
-                              cfg.stream_tile, cfg.kernel)
+                              cfg.stream_tile, cfg.effective_kernel())
         jax.block_until_ready(out)
 
     def t_full():
@@ -66,8 +66,18 @@ def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
     ms_e = steady(t_epilogue) * 1e3
     ms_f = steady(t_full) * 1e3
     n = points.shape[0]
+    from cuda_knearests_tpu.utils.roofline import (problem_traffic,
+                                                   roofline_fields)
+
+    # roofline vs the kernel+epilogue phase (ms_e): that is exactly the span
+    # the traffic model covers (kernel inputs/outputs + epilogue gather);
+    # bench.py divides by the full solve instead, which is conservative.
+    # The pct fields answer DESIGN section 2's "bandwidth-bound" claim with
+    # a number (VERDICT r4 next #3).
+    roof = roofline_fields(problem_traffic(p), ms_e / 1e3, platform)
     print(json.dumps({
-        "config": tag, "platform": platform, "kernel": cfg.kernel,
+        "config": tag, "platform": platform,
+        "kernel": cfg.effective_kernel(),
         "n_points": int(n),
         "kernel_ms": round(ms_k, 2),
         "kernel_plus_epilogue_ms": round(ms_e, 2),
@@ -78,6 +88,7 @@ def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
         "epilogue_pct": round(100 * (ms_e - ms_k) / ms_f, 1),
         "sync_pct": round(100 * (ms_f - ms_e) / ms_f, 1),
         "qps": round(n / (ms_f / 1e3), 1),
+        **roof,
     }), flush=True)
 
 
