@@ -10,7 +10,8 @@ each, the north star last):
   2. uniform-grid kNN on pts300K.xyz (k=10)        -- single chip
   3. blue-noise 900k_blue_cube.xyz (k=20)          -- single chip
   4. all-points batched kNN (N=300K, k=50)         -- the reference's default k
-  5. sharded synthetic uniform 10M (k=10)          -- slab mesh over all chips
+  5. clustered 300K skew (k=10)                    -- adaptive vs global planner
+  6. sharded synthetic uniform 10M (k=10)          -- slab mesh over all chips
 
 The CUDA reference publishes no numbers (BASELINE.md) and no GPU exists in this
 environment to re-measure it, so ``vs_baseline`` is pinned -- identically every
@@ -88,14 +89,16 @@ def _steady_state(fn, iters: int = 3, max_seconds: float | None = None) -> float
     return min(times)
 
 
-def _solve_qps(points, cfg, iters: int = 3):
+def _solve_qps(points, cfg, iters: int = 3, oracle_swap: bool = True):
     """(qps, solve_s, problem) steady-state for the single-chip engine.
 
     On a CPU host with the native oracle built, the engine's fastest exact
     route is the kd-tree backend (config.py: backend='oracle', ~3x the dense
     grid route) -- the bench measures what the framework actually delivers
     on the platform it landed on, and the row carries a ``backend`` stamp so
-    a CPU-fallback record can never be mistaken for a grid/kernel number."""
+    a CPU-fallback record can never be mistaken for a grid/kernel number.
+    ``oracle_swap=False`` pins the grid engine regardless (rows whose point
+    is comparing grid planners, e.g. clustered_300k_adaptive)."""
     import dataclasses
 
     import jax
@@ -103,8 +106,8 @@ def _solve_qps(points, cfg, iters: int = 3):
     from cuda_knearests_tpu import KnnProblem
     from cuda_knearests_tpu.oracle import native_available
 
-    if (cfg.backend == "auto" and jax.devices()[0].platform == "cpu"
-            and native_available()):
+    if (oracle_swap and cfg.backend == "auto"
+            and jax.devices()[0].platform == "cpu" and native_available()):
         cfg = dataclasses.replace(cfg, backend="oracle")
     problem = KnnProblem.prepare(points, cfg)
 
@@ -146,6 +149,21 @@ def _oracle_qps(points, k: int, sample_idx=None):
     query_s = time.perf_counter() - t0
     est_total = build_s + query_s * (n / max(1, sample_idx.size))
     return n / est_total, build_s + query_s, (ref_ids, ref_d2)
+
+
+def _sampled_oracle_ref(points, k: int, env_default: int = 20000):
+    """Seeded oracle-checked query subsample shared by every recall-stamped
+    row: (sample_idx, ref_ids, sample_n).  BENCH_ORACLE_SAMPLE overrides the
+    size; 0 = all points (sample_idx None)."""
+    import numpy as np
+
+    n = points.shape[0]
+    sample_n = min(int(os.environ.get("BENCH_ORACLE_SAMPLE",
+                                      str(env_default))) or n, n)
+    sample = (None if sample_n >= n else
+              np.sort(np.random.default_rng(20626).choice(
+                  n, sample_n, replace=False).astype(np.int32)))
+    return sample, sample_n
 
 
 def _brute_sample(points, idx, k: int):
@@ -213,11 +231,7 @@ def bench_north_star() -> dict:
     n = points.shape[0]
     qps, solve_s, problem = _solve_qps(points, KnnConfig(k=k))
     backend_used = problem.config.backend
-    sample_n = int(os.environ.get("BENCH_ORACLE_SAMPLE", "20000")) or n
-    sample_n = min(sample_n, n)
-    sample = (None if sample_n >= n else
-              np.sort(np.random.default_rng(20626).choice(
-                  n, sample_n, replace=False).astype(np.int32)))
+    sample, sample_n = _sampled_oracle_ref(points, k)
     cpu_qps, _, (ref_ids, _) = _oracle_qps(points, k, sample_idx=sample)
     got = problem.get_knearests_original()
     if backend_used == "oracle":
@@ -327,6 +341,45 @@ def bench_config(name: str) -> dict:
                 "backend": prob.config.backend,
                 "solve_s": round(s, 4), "n_points": points.shape[0],
                 **roofline_fields(problem_traffic(prob), s, plat)}
+    if name == "clustered_300k_adaptive":
+        import numpy as np
+
+        from cuda_knearests_tpu import KnnProblem
+        from cuda_knearests_tpu.cli import set_recall
+        from cuda_knearests_tpu.io import generate_clustered
+
+        k = 10
+        n_target = int(os.environ.get("BENCH_CLUSTERED_N", "300000"))
+        points = generate_clustered(n_target, seed=303)
+        # oracle_swap=False: this row exists to compare the two GRID
+        # planners (adaptive classes vs one global capacity) on
+        # density-skewed data -- the adaptive planner's reason to exist
+        # (ops/adaptive.py:1-31; VERDICT r4 next #8)
+        qps_a, s_a, prob_a = _solve_qps(points, KnnConfig(k=k),
+                                        oracle_swap=False)
+        qps_g, s_g, _ = _solve_qps(points, KnnConfig(k=k, adaptive=False),
+                                   oracle_swap=False)
+        n = points.shape[0]
+        sample, sample_n = _sampled_oracle_ref(points, k)
+        _, _, (ref_ids, _) = _oracle_qps(points, k, sample_idx=sample)
+        got = prob_a.get_knearests_original()
+        recall = set_recall(got if sample is None else got[sample], ref_ids)
+        row = {"config": f"clustered {n_target / 1e3:g}K skewed points "
+                         f"(k=10): adaptive classes vs global capacity",
+               "value": round(qps_a, 1), "unit": "queries/sec",
+               "solve_s": round(s_a, 4),
+               "backend": prob_a.config.backend,
+               "global_capacity_qps": round(qps_g, 1),
+               "global_solve_s": round(s_g, 4),
+               "adaptive_speedup": round(s_g / s_a, 3),
+               "n_points": n, "recall_at_10": round(recall, 6),
+               "oracle_sampled": sample_n,
+               "certified_fraction": float(np.asarray(
+                   prob_a.result.certified).mean()),
+               **roofline_fields(problem_traffic(prob_a), s_a, plat)}
+        if n_target != 300_000:
+            row["scaled_down_from"] = 300_000
+        return row
     if name == "sharded_10m_k10":
         import numpy as np
 
@@ -367,10 +420,9 @@ def bench_config(name: str) -> dict:
                      if cert_rows else 1.0)
         neighbors, _, _ = sp.solve(device_out=outs)
         n = points.shape[0]
-        sample_n = min(int(os.environ.get("BENCH_ORACLE_SAMPLE", "20000"))
-                       or n, n)
-        sample = np.sort(np.random.default_rng(20626).choice(
-            n, sample_n, replace=False).astype(np.int32))
+        sample, sample_n = _sampled_oracle_ref(points, k)
+        if sample is None:  # tiny run: the sampled path needs explicit ids
+            sample = np.arange(n, dtype=np.int32)
         ref_ids, _ = sp._oracle().knn(points[sample], k, exclude_ids=sample)
         recall = set_recall(neighbors[sample], ref_ids)
         label_n = f"{n_target / 1e6:g}M"
@@ -391,7 +443,8 @@ def bench_config(name: str) -> dict:
 
 
 _ALL_CONFIGS = ("kdtree_cpu_20k", "grid_300k_k10", "blue_900k_k20",
-                "batched_300k_k50", "sharded_10m_k10")
+                "batched_300k_k50", "clustered_300k_adaptive",
+                "sharded_10m_k10")
 
 
 def _env_fields(platform: str) -> dict:
@@ -411,9 +464,15 @@ def main(argv=None) -> int:
     is wrapped, and SIGTERM/SIGINT (e.g. an outer `timeout`) emits a
     diagnostic line on the way out."""
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--all", action="store_true",
-                    help="measure every BASELINE.json config, one JSON line "
-                         "each, north star last")
+    group = ap.add_mutually_exclusive_group()
+    group.add_argument("--all", action="store_true",
+                       help="measure every BASELINE.json config, one JSON "
+                            "line each, north star last")
+    group.add_argument("--only", choices=_ALL_CONFIGS, default=None,
+                       help="measure exactly one BASELINE.json config and "
+                            "exit (rc 0 iff the row carries no error) -- "
+                            "used for rc-stamped single-row artifacts, e.g. "
+                            "the full-size sharded run")
     args = ap.parse_args(argv)
 
     # cheap env stamp for the signal/error paths; refreshed with real jax
@@ -421,10 +480,15 @@ def main(argv=None) -> int:
     # call into jax: a SIGTERM mid-backend-init would re-enter the
     # non-reentrant xla_bridge lock and deadlock instead of printing)
     state = {"emitted": False, "note": None,
-             "env": {"platform": "unknown", "n_devices": 0}}
+             "env": {"platform": "unknown", "n_devices": 0},
+             "only": args.only}
 
     def _error_line(err: str) -> dict:
-        out = {"metric": NORTH_STAR_METRIC, "value": 0.0,
+        # in --only mode the artifact must name the config it was measuring,
+        # not look like a failed north-star row
+        head = ({"config": state["only"]} if state["only"]
+                else {"metric": NORTH_STAR_METRIC})
+        out = {**head, "value": 0.0,
                "unit": "queries/sec", "vs_baseline": 0.0, "error": err}
         out.update(state["env"])
         if state["note"]:
@@ -451,6 +515,21 @@ def main(argv=None) -> int:
     honor_jax_platforms_env()
     env = _env_fields(platform)
     state["env"] = env
+
+    if args.only:
+        try:
+            row = bench_config(args.only)
+        except Exception as e:  # noqa: BLE001 -- the one line must appear
+            import traceback
+
+            traceback.print_exc()
+            row = {"config": args.only, "error": f"{type(e).__name__}: {e}"}
+        row.update(env)
+        if note:
+            row["backend_note"] = note
+        print(json.dumps(row), flush=True)
+        state["emitted"] = True
+        return 0 if "error" not in row else 1
 
     if args.all:
         for name in _ALL_CONFIGS:
