@@ -126,8 +126,21 @@ def main(argv=None) -> int:
             run_and_record(
                 [py, os.path.join(REPO, "scripts", "phase_breakdown.py"),
                  "--ten-m"], ph_path, timeout_s=2400)
+            # on-chip differential at the reference's native k=50
+            # (/root/reference/params.h:4) -- exercises the large-k rolled
+            # kernel path on hardware (VERDICT r4 next #6)
+            d20_path = os.path.join(outdir, f"{args.tag}_tpu_diff_20k_k50.json")
+            d300_path = os.path.join(outdir,
+                                     f"{args.tag}_tpu_diff_300k_k50.json")
+            run_and_record(
+                [py, "-m", "cuda_knearests_tpu.cli", "pts20K.xyz",
+                 "--k", "50", "--json"], d20_path, timeout_s=1800)
+            run_and_record(
+                [py, "-m", "cuda_knearests_tpu.cli", "pts300K.xyz",
+                 "--k", "50", "--json"], d300_path, timeout_s=1800)
             if all(_artifact_good(p)
-                   for p in (ns_path, all_path, ab_path, ph_path)):
+                   for p in (ns_path, all_path, ab_path, ph_path,
+                             d20_path, d300_path)):
                 print("[tpu_watch] record captured", flush=True)
                 return 0
             # chip answered the probe but the run failed -- transport may
