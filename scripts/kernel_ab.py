@@ -42,6 +42,8 @@ def main() -> int:
     def measure(tag: str, cfg: KnnConfig) -> None:
         from cuda_knearests_tpu.config import resolve_kernel
         from cuda_knearests_tpu.ops.adaptive import solve_adaptive
+        from cuda_knearests_tpu.utils.roofline import (problem_traffic,
+                                                       roofline_fields)
 
         p = KnnProblem.prepare(blue, cfg)
         raw = solve_adaptive(p.grid, cfg, p.aplan)
@@ -70,6 +72,13 @@ def main() -> int:
             "unit": "queries/sec",
             "pre_fallback_certified": round(pre_cert, 6),
             "platform": platform,
+            # the A/B is exactly the experiment that tests the VMEM cost
+            # model (kpass k*C vs blocked C*m+k*G*m elements per query --
+            # a ~1.5-2.5x modeled drop at k=10-20 with blocked_topm's m;
+            # DESIGN 2b's ~10x figure uses the coarser 4-sweeps-per-neighbor
+            # accounting): if solve_s does not track modeled_vmem_gb across
+            # the kernel pair, the kernel was not VMEM-bound
+            **roofline_fields(problem_traffic(p), t, platform),
         }), flush=True)
 
     ks = (10,) if args.quick else (10, 20)
