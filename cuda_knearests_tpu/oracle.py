@@ -47,6 +47,11 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
                 ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float)]
+            if hasattr(lib, "kdt_knn_all"):  # absent in a stale pre-r5 .so
+                lib.kdt_knn_all.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int32,
+                    ctypes.POINTER(ctypes.c_int32),
+                    ctypes.POINTER(ctypes.c_float)]
         except Exception:
             # stale/wrong-arch .so or no toolchain: fall back to numpy brute
             # (cached so a failing `make` isn't re-spawned per oracle)
@@ -110,8 +115,22 @@ class KdTreeOracle:
 
     def knn_all_points(self, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """All-points self-query with self excluded by index -- the oracle side
-        of the differential test (reference: test_knearests.cu:203-212)."""
-        excl = np.arange(self.points.shape[0], dtype=np.int32)
+        of the differential test (reference: test_knearests.cu:203-212).
+
+        Uses the native tree-order batch entry point when available:
+        iterating queries in tree order keeps nearby queries' shared
+        descent paths hot in cache (same results, measured faster than the
+        original-order batch)."""
+        n = self.points.shape[0]
+        if self._handle is not None and hasattr(self._lib, "kdt_knn_all"):
+            out_ids = np.empty((n, k), dtype=np.int32)
+            out_d2 = np.empty((n, k), dtype=np.float32)
+            self._lib.kdt_knn_all(
+                self._handle, k,
+                out_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                out_d2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            return out_ids, out_d2
+        excl = np.arange(n, dtype=np.int32)
         return self.knn(self.points, k, exclude_ids=excl)
 
     def _brute(self, queries, k, exclude_ids, chunk: int = 512):

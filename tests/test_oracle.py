@@ -67,3 +67,21 @@ def test_numpy_fallback_agrees(uniform_10k, rng):
     for r in range(32):
         assert set(n_ids[r].tolist()) == set(b_ids[r].tolist())
     np.testing.assert_allclose(n_d2, b_d2, rtol=1e-6)
+
+
+def test_tree_order_batch_matches_per_query_api():
+    """kdt_knn_all (tree-order iteration, the fast all-points entry point)
+    must be bit-identical to kdt_knn over the same points with iota
+    exclusion -- same results, only the traversal order differs."""
+    import numpy as np
+
+    from cuda_knearests_tpu.io import generate_clustered
+    from cuda_knearests_tpu.oracle import KdTreeOracle
+
+    pts = generate_clustered(6000, seed=11)
+    o = KdTreeOracle(pts)
+    a_ids, a_d2 = o.knn_all_points(k=9)
+    b_ids, b_d2 = o.knn(pts, 9,
+                        exclude_ids=np.arange(len(pts), dtype=np.int32))
+    assert np.array_equal(a_ids, b_ids)
+    assert np.array_equal(a_d2, b_d2)
