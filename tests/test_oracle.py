@@ -73,13 +73,14 @@ def test_tree_order_batch_matches_per_query_api():
     """kdt_knn_all (tree-order iteration, the fast all-points entry point)
     must be bit-identical to kdt_knn over the same points with iota
     exclusion -- same results, only the traversal order differs."""
-    import numpy as np
-
     from cuda_knearests_tpu.io import generate_clustered
-    from cuda_knearests_tpu.oracle import KdTreeOracle
 
     pts = generate_clustered(6000, seed=11)
     o = KdTreeOracle(pts)
+    # a stale pre-r5 .so would make knn_all_points fall back to the exact
+    # expression compared against below -- a vacuous pass; fail loudly
+    assert hasattr(o._lib, "kdt_knn_all"), \
+        "stale liboracle.so: rebuild with make -C oracle"
     a_ids, a_d2 = o.knn_all_points(k=9)
     b_ids, b_d2 = o.knn(pts, 9,
                         exclude_ids=np.arange(len(pts), dtype=np.int32))
