@@ -145,15 +145,27 @@ int widest_axis(const Tree& t, int32_t b, int32_t e) {
   return best;
 }
 
-// Recursive preorder build over perm[b..e).  Returns the node's index.
-int32_t build_node(Tree& t, int32_t b, int32_t e) {
-  int32_t me = (int32_t)t.nodes.size();
-  t.nodes.emplace_back();
+// Preorder node count for a range of m points.  The split is always
+// mid = m/2, so the layout is a pure function of m -- which is what lets
+// subtrees build in parallel into a preallocated array: every node's index
+// is known before any child is built.
+int32_t node_count(int32_t m) {
+  if (m <= kLeafSize) return 1;
+  return 1 + node_count(m / 2) + node_count(m - m / 2);
+}
+
+// Recursive preorder build over perm[b..e) into the preallocated slot `me`.
+// Subtrees above kParallelGrain points build as OpenMP tasks: they touch
+// disjoint perm ranges and disjoint node slots, so no synchronization is
+// needed beyond the parallel region's implicit barrier.
+constexpr int32_t kParallelGrain = 1 << 15;
+
+void build_node(Tree& t, int32_t me, int32_t b, int32_t e) {
   if (e - b <= kLeafSize) {
     t.nodes[me].axis = -1;
     t.nodes[me].begin = b;
     t.nodes[me].end = e;
-    return me;
+    return;
   }
   int axis = widest_axis(t, b, e);
   int32_t mid = b + (e - b) / 2;
@@ -165,9 +177,19 @@ int32_t build_node(Tree& t, int32_t b, int32_t e) {
   float split = t.pts[3 * (size_t)t.perm[mid] + axis];
   t.nodes[me].axis = axis;
   t.nodes[me].value = split;
-  build_node(t, b, mid);                       // left = me + 1 by preorder
-  t.nodes[me].right = build_node(t, mid, e);
-  return me;
+  int32_t left = me + 1;                       // preorder
+  int32_t right = left + node_count(mid - b);
+  t.nodes[me].right = right;
+#if defined(_OPENMP)
+  if (e - b >= kParallelGrain) {
+#pragma omp task default(none) shared(t) firstprivate(left, b, mid)
+    build_node(t, left, b, mid);
+    build_node(t, right, mid, e);
+    return;
+  }
+#endif
+  build_node(t, left, b, mid);
+  build_node(t, right, mid, e);
 }
 
 // DFS with incremental lower-bound pruning.  `lb` is a running lower bound on
@@ -214,8 +236,21 @@ void* kdt_build(const float* pts, int64_t n) {
   t->pts.assign(pts, pts + 3 * (size_t)n);
   t->perm.resize((size_t)n);
   for (int64_t i = 0; i < n; ++i) t->perm[(size_t)i] = (int32_t)i;
-  t->nodes.reserve((size_t)(n / (kLeafSize / 2) + 4));
-  if (n > 0) build_node(*t, 0, (int32_t)n);
+  if (n > 0) {
+    t->nodes.resize((size_t)node_count((int32_t)n));
+#if defined(_OPENMP)
+    if (n >= kParallelGrain) {
+      // tasks complete at the parallel region's implicit barrier
+#pragma omp parallel
+#pragma omp single nowait
+      build_node(*t, 0, 0, (int32_t)n);
+    } else {
+      build_node(*t, 0, 0, (int32_t)n);  // small tree: skip the team fork
+    }
+#else
+    build_node(*t, 0, 0, (int32_t)n);
+#endif
+  }
   t->tpts.resize(3 * (size_t)n);
   for (int64_t i = 0; i < n; ++i)
     std::memcpy(&t->tpts[3 * (size_t)i], &t->pts[3 * (size_t)t->perm[i]],
