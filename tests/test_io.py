@@ -45,23 +45,43 @@ def test_generators_shapes_and_domain():
         assert pts.min() >= 0.0 and pts.max() <= DOMAIN_SIZE
 
 
-def test_blue_noise_is_more_even_than_uniform():
-    """Blue noise should concentrate the occupancy histogram (smaller variance
-    of points-per-cell than i.i.d. uniform)."""
+def _occupancy_var(pts, dim=18):
+    """Variance of the points-per-cell histogram: the skew measure both
+    generator-shape tests compare against uniform."""
     from cuda_knearests_tpu.ops.gridhash import cell_ids
     import jax.numpy as jnp
 
-    n, dim = 20_000, 18
-    var = {}
-    for name, pts in (("u", generate_uniform(n, seed=5)),
-                      ("b", generate_blue_noise(n, seed=5))):
-        cid = np.asarray(cell_ids(jnp.asarray(pts), dim))
-        counts = np.bincount(cid, minlength=dim ** 3)
-        var[name] = counts.var()
-    assert var["b"] < 0.7 * var["u"]
+    cid = np.asarray(cell_ids(jnp.asarray(pts), dim))
+    return np.bincount(cid, minlength=dim ** 3).var()
+
+
+def test_blue_noise_is_more_even_than_uniform():
+    """Blue noise should concentrate the occupancy histogram (smaller variance
+    of points-per-cell than i.i.d. uniform)."""
+    n = 20_000
+    assert _occupancy_var(generate_blue_noise(n, seed=5)) \
+        < 0.7 * _occupancy_var(generate_uniform(n, seed=5))
 
 
 def test_generators_deterministic():
     a = generate_blue_noise(1000, seed=9)
     b = generate_blue_noise(1000, seed=9)
     np.testing.assert_array_equal(a, b)
+
+
+def test_clustered_generator_contract():
+    """generate_clustered: shape/domain/determinism plus the property the
+    bench row depends on -- the occupancy histogram must be heavily skewed
+    vs uniform (tight blobs over background), the opposite tail from blue
+    noise."""
+    from cuda_knearests_tpu.io import generate_clustered
+
+    n = 20_000
+    c = generate_clustered(n, seed=3)
+    assert c.shape == (n, 3) and c.dtype == np.float32
+    # <=: the f64 clip bound rounds back to exactly DOMAIN_SIZE in f32
+    assert c.min() >= 0.0 and c.max() <= DOMAIN_SIZE
+    np.testing.assert_array_equal(c, generate_clustered(n, seed=3))
+    vc = _occupancy_var(c)
+    vu = _occupancy_var(generate_uniform(n, seed=3))
+    assert vc > 5.0 * vu, (vc, vu)
