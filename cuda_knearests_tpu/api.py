@@ -140,7 +140,7 @@ class KnnProblem:
         the grid engine (exact by construction, all rows certified) -- the
         reference's own CPU path (its kd-tree solve phase,
         /root/reference/test_knearests.cu:194-214) promoted to a first-class
-        engine, and the fastest exact CPU route (measured ~3x the grid's
+        engine, and the fastest exact CPU route (measured 3-5x the grid's
         dense route on the 900k north star, DESIGN.md section 5)."""
         if self.config.backend == "oracle":
             ids, d2 = self._oracle.knn_all_points(self.config.k) \

@@ -92,7 +92,8 @@ class KnnConfig:
         fits VMEM, else xla.  'oracle' = answer through the native C++ kd-tree
         (the reference's own CPU path promoted to a first-class engine): exact
         by construction, all rows certified, and the fastest exact CPU route
-        (~3x the grid's dense route on the 900k north star) -- the right
+        (3-5x the grid's dense route on the 900k north star; ~5x after the
+        round-5 tree-order layout) -- the right
         choice on accelerator-less hosts; no accelerator involvement at all.
       interpret: run Pallas kernels in interpreter mode (CPU testing).
       adaptive: partition supercells into per-radius capacity classes sized
