@@ -544,8 +544,10 @@ def main(argv=None) -> int:
     state["note"] = note
     state["env"] = {"platform": platform, "n_devices": 0}
 
-    from cuda_knearests_tpu.utils.platform import honor_jax_platforms_env
+    from cuda_knearests_tpu.utils.platform import (enable_compile_cache,
+                                                   honor_jax_platforms_env)
     honor_jax_platforms_env()
+    enable_compile_cache()  # remote-tunnel compiles persist across runs
     env = _env_fields(platform)
     state["env"] = env
 

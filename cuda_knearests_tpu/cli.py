@@ -80,9 +80,11 @@ def main(argv=None) -> int:
     # exact environment failure mode bench.py guards against) -- probe in a
     # subprocess and pin cpu on persistent failure, so the driver always
     # terminates.  JAX_PLATFORMS=cpu short-circuits the probe entirely.
-    from .utils.platform import acquire_backend, honor_jax_platforms_env
+    from .utils.platform import (acquire_backend, enable_compile_cache,
+                                 honor_jax_platforms_env)
     platform, backend_note = acquire_backend()
     honor_jax_platforms_env()
+    enable_compile_cache()  # remote-tunnel compiles persist across runs
 
     from . import KnnConfig, KnnProblem
     from .io import get_dataset, load_xyz, normalize_points

@@ -16,6 +16,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)  # PYTHONPATH breaks axon plugin discovery
 
 import jax
+
+from cuda_knearests_tpu.utils.platform import enable_compile_cache
+
+enable_compile_cache()  # remote-tunnel compiles persist across runs
 import numpy as np
 
 from cuda_knearests_tpu import KnnConfig, KnnProblem

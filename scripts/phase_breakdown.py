@@ -25,6 +25,10 @@ sys.path.insert(0, REPO)  # PYTHONPATH breaks axon plugin discovery
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
+
+from cuda_knearests_tpu.utils.platform import enable_compile_cache
+
+enable_compile_cache()  # remote-tunnel compiles persist across runs
 import numpy as np
 
 from kernel_ab import steady  # shared steady-state timing methodology

@@ -10,6 +10,10 @@ import time
 sys.path.insert(0, os.getcwd())  # PYTHONPATH breaks axon plugin discovery
 
 import jax
+
+from cuda_knearests_tpu.utils.platform import enable_compile_cache
+
+enable_compile_cache()  # remote-tunnel compiles persist across runs
 import numpy as np
 
 from cuda_knearests_tpu import KnnConfig, KnnProblem

@@ -1,9 +1,16 @@
 """Tuning sweep on the real chip: solve time vs config knobs (dev tool)."""
 import itertools
+import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
+
+from cuda_knearests_tpu.utils.platform import enable_compile_cache
+
+enable_compile_cache()  # remote-tunnel compiles persist across runs
 import numpy as np
 
 from cuda_knearests_tpu import KnnConfig, KnnProblem

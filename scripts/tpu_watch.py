@@ -28,7 +28,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from cuda_knearests_tpu.utils.platform import _probe_default_backend
+from cuda_knearests_tpu.utils.platform import (_probe_default_backend,
+                                               enable_compile_cache)
 
 
 def _utc() -> str:
@@ -118,10 +119,8 @@ def main(argv=None) -> int:
             # transport last single-digit minutes, and ~30 s/program remote
             # compiles are most of a cold capture.  Cache them so a retry
             # after a flap resumes nearly compile-free and fits the window.
-            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                                  os.path.join(REPO, ".jax_cache"))
-            os.environ.setdefault(
-                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+            # (Sets the env vars the children inherit; one source of truth.)
+            enable_compile_cache()
             ns_path = os.path.join(outdir, f"{args.tag}_tpu_north_star.json")
             all_path = os.path.join(outdir, f"{args.tag}_tpu_all_rows.json")
             ab_path = os.path.join(outdir, f"{args.tag}_tpu_kernel_ab.json")

@@ -19,6 +19,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+from cuda_knearests_tpu.utils.platform import enable_compile_cache  # noqa: E402
+
+# Persist XLA compiles across pytest runs (keyed by jax on backend/options,
+# so the emulated-mesh CPU programs never collide with hardware entries).
+enable_compile_cache()
+
 # The environment's sitecustomize may pre-register a hardware TPU backend and
 # widen jax_platforms behind our back; tests must run on the emulated CPU mesh
 # regardless (and not hang if the hardware tunnel is down), so force the

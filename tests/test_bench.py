@@ -167,3 +167,28 @@ def test_main_emits_json_on_sigterm():
     assert out is not None, f"no JSON line after SIGTERM: {stdout!r}"
     assert "terminated by signal" in out["error"]
     assert p.returncode == 128 + signal.SIGTERM
+
+
+def test_enable_compile_cache_env_override_wins(monkeypatch, tmp_path):
+    """An explicit JAX_COMPILATION_CACHE_DIR is honored verbatim; otherwise
+    the repo-local .jax_cache default is installed at env AND config level
+    (jax only reads the env var at import, and it is long-imported here)."""
+    from cuda_knearests_tpu.utils.platform import enable_compile_cache
+
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "explicit"))
+    assert enable_compile_cache() == str(tmp_path / "explicit")
+    import jax
+
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / "explicit")
+
+    # explicit disable (stock jax semantics: empty dir = cache off) must be
+    # honored, not silently re-enabled
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "")
+    assert enable_compile_cache() == ""
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == ""
+
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR")
+    path = enable_compile_cache()
+    assert path == os.path.join(REPO, ".jax_cache")
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == path
+    assert jax.config.jax_compilation_cache_dir == path

@@ -1,0 +1,93 @@
+"""TPU-watch capture sequencing: the record-collection automation must
+survive the transport's observed failure mode (healthy probe, then death
+mid-sequence) without burning hours of child timeouts.
+
+The reference has no analog -- its failure handling is check-and-exit per
+CUDA call (/root/reference/knearests.cu:205-231); this environment's
+accelerator fails by *hanging*, so the watcher owns bounded-time capture.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+import tpu_watch  # noqa: E402
+
+STEP_FILES = ["_tpu_north_star.json", "_tpu_kernel_ab.json",
+              "_tpu_all_rows.json", "_tpu_diff_20k_k50.json",
+              "_tpu_diff_300k_k50.json", "_tpu_phases.json"]
+
+
+@pytest.fixture()
+def capture(monkeypatch, tmp_path):
+    calls = []
+
+    def fake_run(argv, out_path, timeout_s):
+        calls.append(os.path.basename(out_path))
+        with open(out_path, "w") as f:
+            json.dump({"rc": 0, "lines": [{"platform": "tpu", "value": 1}]}, f)
+        return 0
+
+    monkeypatch.setattr(tpu_watch, "run_and_record", fake_run)
+    return calls, tmp_path
+
+
+def _main(tmp_path, extra=()):
+    # interval > 0: with an instant mocked probe and a zero interval, the
+    # dark-transport cases would hot-loop (a flushed print per iteration)
+    # for the whole deadline window
+    return tpu_watch.main(["--interval", "0.05", "--max-hours", "0.0002",
+                           "--outdir", str(tmp_path), "--tag", "t", *extra])
+
+
+def test_healthy_window_runs_all_steps_in_value_order(capture, monkeypatch):
+    calls, tmp_path = capture
+    monkeypatch.setattr(tpu_watch, "_probe_default_backend", lambda t: "tpu")
+    assert _main(tmp_path) == 0
+    assert calls == [f"t{s}" for s in STEP_FILES]
+
+
+def test_mid_sequence_flap_breaks_out_and_resumes_without_rerun(
+        capture, monkeypatch):
+    calls, tmp_path = capture
+    # window 1: healthy probe, north star runs, gate probe for step 2 dark;
+    # window 2: healthy throughout -- the good artifact must be skipped
+    seq = iter(["tpu", None] + ["tpu"] * 8)
+    monkeypatch.setattr(tpu_watch, "_probe_default_backend",
+                        lambda t: next(seq))
+    assert _main(tmp_path) == 0
+    assert calls == [f"t{s}" for s in STEP_FILES]  # each ran exactly once
+
+
+def test_dark_transport_exits_nonzero_with_no_captures(capture, monkeypatch):
+    calls, tmp_path = capture
+    monkeypatch.setattr(tpu_watch, "_probe_default_backend", lambda t: None)
+    assert _main(tmp_path) == 2
+    assert calls == []
+
+
+def test_cpu_only_probe_never_counts_as_accelerator(capture, monkeypatch):
+    calls, tmp_path = capture
+    monkeypatch.setattr(tpu_watch, "_probe_default_backend", lambda t: "cpu")
+    assert _main(tmp_path) == 2
+    assert calls == []
+
+
+def test_artifact_good_rejects_cpu_fallback_and_errors(tmp_path):
+    p = tmp_path / "a.json"
+    # rc 0 but platform=cpu: bench's internal fallback must not be enshrined
+    p.write_text(json.dumps(
+        {"rc": 0, "lines": [{"platform": "cpu", "value": 1}]}))
+    assert not tpu_watch._artifact_good(str(p))
+    p.write_text(json.dumps(
+        {"rc": 0, "lines": [{"platform": "tpu", "error": "boom"}]}))
+    assert not tpu_watch._artifact_good(str(p))
+    p.write_text(json.dumps({"rc": 1, "lines": [{"platform": "tpu"}]}))
+    assert not tpu_watch._artifact_good(str(p))
+    p.write_text(json.dumps(
+        {"rc": 0, "lines": [{"platform": "tpu", "value": 1}]}))
+    assert tpu_watch._artifact_good(str(p))
