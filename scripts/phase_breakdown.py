@@ -101,14 +101,29 @@ def main() -> int:
     ap.add_argument("--ten-m", action="store_true",
                     help="also profile the 10M single-chip config")
     args = ap.parse_args()
+    failures = 0
+
+    def try_breakdown(tag, points, cfg):
+        # one phase row must not sink the rest (e.g. a blocked-kernel Mosaic
+        # failure at real shapes must still leave the kpass + 10M rows)
+        nonlocal failures
+        try:
+            breakdown(tag, points, cfg)
+        except Exception as e:  # noqa: BLE001 -- record and keep profiling
+            failures += 1
+            print(json.dumps({"config": tag,
+                              "platform": jax.devices()[0].platform,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
     blue = get_dataset("900k_blue_cube.xyz")
     for kern in ("kpass", "blocked"):
-        breakdown(f"north star 900k k=10 [{kern}]", blue,
-                  KnnConfig(k=10, kernel=kern))
+        try_breakdown(f"north star 900k k=10 [{kern}]", blue,
+                      KnnConfig(k=10, kernel=kern))
     if args.ten_m:
-        breakdown("uniform 10M k=10 [kpass]", generate_uniform(
+        try_breakdown("uniform 10M k=10 [kpass]", generate_uniform(
             10_000_000, seed=10), KnnConfig(k=10))
-    return 0
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":
