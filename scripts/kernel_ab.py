@@ -114,6 +114,11 @@ def main() -> int:
         for sc in (4, 5):
             try_measure(f"north star 900k (k=10, sc={sc})",
                         KnnConfig(k=10, kernel="blocked", supercell=sc))
+        # r3's sweep showed sc=3 < sc=4 solve time on kpass ("the smaller
+        # tile pipelines better", DESIGN 4b) but never measured the curve's
+        # left edge -- one row settles whether sc=2 continues the trend
+        try_measure("north star 900k (k=10, sc=2)",
+                    KnnConfig(k=10, kernel="kpass", supercell=2))
     return 1 if failures else 0
 
 
