@@ -182,9 +182,16 @@ def _kernel_blocked(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref,
     smallest, and the id set may differ from a full scan only inside exact
     ties at the k-th distance -- id flips inside exact ties are accepted
     throughout this framework (differential tests compare tie-aware).
+
+    Layout: candidate refs arrive as (1, G, 128) -- one SUBLANE row per
+    128-lane block -- so block g is a dynamic-sublane slice
+    (``c_ref[0, pl.ds(g, 1), :]``), the indexing pattern Mosaic supports
+    with a traced g.  The flat (1, 1, G*128) layout the kpass kernel uses
+    would need a dynamic *lane* offset in the rolled stage-1 path, which
+    the TPU's rigid 128-lane tiling does not (pallas_guide.md "Tiling
+    Constraints"; every documented pl.ds example indexes sublanes).
     """
-    c_total = cx_ref.shape[2]
-    n_blocks = c_total // 128
+    n_blocks = cx_ref.shape[1]
     q_lanes = qx_ref.shape[2]
     qa = [r[0, 0, :].reshape(-1, 1) for r in (qx_ref, qy_ref, qz_ref)]
     qi = qid_ref[0, 0, :].reshape(-1, 1) if exclude_self else None
@@ -192,14 +199,15 @@ def _kernel_blocked(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref,
     def block_topm(g):
         """One block's ascending top-m + its smallest remaining value, all
         sublane-major ((m, Q) kept, (1, Q) rem) so the rolled path can
-        dynamic-update rows (sublane offsets; lane offsets stay static)."""
-        sl = pl.ds(g * 128, 128)
+        dynamic-update rows (sublane offsets everywhere; lane offsets are
+        always static)."""
+        sl = pl.ds(g, 1)
         d2b = None
         for q_col, c_ref in zip(qa, (cx_ref, cy_ref, cz_ref)):
-            cb = c_ref[0, 0, sl].reshape(1, -1)
+            cb = c_ref[0, sl, :].reshape(1, -1)
             diff = q_col - cb
             d2b = diff * diff if d2b is None else d2b + diff * diff
-        cib = cid_ref[0, 0, sl].reshape(1, -1)
+        cib = cid_ref[0, sl, :].reshape(1, -1)
         drop = cib == _PAD_C
         if exclude_self:
             drop = drop | (qi == cib)
@@ -309,8 +317,18 @@ def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
     if m:
         body = functools.partial(_kernel_blocked, k=k, m=m,
                                  exclude_self=exclude_self)
+        # Candidates as (S, G, 128): one sublane row per lane block, so the
+        # kernel's per-block access is a dynamic-SUBLANE slice (see
+        # _kernel_blocked docstring).  HBM-side reshape only.
+        g = ccap // 128
+        cx, cy, cz = (a.reshape(s_total, g, 128) for a in (cx, cy, cz))
+        cid3 = cid3.reshape(s_total, g, 128)
+        c_spec = pl.BlockSpec((1, g, 128), lambda b: (b, 0, 0),
+                              memory_space=pltpu.VMEM)
     else:
         body = functools.partial(_kernel, k=k, exclude_self=exclude_self)
+        c_spec = pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
+                              memory_space=pltpu.VMEM)
     return pl.pallas_call(
         body,
         grid=(s_total,),
@@ -321,16 +339,12 @@ def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
+            c_spec,
+            c_spec,
+            c_spec,
             pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
+            c_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, k, qcap), lambda b: (b, 0, 0),
