@@ -152,8 +152,8 @@ def _kernel(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref, cid_ref,
 
 
 def _kernel_blocked(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref,
-                    cid_ref, out_d_ref, out_i_ref, *, k: int, m: int,
-                    exclude_self: bool):
+                    cid_ref, out_d_ref, out_i_ref, pool_d_ref, pool_i_ref,
+                    rem_ref, *, k: int, m: int, exclude_self: bool):
     """Blocked two-stage top-k (config.kernel='blocked').
 
     Stage 1 walks the candidate lanes one 128-lane block at a time: each
@@ -192,7 +192,6 @@ def _kernel_blocked(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref,
     Constraints"; every documented pl.ds example indexes sublanes).
     """
     n_blocks = cx_ref.shape[1]
-    q_lanes = qx_ref.shape[2]
     qa = [r[0, 0, :].reshape(-1, 1) for r in (qx_ref, qy_ref, qz_ref)]
     qi = qid_ref[0, 0, :].reshape(-1, 1) if exclude_self else None
 
@@ -228,24 +227,27 @@ def _kernel_blocked(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref,
     # Mosaic compile cost scales with unrolled op count; the kpass kernel
     # rolls above _UNROLL_K_MAX passes for the same reason.  Stage 1 is
     # n_blocks*m extraction passes: unroll small schedules (registers, no
-    # carry), roll big ones over the block index with a (G*m, Q) pool carry.
+    # scratch traffic); roll big ones over the block index, landing each
+    # block's rows in the VMEM scratch pool via ref stores at a dynamic
+    # SUBLANE offset (the documented pl.ds store pattern -- a traced-offset
+    # dynamic_update_slice on a loop-carried value is not).
     if n_blocks * m + k <= _UNROLL_K_MAX:
         blocks = [block_topm(g) for g in range(n_blocks)]
         pool_d = jnp.concatenate([b[0] for b in blocks], axis=0)  # (G*m, Q)
         pool_i = jnp.concatenate([b[1] for b in blocks], axis=0)
         rem = jnp.concatenate([b[2] for b in blocks], axis=0)     # (G, Q)
     else:
-        def s1_body(g, carry):
-            pool_d, pool_i, rem = carry
+        def s1_body(g, _):
             kd, ki, r = block_topm(g)
-            return (jax.lax.dynamic_update_slice(pool_d, kd, (g * m, 0)),
-                    jax.lax.dynamic_update_slice(pool_i, ki, (g * m, 0)),
-                    jax.lax.dynamic_update_slice(rem, r, (g, 0)))
+            pool_d_ref[pl.ds(g * m, m), :] = kd
+            pool_i_ref[pl.ds(g * m, m), :] = ki
+            rem_ref[pl.ds(g, 1), :] = r
+            return 0
 
-        pool_d, pool_i, rem = jax.lax.fori_loop(0, n_blocks, s1_body, (
-            jnp.full((n_blocks * m, q_lanes), jnp.inf, jnp.float32),
-            jnp.full((n_blocks * m, q_lanes), _PAD_C, jnp.int32),
-            jnp.full((n_blocks, q_lanes), jnp.inf, jnp.float32)))
+        jax.lax.fori_loop(0, n_blocks, s1_body, 0)
+        pool_d = pool_d_ref[:, :]
+        pool_i = pool_i_ref[:, :]
+        rem = rem_ref[:, :]
 
     def extract(pool_d):
         mv = jnp.min(pool_d, axis=0)                              # (Q,)
@@ -314,6 +316,7 @@ def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
 
     s_total = qx.shape[0]
     m = blocked_topm(k, ccap) if kernel == "blocked" else 0
+    scratch_shapes = []
     if m:
         body = functools.partial(_kernel_blocked, k=k, m=m,
                                  exclude_self=exclude_self)
@@ -325,6 +328,11 @@ def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
         cid3 = cid3.reshape(s_total, g, 128)
         c_spec = pl.BlockSpec((1, g, 128), lambda b: (b, 0, 0),
                               memory_space=pltpu.VMEM)
+        # VMEM survivor pool for the rolled stage-1 path (unused but cheap
+        # -- tens of KB -- when the unrolled path keeps it in registers)
+        scratch_shapes = [pltpu.VMEM((g * m, qcap), jnp.float32),
+                          pltpu.VMEM((g * m, qcap), jnp.int32),
+                          pltpu.VMEM((g, qcap), jnp.float32)]
     else:
         body = functools.partial(_kernel, k=k, exclude_self=exclude_self)
         c_spec = pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
@@ -356,6 +364,7 @@ def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
             jax.ShapeDtypeStruct((s_total, k, qcap), jnp.float32),
             jax.ShapeDtypeStruct((s_total, k, qcap), jnp.int32),
         ],
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
     )(qx, qy, qz, cx, cy, cz, qid3, cid3)
 
