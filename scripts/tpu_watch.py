@@ -36,16 +36,18 @@ def _utc() -> str:
     return datetime.datetime.now(datetime.timezone.utc).isoformat()
 
 
-def run_and_record(argv: list[str], out_path: str, timeout_s: float) -> int:
+def run_and_record(argv: list[str], out_path: str, timeout_s: float,
+                   env_extra: dict | None = None) -> int:
     """Run a bench command, persist an rc-stamped artifact of its stdout.
     A previously captured-good artifact short-circuits (rc 0, no run) and is
     never overwritten by a worse retry."""
     if _artifact_good(out_path):
         return 0
     t0 = time.time()
+    env = dict(os.environ, **(env_extra or {}))
     try:
         r = subprocess.run(argv, capture_output=True, text=True,
-                           timeout=timeout_s)
+                           timeout=timeout_s, env=env)
         rc, stdout, stderr = r.returncode, r.stdout, r.stderr
     except subprocess.TimeoutExpired as e:
         rc = -1
@@ -62,7 +64,11 @@ def run_and_record(argv: list[str], out_path: str, timeout_s: float) -> int:
                 pass
     record = {"rc": rc, "argv": argv, "utc": _utc(),
               "wall_s": round(time.time() - t0, 1), "lines": lines,
-              "stderr_tail": stderr[-2000:]}
+              "stderr_tail": stderr[-2000:],
+              # provenance: the smoke and full north-star steps share argv
+              # and differ only by env, so a failed (no-lines) artifact
+              # must still record which variant ran
+              **({"env_extra": env_extra} if env_extra else {})}
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "w") as f:
         json.dump(record, f, indent=1)
@@ -121,6 +127,7 @@ def main(argv=None) -> int:
             # after a flap resumes nearly compile-free and fits the window.
             # (Sets the env vars the children inherit; one source of truth.)
             enable_compile_cache()
+            sm_path = os.path.join(outdir, f"{args.tag}_tpu_smoke.json")
             ns_path = os.path.join(outdir, f"{args.tag}_tpu_north_star.json")
             all_path = os.path.join(outdir, f"{args.tag}_tpu_all_rows.json")
             ab_path = os.path.join(outdir, f"{args.tag}_tpu_kernel_ab.json")
@@ -128,25 +135,33 @@ def main(argv=None) -> int:
             d20_path = os.path.join(outdir, f"{args.tag}_tpu_diff_20k_k50.json")
             d300_path = os.path.join(outdir,
                                      f"{args.tag}_tpu_diff_300k_k50.json")
-            # Value order: the north star is THE record; the kernel A/B
-            # decides the default (VERDICT r4 next #2); then the full row
-            # set; then the k=50 differentials (/root/reference/params.h:4,
-            # VERDICT r4 next #6) and the phase table.
+            # Value order: first a SMOKE-scale north star (150K points,
+            # honestly stamped scaled_down_from) so even a minutes-long
+            # healthy window yields one rc-stamped platform=tpu record;
+            # then the full north star (THE record); the kernel A/B that
+            # decides the default (VERDICT r4 next #2); the full row set;
+            # the k=50 differentials (/root/reference/params.h:4, VERDICT
+            # r4 next #6); and the phase table.  Timeouts are tight on
+            # purpose: the observed healthy windows last single-digit
+            # minutes, and a child hung on a dead tunnel RPC blinds the
+            # probe loop for its whole timeout (the 2026-07-31 01:02
+            # window cost 30 min of blindness under the old 1800 s cap).
             steps = [
-                ([py, bench], ns_path, 1800),
+                ([py, bench], sm_path, 480, {"BENCH_NORTH_N": "150000"}),
+                ([py, bench], ns_path, 900, None),
                 ([py, os.path.join(REPO, "scripts", "kernel_ab.py")],
-                 ab_path, 2400),
-                ([py, bench, "--all"], all_path, 3600),
+                 ab_path, 1500, None),
+                ([py, bench, "--all"], all_path, 2400, None),
                 ([py, "-m", "cuda_knearests_tpu.cli", "pts20K.xyz",
-                  "--k", "50", "--json"], d20_path, 1800),
+                  "--k", "50", "--json"], d20_path, 700, None),
                 ([py, "-m", "cuda_knearests_tpu.cli", "pts300K.xyz",
-                  "--k", "50", "--json"], d300_path, 1800),
+                  "--k", "50", "--json"], d300_path, 900, None),
                 ([py, os.path.join(REPO, "scripts", "phase_breakdown.py"),
-                  "--ten-m"], ph_path, 2400),
+                  "--ten-m"], ph_path, 1500, None),
             ]
-            all_paths = [p for _, p, _ in steps]
+            all_paths = [p for _, p, _, _ in steps]
             ran_child = False
-            for argv_i, path_i, timeout_i in steps:
+            for argv_i, path_i, timeout_i, env_i in steps:
                 if _artifact_good(path_i):
                     continue
                 # Re-probe between steps: when the transport flaps mid-
@@ -161,7 +176,8 @@ def main(argv=None) -> int:
                         print("[tpu_watch] transport dark mid-sequence; "
                               "back to probing", flush=True)
                         break
-                run_and_record(argv_i, path_i, timeout_s=timeout_i)
+                run_and_record(argv_i, path_i, timeout_s=timeout_i,
+                               env_extra=env_i)
                 ran_child = True
             if all(_artifact_good(p) for p in all_paths):
                 print("[tpu_watch] record captured", flush=True)

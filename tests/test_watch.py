@@ -17,17 +17,21 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
 
 import tpu_watch  # noqa: E402
 
-STEP_FILES = ["_tpu_north_star.json", "_tpu_kernel_ab.json",
-              "_tpu_all_rows.json", "_tpu_diff_20k_k50.json",
-              "_tpu_diff_300k_k50.json", "_tpu_phases.json"]
+STEP_FILES = ["_tpu_smoke.json", "_tpu_north_star.json",
+              "_tpu_kernel_ab.json", "_tpu_all_rows.json",
+              "_tpu_diff_20k_k50.json", "_tpu_diff_300k_k50.json",
+              "_tpu_phases.json"]
 
 
 @pytest.fixture()
 def capture(monkeypatch, tmp_path):
     calls = []
 
-    def fake_run(argv, out_path, timeout_s):
+    def fake_run(argv, out_path, timeout_s, env_extra=None):
         calls.append(os.path.basename(out_path))
+        # the smoke step must scale the run down via env, not argv
+        if out_path.endswith("_tpu_smoke.json"):
+            assert (env_extra or {}).get("BENCH_NORTH_N")
         with open(out_path, "w") as f:
             json.dump({"rc": 0, "lines": [{"platform": "tpu", "value": 1}]}, f)
         return 0
