@@ -51,10 +51,13 @@ NORTH_STAR_METRIC = ("queries/sec/chip, all-points kNN on 900k_blue_cube.xyz "
 # docstrings in utils/platform.py).  Importing the package is backend-safe:
 # module import never initializes a jax backend.
 from cuda_knearests_tpu.utils import platform as _platform
+from cuda_knearests_tpu.utils import watchdog as _watchdog
 
 
 def _probe_default_backend(timeout_s: float) -> str | None:
-    return _platform._probe_default_backend(timeout_s)
+    res = _platform._probe_default_backend(timeout_s)
+    _watchdog.heartbeat()  # each bounded probe return is forward progress
+    return res
 
 
 def acquire_backend(tries: int | None = None, timeout_s: float | None = None):
@@ -82,6 +85,7 @@ def _steady_state(fn, iters: int = 3, max_seconds: float | None = None) -> float
     for _ in range(iters):
         t0 = time.perf_counter()
         fn()
+        _watchdog.heartbeat()
         times.append(time.perf_counter() - t0)
         spent += times[-1]
         if max_seconds is not None and spent >= max_seconds:
@@ -115,12 +119,14 @@ def _solve_qps(points, cfg, iters: int = 3, oracle_swap: bool = True,
                 and native_available()):
             cfg = dataclasses.replace(cfg, backend="oracle")
         problem = KnnProblem.prepare(points, cfg)
+    _watchdog.heartbeat()
 
     def run():
         res = problem.solve()
         jax.block_until_ready((res.neighbors, res.dists_sq, res.certified))
 
     run()  # compile + warmup
+    _watchdog.heartbeat()
     s = _steady_state(run, iters, max_seconds=_budget_s())
     return points.shape[0] / s, s, problem
 
@@ -238,7 +244,9 @@ def bench_north_star() -> dict:
     backend_used = problem.config.backend
     sample, sample_n = _sampled_oracle_ref(points, k)
     cpu_qps, _, (ref_ids, _) = _oracle_qps(points, k, sample_idx=sample)
+    _watchdog.heartbeat()  # the CPU oracle pass is slow but local
     got = problem.get_knearests_original()
+    _watchdog.heartbeat()
     if backend_used == "oracle":
         # kd-tree vs kd-tree would be self-referential: check a seeded
         # sample against an independent numpy brute force instead.  On
@@ -428,13 +436,16 @@ def bench_config(name: str) -> dict:
         n_target = int(os.environ.get(
             "BENCH_SHARDED_N", "1000000" if plat == "cpu" else "10000000"))
         points = generate_uniform(n_target, seed=10)
+        _watchdog.heartbeat()
         sp = ShardedKnnProblem.prepare(points, n_devices=ndev,
                                        config=KnnConfig(k=k))
+        _watchdog.heartbeat()  # prepare moved ~120 MB over the transport
 
         def run():
             jax.block_until_ready(sp.solve_device())
 
         run()  # compile + warmup; timing is device-side like the other configs
+        _watchdog.heartbeat()
         s = _steady_state(run, iters=2, max_seconds=_budget_s())
         qps = points.shape[0] / s
         # Correctness stamp (VERDICT r3 next #5): the published sharded
@@ -540,7 +551,14 @@ def main(argv=None) -> int:
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, _on_signal)
 
+    # armed before acquisition: the in-process jax init after a healthy
+    # probe is itself a hang point when the tunnel dies in between
+    _watchdog.start(tag="bench")
     platform, note = acquire_backend()
+    if platform == "cpu":
+        # local CPU work cannot hang on the transport, and the slow rows
+        # (emulated sharded 10M) legitimately exceed any sane stall limit
+        _watchdog.disable()
     state["note"] = note
     state["env"] = {"platform": platform, "n_devices": 0}
 
@@ -568,6 +586,7 @@ def main(argv=None) -> int:
 
     if args.all:
         for name in _ALL_CONFIGS:
+            _watchdog.heartbeat()  # entering a row is forward progress
             try:
                 row = bench_config(name)
             except Exception as e:  # noqa: BLE001 -- keep measuring the rest

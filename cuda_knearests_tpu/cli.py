@@ -82,7 +82,22 @@ def main(argv=None) -> int:
     # terminates.  JAX_PLATFORMS=cpu short-circuits the probe entirely.
     from .utils.platform import (acquire_backend, enable_compile_cache,
                                  honor_jax_platforms_env)
-    platform, backend_note = acquire_backend()
+    from .utils import watchdog
+    from .utils.platform import _probe_default_backend
+
+    def _probe(timeout_s):
+        # each bounded probe return is forward progress; without this a
+        # legitimately long probe-and-backoff acquisition (overridden tries
+        # or timeouts) would trip the stall limit mid-acquisition
+        res = _probe_default_backend(timeout_s)
+        watchdog.heartbeat()
+        return res
+
+    watchdog.start(tag="cli")  # a dead-tunnel hang must exit, not pin
+    platform, backend_note = acquire_backend(probe=_probe)
+    watchdog.heartbeat()  # bounded acquisition completed
+    if platform == "cpu":
+        watchdog.disable()  # local work cannot hang on the transport
     honor_jax_platforms_env()
     enable_compile_cache()  # remote-tunnel compiles persist across runs
 
@@ -115,9 +130,11 @@ def main(argv=None) -> int:
         with Stopwatch("prepare (grid + slab plan)"):
             sp = ShardedKnnProblem.prepare(points, n_devices=args.sharded,
                                            config=cfg)
+        watchdog.heartbeat()
         # device-side steady state, compile split out -- same convention (and
         # the same JSON summary schema) as the single-chip branch below
         dev_out, t = timed(lambda: sp.solve_device(), warmup=1, iters=1)
+        watchdog.heartbeat()
         print(f"solve (sharded): compile+first {t['warmup_s']:.3f}s, "
               f"steady {t['min_s']:.3f}s "
               f"({n / t['min_s']:.0f} queries/sec)")
@@ -129,7 +146,9 @@ def main(argv=None) -> int:
     else:
         with Stopwatch("prepare (grid + plan)"):
             problem = KnnProblem.prepare(points, cfg)
+        watchdog.heartbeat()
         _, t = timed(lambda: problem.solve(), warmup=1, iters=1)
+        watchdog.heartbeat()
         print(f"solve: compile+first {t['warmup_s']:.3f}s, "
               f"steady {t['min_s']:.3f}s "
               f"({n / t['min_s']:.0f} queries/sec)")
@@ -138,6 +157,11 @@ def main(argv=None) -> int:
         problem.print_stats()
         neighbors = problem.get_knearests_original()
         perm = problem.get_permutation()
+
+    # device work done; the remaining phases (oracle, tie analysis) are
+    # local CPU and may legitimately exceed the stall limit at k=50
+    watchdog.heartbeat()
+    watchdog.disable()
 
     # --- sanity: permutation bijection (test_knearests.cu:162-168) -------------
     assert np.array_equal(np.sort(perm), np.arange(n)), "permutation not a bijection"

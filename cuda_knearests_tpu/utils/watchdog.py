@@ -1,0 +1,96 @@
+"""In-process stall watchdog for unattended accelerated captures.
+
+The tunneled TPU transport's observed failure mode is a *hang*: a backend
+RPC (compile or execute) that never returns once the tunnel dies.  The
+reference can check-and-exit per CUDA call (its errors are synchronous,
+/root/reference/knearests.cu error handling); here the only reliable signal
+is the absence of progress.  The outer watcher (scripts/tpu_watch.py) kills
+a hung child at its step timeout, but that blinds the probe loop for the
+whole timeout and -- worse -- wastes the rest of a healthy window that
+returned while the child was pinned to its dead connection.  This watchdog
+lets the child detect the stall itself: benches call ``heartbeat()`` after
+every completed unit of device work, and a daemon thread exits the process
+(rc 3, after printing a machine-readable error line) when no heartbeat
+arrives for ``BENCH_STALL_TIMEOUT_S`` seconds (default 300; 0 disables).
+
+Callers ``disable()`` it on CPU hosts: local CPU work cannot hang on the
+transport, and a legitimately slow row (e.g. the emulated sharded 10M
+config) would trip a 300 s limit.
+
+GIL caveat: the thread only runs if the hung extension call released the
+GIL.  jax's blocking waits (compile RPCs, ``block_until_ready``) do, so the
+observed hangs are coverable; a hypothetical GIL-holding hang degrades to
+the outer watcher's timeout kill -- never worse than without the watchdog.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+_ENV = "BENCH_STALL_TIMEOUT_S"
+_lock = threading.Lock()
+_state = {"t": 0.0, "enabled": False, "stall_s": 300.0, "tag": ""}
+_started = False
+
+
+def heartbeat() -> None:
+    """Record forward progress.  Cheap; safe to call from any thread, and a
+    no-op if the watchdog was never started."""
+    with _lock:
+        _state["t"] = time.monotonic()
+
+
+def disable() -> None:
+    """Stop stall enforcement (the thread stays parked).  Used when the
+    acquired platform turns out to be CPU."""
+    with _lock:
+        _state["enabled"] = False
+
+
+def start(tag: str = "", default_s: float = 300.0) -> None:
+    """Arm the watchdog (idempotent).  ``tag`` names the tool for the error
+    line.  BENCH_STALL_TIMEOUT_S overrides the limit; <= 0 disables."""
+    global _started
+    raw = os.environ.get(_ENV)
+    stall_s = default_s
+    if raw is not None:
+        try:
+            stall_s = float(raw)
+        except ValueError:
+            print(f"ignoring malformed {_ENV}={raw!r}; using {default_s}",
+                  file=sys.stderr, flush=True)
+    if stall_s <= 0:
+        return
+    with _lock:
+        _state.update(t=time.monotonic(), enabled=True, stall_s=stall_s,
+                      tag=tag)
+    if _started:
+        return
+    _started = True
+    threading.Thread(target=_watch, daemon=True,
+                     name="bench-stall-watchdog").start()
+
+
+def _watch() -> None:
+    while True:
+        with _lock:
+            stall_s = _state["stall_s"]
+        time.sleep(max(1.0, min(15.0, stall_s / 4.0)))
+        with _lock:
+            if not _state["enabled"]:
+                continue
+            dt = time.monotonic() - _state["t"]
+            tag = _state["tag"]
+        if dt > stall_s:
+            # one machine-readable line so the rc-stamped artifact records
+            # WHY the run died (the watcher's _artifact_good rejects
+            # error-bearing lines, so the step is retried, not enshrined)
+            print(json.dumps({
+                "error": f"stall watchdog ({tag}): no progress for "
+                         f"{dt:.0f}s (limit {stall_s:.0f}s); presumed hung "
+                         f"on a dead accelerator transport"}), flush=True)
+            sys.stderr.flush()
+            os._exit(3)

@@ -17,6 +17,7 @@ sys.path.insert(0, REPO)  # PYTHONPATH breaks axon plugin discovery
 
 import jax
 
+from cuda_knearests_tpu.utils import watchdog
 from cuda_knearests_tpu.utils.platform import enable_compile_cache
 
 enable_compile_cache()  # remote-tunnel compiles persist across runs
@@ -28,10 +29,12 @@ from cuda_knearests_tpu.io import get_dataset
 
 def steady(fn, iters=5):
     fn()
+    watchdog.heartbeat()  # compile+warmup completed
     ts = []
     for _ in range(iters):
         t0 = time.perf_counter()
         fn()
+        watchdog.heartbeat()
         ts.append(time.perf_counter() - t0)
     return min(ts)
 
@@ -40,7 +43,11 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="k=10 only")
     args = ap.parse_args()
+    watchdog.start(tag="kernel_ab")  # dead-tunnel hangs must exit, not pin
     platform = jax.devices()[0].platform
+    watchdog.heartbeat()
+    if platform == "cpu":
+        watchdog.disable()
     blue = get_dataset("900k_blue_cube.xyz")
 
     def measure(tag: str, cfg: KnnConfig) -> None:
@@ -50,8 +57,10 @@ def main() -> int:
                                                        roofline_fields)
 
         p = KnnProblem.prepare(blue, cfg)
+        watchdog.heartbeat()
         raw = solve_adaptive(p.grid, cfg, p.aplan)
         pre_cert = float(np.asarray(raw.certified).mean())
+        watchdog.heartbeat()
 
         def run():
             r = p.solve()

@@ -34,6 +34,7 @@ import numpy as np
 from kernel_ab import steady  # shared steady-state timing methodology
 from cuda_knearests_tpu import KnnConfig, KnnProblem
 from cuda_knearests_tpu.io import get_dataset, generate_uniform
+from cuda_knearests_tpu.utils import watchdog
 
 
 def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
@@ -41,6 +42,7 @@ def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
 
     platform = jax.devices()[0].platform
     p = KnnProblem.prepare(points, cfg)
+    watchdog.heartbeat()
     plan = p.aplan
     grid = p.grid
 
@@ -101,6 +103,10 @@ def main() -> int:
     ap.add_argument("--ten-m", action="store_true",
                     help="also profile the 10M single-chip config")
     args = ap.parse_args()
+    watchdog.start(tag="phase_breakdown")
+    if jax.devices()[0].platform == "cpu":
+        watchdog.disable()
+    watchdog.heartbeat()
     failures = 0
 
     def try_breakdown(tag, points, cfg):

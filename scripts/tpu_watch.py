@@ -151,13 +151,17 @@ def main(argv=None) -> int:
                 ([py, bench], ns_path, 900, None),
                 ([py, os.path.join(REPO, "scripts", "kernel_ab.py")],
                  ab_path, 1500, None),
-                ([py, bench, "--all"], all_path, 2400, None),
+                # the 10M rows legitimately spend minutes in prepare
+                # (~120 MB H2D over the tunnel) between heartbeats
+                ([py, bench, "--all"], all_path, 2400,
+                 {"BENCH_STALL_TIMEOUT_S": "600"}),
                 ([py, "-m", "cuda_knearests_tpu.cli", "pts20K.xyz",
                   "--k", "50", "--json"], d20_path, 700, None),
                 ([py, "-m", "cuda_knearests_tpu.cli", "pts300K.xyz",
                   "--k", "50", "--json"], d300_path, 900, None),
                 ([py, os.path.join(REPO, "scripts", "phase_breakdown.py"),
-                  "--ten-m"], ph_path, 1500, None),
+                  "--ten-m"], ph_path, 1500,
+                 {"BENCH_STALL_TIMEOUT_S": "600"}),
             ]
             all_paths = [p for _, p, _, _ in steps]
             ran_child = False

@@ -1,0 +1,71 @@
+"""Stall watchdog: a bench child hung on a dead accelerator transport must
+exit on its own (rc 3, machine-readable error line) instead of pinning the
+outer watcher for the step timeout; heartbeats and CPU-disable must keep
+legitimate work alive.
+
+The reference needs no analog -- CUDA errors are synchronous and its driver
+check-and-exits per call; this environment's transport fails by hanging.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code: str, timeout=60):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def test_stall_exits_3_with_error_line():
+    r = _run("""
+import os, time
+os.environ["BENCH_STALL_TIMEOUT_S"] = "1"
+from cuda_knearests_tpu.utils import watchdog
+watchdog.start(tag="t")
+time.sleep(30)  # no heartbeat: the watchdog must kill us long before this
+""")
+    assert r.returncode == 3, r.stderr
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "stall watchdog" in line["error"]
+
+
+def test_heartbeat_and_disable_keep_process_alive():
+    r = _run("""
+import os, time
+os.environ["BENCH_STALL_TIMEOUT_S"] = "5"
+from cuda_knearests_tpu.utils import watchdog
+watchdog.start(tag="t")
+for _ in range(4):          # 0.5 s heartbeats outpace the 5 s limit with a
+    time.sleep(0.5)         # 10x margin (loaded-CI oversleep tolerance)
+    watchdog.heartbeat()
+watchdog.disable()          # CPU-host path: no enforcement at all
+time.sleep(7)
+print("survived")
+""", timeout=90)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "survived" in r.stdout
+
+
+def test_env_zero_disables_and_malformed_falls_back():
+    r = _run("""
+import os, time
+os.environ["BENCH_STALL_TIMEOUT_S"] = "0"
+from cuda_knearests_tpu.utils import watchdog
+watchdog.start(tag="t")
+time.sleep(2)
+print("survived")
+""")
+    assert r.returncode == 0 and "survived" in r.stdout
+    r = _run("""
+import os
+os.environ["BENCH_STALL_TIMEOUT_S"] = "nan-sense"
+from cuda_knearests_tpu.utils import watchdog
+watchdog.start(tag="t", default_s=300.0)
+print("armed")
+""")
+    assert r.returncode == 0 and "armed" in r.stdout
+    assert "ignoring malformed" in r.stderr
