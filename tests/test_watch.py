@@ -27,7 +27,8 @@ STEP_FILES = ["_tpu_smoke.json", "_tpu_north_star.json",
 def capture(monkeypatch, tmp_path):
     calls = []
 
-    def fake_run(argv, out_path, timeout_s, env_extra=None):
+    def fake_run(argv, out_path, timeout_s, env_extra=None,
+                 allow_partial=False):
         calls.append(os.path.basename(out_path))
         # the smoke step must scale the run down via env, not argv
         if out_path.endswith("_tpu_smoke.json"):
@@ -95,3 +96,24 @@ def test_artifact_good_rejects_cpu_fallback_and_errors(tmp_path):
     p.write_text(json.dumps(
         {"rc": 0, "lines": [{"platform": "tpu", "value": 1}]}))
     assert tpu_watch._artifact_good(str(p))
+
+
+def test_artifact_good_partial_accepts_result_rows(tmp_path):
+    """Experiment-matrix artifacts (kernel A/B, phases): a per-config error
+    row is a result (e.g. blocked failing Mosaic); the step must not be
+    re-run every window as long as one real measurement landed."""
+    p = tmp_path / "ab.json"
+    mixed = {"rc": 0, "lines": [
+        {"platform": "tpu", "config": "kpass", "value": 1},
+        {"platform": "tpu", "config": "blocked", "error": "Mosaic: no"}]}
+    p.write_text(json.dumps(mixed))
+    assert not tpu_watch._artifact_good(str(p))            # strict: rejected
+    assert tpu_watch._artifact_good(str(p), True)          # partial: a result
+    # all-error matrices are still retried even under partial
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        {"platform": "tpu", "config": "kpass", "error": "died"}]}))
+    assert not tpu_watch._artifact_good(str(p), True)
+    # a cpu-stamped row poisons partial artifacts too
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        {"platform": "cpu", "config": "kpass", "value": 1}]}))
+    assert not tpu_watch._artifact_good(str(p), True)
