@@ -555,9 +555,11 @@ def main(argv=None) -> int:
     # probe is itself a hang point when the tunnel dies in between
     _watchdog.start(tag="bench")
     platform, note = acquire_backend()
-    if platform == "cpu":
+    if platform == "cpu" and not os.environ.get("BENCH_STALL_FORCE"):
         # local CPU work cannot hang on the transport, and the slow rows
-        # (emulated sharded 10M) legitimately exceed any sane stall limit
+        # (emulated sharded 10M) legitimately exceed any sane stall limit.
+        # BENCH_STALL_FORCE keeps enforcement on for the fault-injection
+        # tests, which can only simulate a hang on the CPU backend.
         _watchdog.disable()
     state["note"] = note
     state["env"] = {"platform": platform, "n_devices": 0}

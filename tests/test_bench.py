@@ -169,6 +169,20 @@ def test_main_emits_json_on_sigterm():
     assert p.returncode == 128 + signal.SIGTERM
 
 
+def test_main_stall_watchdog_exits_3_on_hang():
+    """The full bench->watchdog chain: a hang with no heartbeat (the dead
+    tunnel's signature) must exit rc 3 with a machine-readable error line
+    the capture watcher will refuse to enshrine.  BENCH_STALL_FORCE keeps
+    enforcement on under the CPU backend, where a hang can be simulated."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_STALL_FORCE="1",
+               BENCH_STALL_TIMEOUT_S="2", BENCH_HANG_FOR_TEST="60")
+    r = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, env=env, timeout=120)
+    assert r.returncode == 3, (r.stdout, r.stderr)
+    out = _last_json_line(r.stdout)
+    assert out is not None and "stall watchdog" in out["error"]
+
+
 def test_enable_compile_cache_env_override_wins(monkeypatch, tmp_path):
     """An explicit JAX_COMPILATION_CACHE_DIR is honored verbatim; otherwise
     the repo-local .jax_cache default is installed at env AND config level
