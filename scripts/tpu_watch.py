@@ -139,6 +139,11 @@ def main(argv=None) -> int:
             # compiles are most of a cold capture.  Cache them so a retry
             # after a flap resumes nearly compile-free and fits the window.
             # (Sets the env vars the children inherit; one source of truth.)
+            # Persist even sub-0.5s compiles: locally trivial programs
+            # (the ~14 eager prepare/epilogue ops) still cost a remote
+            # compile round-trip per retry over the tunnel.
+            os.environ.setdefault(
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0")
             enable_compile_cache()
             sm_path = os.path.join(outdir, f"{args.tag}_tpu_smoke.json")
             ns_path = os.path.join(outdir, f"{args.tag}_tpu_north_star.json")
