@@ -116,8 +116,10 @@ class KnnConfig:
         better candidate decertifies the row, which then resolves through
         the standard exact fallback); candidate slots are interleaved across
         blocks at pack time so the spatially-clustered near candidates
-        spread evenly and deficits stay rare.  'auto' = 'blocked' where the
-        survivor pool comfortably covers k (see blocked_topm), else 'kpass'.
+        spread evenly and deficits stay rare.  'auto' = 'kpass': the
+        on-chip A/B (bench_runs/r5_tpu_kernel_ab.json) measured blocked
+        slower at every compiling shape and Mosaic-rejected at supercell
+        >= 4, so blocked is kept explicit-request-only (see resolve_kernel).
     """
 
     k: int = DEFAULT_K
@@ -175,13 +177,22 @@ def blocked_topm(k: int, ccap: int) -> int:
 
 
 def resolve_kernel(kernel: str, k: int, ccap: int) -> str:
-    """'auto' -> 'blocked' when eligible (see blocked_topm), else 'kpass'."""
+    """'auto' -> 'kpass'; 'blocked' stays explicit-request-only.
+
+    Decided by the on-chip A/B (bench_runs/r5_tpu_kernel_ab.json): at every
+    shape where blocked compiles it measured slower than kpass (k=10:
+    1.29M vs 2.17M q/s; k=20: 0.88M vs 1.57M), and at supercell >= 4 its
+    dynamic-offset VMEM scratch store fails Mosaic ('index in dimension 0
+    not provably a multiple of 8').  The traffic model that motivated it
+    (O(C*m + k*G*m) vs O(k*C) VMEM bytes) is real but does not pay on v5e,
+    where the kpass sweeps pipeline better than the per-block gather/store
+    traffic of the two-stage reduce."""
     if kernel not in ("auto", "blocked", "kpass"):
         raise ValueError(
             f"unknown kernel {kernel!r}: expected 'auto', 'blocked' or "
             f"'kpass'")  # a typo must not silently benchmark the wrong body
     if kernel == "auto":
-        return "blocked" if blocked_topm(k, ccap) else "kpass"
+        return "kpass"
     if kernel == "blocked" and not blocked_topm(k, ccap):
         return "kpass"  # ineligible shape: degrade to exact-anyway kpass
     return kernel

@@ -158,7 +158,10 @@ def test_blocked_topm_policy():
     assert blocked_topm(50, 2304) == 9       # m bumps to cover 3k (G=18)
     assert blocked_topm(10, 128) == 0        # single block
     assert blocked_topm(10, 1000) == 0       # not 128-aligned
-    assert resolve_kernel("auto", 10, 1152) == "blocked"
+    # 'auto' pins kpass since the on-chip A/B (r5_tpu_kernel_ab.json)
+    # measured blocked slower everywhere it compiles; blocked is
+    # explicit-request-only and still degrades on ineligible shapes
+    assert resolve_kernel("auto", 10, 1152) == "kpass"
     assert resolve_kernel("auto", 50, 1152) == "kpass"
     assert resolve_kernel("blocked", 50, 1152) == "kpass"  # silent degrade
     assert resolve_kernel("kpass", 10, 1152) == "kpass"
