@@ -228,7 +228,7 @@ class ClassPlan:
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=("classes", "inv_base", "inv_istride", "inv_box",
+    data_fields=("classes", "inv_row", "inv_box",
                  "class_of_sc", "row_of_sc"),
     meta_fields=("n_points",),
 )
@@ -236,15 +236,18 @@ class ClassPlan:
 class AdaptivePlan:
     """Class schedules + the global slot-partition inverse for the epilogue.
 
-    inv_base/inv_istride: (n,) i32 -- stored point r's k neighbors live at
-              1-D offsets inv_base[r] + i * inv_istride[r] of the
-              concatenation of every class's RAW solver output, flattened.
-              Encoding each route's natural layout here (pallas emits
-              (Sc, k, qcap) so istride = qcap; dense/streamed emit
-              (Sc*qcap, k) so istride = 1) lets the epilogue gather
-              straight from the kernel outputs with no transposes
-              (VERDICT r3 weak #2: the (S,k,Q)->(S*Q,k) transposes
-              survived in the hot path).
+    inv_row:  (n,) i32 -- stored point r's k neighbors live in row
+              inv_row[r] of the ROW-MAJOR (N_slots, k) concatenation of
+              every class's solver output.  The row index is uniform across
+              routes (row_off + sc * qcap + lane); the pallas route's raw
+              (Sc, k, qcap) output is transposed to row-major in the
+              epilogue first.  The earlier element-level inv_base/istride
+              maps avoided that transpose, but the resulting strided
+              ELEMENT gather of n*k indices dominated the solve (51.5% of
+              the on-chip kpass north star, bench_runs/r5_tpu_phases.json)
+              -- gather cost scales with index count, so transposing and
+              gathering k-fold fewer CONTIGUOUS rows wins despite the
+              extra data movement (A/B: scripts/epilogue_ab.py).
     inv_box:  (n,) i32 into the concatenation of per-class supercell axes
               (for the per-row lo/hi certificate gather).
     class_of_sc / row_of_sc: (n_sc_global,) i32 -- which class each global
@@ -255,8 +258,7 @@ class AdaptivePlan:
     """
 
     classes: Tuple[ClassPlan, ...]
-    inv_base: jax.Array
-    inv_istride: jax.Array
+    inv_row: jax.Array
     inv_box: jax.Array
     class_of_sc: jax.Array
     row_of_sc: jax.Array
@@ -313,10 +315,10 @@ def build_adaptive_plan(grid: GridHash, cfg: KnnConfig,
                 cp.own, cp.cand, cp.qcap_pad, cp.ccap))
         classes.append(cp)
 
-    inv_base, inv_istride, inv_box = _invert_partition(
-        tuple(classes), grid.cell_starts, grid.cell_counts, grid.n_points, k)
-    return AdaptivePlan(classes=tuple(classes), inv_base=inv_base,
-                        inv_istride=inv_istride, inv_box=inv_box,
+    inv_row, inv_box = _invert_partition(
+        tuple(classes), grid.cell_starts, grid.cell_counts, grid.n_points)
+    return AdaptivePlan(classes=tuple(classes), inv_row=inv_row,
+                        inv_box=inv_box,
                         class_of_sc=jnp.asarray(class_of),
                         row_of_sc=jnp.asarray(row_of), n_points=grid.n_points)
 
@@ -333,17 +335,19 @@ def _prepack_kernel_inputs(points, starts, counts, own, cand,
                      qid3=qid3, cid3=cid3)
 
 
-def _class_inverse_update(inv_base, inv_istride, inv_box, cp: ClassPlan,
-                          starts, counts, sentinel: int, k: int,
-                          elem_off: int, box_off: int):
-    """Scatter one class's raw-output layout map into the inversion arrays
+def _class_inverse_update(inv_row, inv_box, cp: ClassPlan,
+                          starts, counts, sentinel: int,
+                          row_off: int, box_off: int):
+    """Scatter one class's output-row map into the inversion arrays
     (shared by the single-chip and per-chip-sharded prepare paths).
 
-    The layout encodes each route's natural output order so the epilogue
-    gathers with no transposes: pallas emits (Sc, k, qcap) -> elem =
-    sc*k*qcap + i*qcap + lane, istride = qcap; dense/streamed emit
-    (Sc*qcap, k) -> elem = (sc*qcap + lane)*k + i, istride = 1.  Returns the
-    updated arrays plus the advanced (elem_off, box_off).
+    Row indices are uniform across routes -- row = row_off + sc*qcap + lane
+    into the row-major (N_slots, k) concat of class outputs; the per-route
+    layout difference (pallas emits (Sc, k, qcap), dense/streamed emit
+    (Sc*qcap, k)) is handled by `_rows2d`'s per-class transpose in the
+    epilogue instead of being encoded into element strides here (see
+    AdaptivePlan.inv_row for the measured reason).  Returns the updated
+    arrays plus the advanced (row_off, box_off).
     """
     q_idx, q_ok = pack_cells(cp.own, starts, counts, cp.qcap_pad)
     qcap = cp.qcap_pad
@@ -351,43 +355,53 @@ def _class_inverse_update(inv_base, inv_istride, inv_box, cp: ClassPlan,
                             q_idx.shape)
     rows = jnp.broadcast_to(
         jnp.arange(cp.n_sc, dtype=jnp.int32)[:, None], q_idx.shape)
-    if cp.route == "pallas":
-        base = elem_off + rows * (k * qcap) + lane
-        istride = qcap
-    else:
-        base = elem_off + (rows * qcap + lane) * k
-        istride = 1
     safe = jnp.where(q_ok, q_idx, sentinel)
-    inv_base = inv_base.at[safe].set(base, mode="drop")
-    inv_istride = inv_istride.at[safe].set(istride, mode="drop")
+    inv_row = inv_row.at[safe].set(row_off + rows * qcap + lane, mode="drop")
     inv_box = inv_box.at[safe].set(box_off + rows, mode="drop")
-    elem_off += cp.n_sc * qcap * k
+    row_off += cp.n_sc * qcap
     box_off += cp.n_sc
-    # element-unit indices shrink the int32 ceiling by k vs the old
-    # row-unit inv_flat; at that scale jnp.take's clip mode would return
-    # silently wrong (yet certifiable) neighbors, so refuse loudly
-    if elem_off > 2**31 - 1:
+    # past the int32 ceiling jnp.take's clip mode would return silently
+    # wrong (yet certifiable) neighbors, so refuse loudly; row-unit
+    # indices put that ceiling k-fold beyond the old element-unit maps
+    if row_off > 2**31 - 1:
         raise ValueError(
-            f"raw solver output exceeds int32 indexing "
-            f"({elem_off} elements): shard the problem or reduce k")
-    return inv_base, inv_istride, inv_box, elem_off, box_off
+            f"solver output exceeds int32 row indexing "
+            f"({row_off} rows): shard the problem")
+    return inv_row, inv_box, row_off, box_off
 
 
-@functools.partial(jax.jit, static_argnames=("n", "k"))
+def _rows2d(flats_d, flats_i, classes, k: int):
+    """Concat per-class raw solver outputs as row-major (N_slots, k) arrays
+    (the epilogue's gather operand; see AdaptivePlan.inv_row).  pallas
+    classes transpose their (Sc, k, qcap) kernel layout here -- one
+    vectorized data movement instead of a per-element strided gather."""
+    ds, is_ = [], []
+    for cp, fd, fi in zip(classes, flats_d, flats_i):
+        if cp.route == "pallas":
+            d3 = fd.reshape(cp.n_sc, k, cp.qcap_pad)
+            i3 = fi.reshape(cp.n_sc, k, cp.qcap_pad)
+            ds.append(jnp.swapaxes(d3, 1, 2).reshape(-1, k))
+            is_.append(jnp.swapaxes(i3, 1, 2).reshape(-1, k))
+        else:
+            ds.append(fd.reshape(-1, k))
+            is_.append(fi.reshape(-1, k))
+    return jnp.concatenate(ds, axis=0), jnp.concatenate(is_, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
 def _invert_partition(classes: Tuple[ClassPlan, ...], starts: jax.Array,
-                      counts: jax.Array, n: int, k: int):
-    """One prepare-time scatter: stored point -> (raw-output base index,
-    per-neighbor stride, supercell row).  See AdaptivePlan.inv_base."""
-    inv_base = jnp.zeros((n,), jnp.int32)
-    inv_istride = jnp.ones((n,), jnp.int32)
+                      counts: jax.Array, n: int):
+    """One prepare-time scatter: stored point -> (output row, supercell
+    row).  See AdaptivePlan.inv_row."""
+    inv_row = jnp.zeros((n,), jnp.int32)
     inv_box = jnp.zeros((n,), jnp.int32)
-    elem_off = 0
+    row_off = 0
     box_off = 0
     for cp in classes:
-        inv_base, inv_istride, inv_box, elem_off, box_off = (
-            _class_inverse_update(inv_base, inv_istride, inv_box, cp,
-                                  starts, counts, n, k, elem_off, box_off))
-    return inv_base, inv_istride, inv_box
+        inv_row, inv_box, row_off, box_off = (
+            _class_inverse_update(inv_row, inv_box, cp,
+                                  starts, counts, n, row_off, box_off))
+    return inv_row, inv_box
 
 
 def _streamed_topk(points: jax.Array, starts: jax.Array, counts: jax.Array,
@@ -541,8 +555,8 @@ def _class_flat(points: jax.Array, starts: jax.Array, counts: jax.Array,
     """Route one class's self-solve to its solver.  Returns the solver's
     RAW output flattened 1-D (Sc * qcap_pad * k elements): pallas emits
     (Sc, k, qcap) order, dense/streamed emit (Sc*qcap, k) order -- the
-    per-route layout is encoded in the epilogue's base/istride maps
-    (AdaptivePlan.inv_base), so no route pays a transpose."""
+    epilogue's `_rows2d` normalizes both to row-major before the one
+    per-point row gather (AdaptivePlan.inv_row)."""
     if cp.route == "pallas":
         return _pallas_class(points, starts, counts, cp, k, exclude_self,
                              interpret, kernel)
@@ -586,8 +600,8 @@ def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                                 cp.qcap_pad, cp.ccap, k, exclude_self,
                                 interpret,
                                 resolve_kernel(kernel, k, cp.ccap))
-    # raw (Sc, k, qcap) layout, flattened -- the epilogue's base/istride
-    # gather (AdaptivePlan.inv_base) indexes it directly, no transpose
+    # raw (Sc, k, qcap) layout, flattened -- the epilogue's _rows2d
+    # transposes it to row-major before the per-point row gather
     return out_d.reshape(-1), out_i.reshape(-1)
 
 
@@ -605,13 +619,9 @@ def _solve_adaptive(points: jax.Array, starts: jax.Array, counts: jax.Array,
         flats_i.append(fi)
         los.append(cp.lo)
         his.append(cp.hi)
-    flat_d = jnp.concatenate(flats_d, axis=0)                # 1-D raw concat
-    flat_i = jnp.concatenate(flats_i, axis=0)
-    idx = (plan.inv_base[:, None]
-           + jnp.arange(k, dtype=jnp.int32)[None, :]
-           * plan.inv_istride[:, None])
-    row_d = jnp.take(flat_d, idx)                            # (n, k)
-    row_i = jnp.take(flat_i, idx)
+    all_d, all_i = _rows2d(flats_d, flats_i, plan.classes, k)
+    row_d = jnp.take(all_d, plan.inv_row, axis=0)            # (n, k)
+    row_i = jnp.take(all_i, plan.inv_row, axis=0)
     # raw k-th BEFORE sanitization: blocked-kernel deficit rows carry NaN
     # there, and NaN <= margin is false even for an infinite margin
     raw_kth = row_d[:, k - 1]

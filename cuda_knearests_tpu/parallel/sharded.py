@@ -43,7 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import DOMAIN_SIZE, KnnConfig, default_ring_radius
 from ..ops.adaptive import (ClassPlan, _class_flat, _prepack_kernel_inputs,
-                            build_class_specs, select_radii)
+                            _rows2d, build_class_specs, select_radii)
 from ..ops.gridhash import cell_coords
 from ..ops.rings import box_sums, summed_area_table
 from ..ops.solve import _FAR, _margin_sq, _round_up, pack_cells
@@ -373,8 +373,8 @@ def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
     packing or scatter (measured 3.3x on the single-chip path, DESIGN.md).
 
     Returns (spts, ext arrays, classes-with-pk,
-    inv_loc = (inv_base (pcap,), inv_istride (pcap,)) raw-output index maps
-    for the local rows, lo_rows/hi_rows (pcap, 3) certificate boxes per
+    inv_loc = (pcap,) output-row index map for the local rows (see
+    AdaptivePlan.inv_row), lo_rows/hi_rows (pcap, 3) certificate boxes per
     local row).
     """
     pcap = spts.shape[0]
@@ -385,10 +385,9 @@ def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
     from ..ops.adaptive import _class_inverse_update
 
     n_ext = ext_pts.shape[0]
-    inv_base = jnp.zeros((n_ext,), jnp.int32)
-    inv_istride = jnp.ones((n_ext,), jnp.int32)
+    inv_row = jnp.zeros((n_ext,), jnp.int32)
     inv_box = jnp.zeros((n_ext,), jnp.int32)
-    elem_off = box_off = 0
+    row_off = box_off = 0
     packed = []
     for cp in classes:
         if cp.route == "pallas":
@@ -398,11 +397,11 @@ def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
         packed.append(cp)
         # invert this class's slot partition (local rows only own slots
         # here: own cells never cover halo layers) via the shared layout
-        # encoder -- one source of truth for the raw-output index maps
-        inv_base, inv_istride, inv_box, elem_off, box_off = (
-            _class_inverse_update(inv_base, inv_istride, inv_box, cp,
-                                  ext_starts, ext_counts, n_ext, k,
-                                  elem_off, box_off))
+        # encoder -- one source of truth for the output-row index maps
+        inv_row, inv_box, row_off, box_off = (
+            _class_inverse_update(inv_row, inv_box, cp,
+                                  ext_starts, ext_counts, n_ext,
+                                  row_off, box_off))
 
     loc = slice(hcap, hcap + pcap)
     box_loc = inv_box[loc]
@@ -411,7 +410,7 @@ def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
     hi_rows = jnp.take(jnp.concatenate([cp.hi for cp in classes], axis=0),
                        box_loc, axis=0)
     return (spts, ext_pts, ext_ids, ext_starts, ext_counts, tuple(packed),
-            (inv_base[loc], inv_istride[loc]), lo_rows, hi_rows)
+            inv_row[loc], lo_rows, hi_rows)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
@@ -432,13 +431,9 @@ def _chip_solve(spts, ext_pts, ext_ids, ext_starts, ext_counts,
                              exclude_self, tile, interpret, kernel)
         flats_d.append(fd)
         flats_i.append(fi)
-    flat_d = jnp.concatenate(flats_d, axis=0)                # 1-D raw concat
-    flat_i = jnp.concatenate(flats_i, axis=0)
-    inv_base, inv_istride = inv_loc
-    idx = (inv_base[:, None]
-           + jnp.arange(k, dtype=jnp.int32)[None, :] * inv_istride[:, None])
-    row_d = jnp.take(flat_d, idx)                            # (pcap, k)
-    row_i = jnp.take(flat_i, idx)
+    all_d, all_i = _rows2d(flats_d, flats_i, classes, k)
+    row_d = jnp.take(all_d, inv_loc, axis=0)                 # (pcap, k)
+    row_i = jnp.take(all_i, inv_loc, axis=0)
     # raw k-th BEFORE sanitization (blocked-kernel deficit rows carry NaN)
     raw_kth = row_d[:, k - 1]
     ok = jnp.isfinite(row_d)

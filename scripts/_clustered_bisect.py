@@ -1,0 +1,60 @@
+"""Class-by-class bisect of the clustered-300K TPU worker crash.
+
+Runs each adaptive class's self-solve as its own jitted program with a
+block_until_ready between, printing progress, so the crashing class (or
+epilogue, or global-planner prepare) is identified by the last line
+printed before the worker dies."""
+import os, sys, time
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+import json
+import numpy as np, jax, jax.numpy as jnp
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.io import generate_clustered
+from cuda_knearests_tpu.ops import gridhash, adaptive
+from cuda_knearests_tpu.utils.platform import enable_compile_cache
+enable_compile_cache()
+
+n = int(os.environ.get("REPRO_N", "300000"))
+points = generate_clustered(n, seed=303)
+cfg = KnnConfig(k=10)
+print(json.dumps({"platform": jax.devices()[0].platform, "stage": "init", "platform": jax.devices()[0].platform, "n": n}), flush=True)
+
+dim = gridhash.grid_dim_for(n, cfg.density)
+t0 = time.time()
+grid = gridhash.build_grid(jnp.asarray(points, jnp.float32), dim)
+jax.block_until_ready(grid.points)
+print(json.dumps({"platform": jax.devices()[0].platform, "stage": "grid", "seconds": round(time.time()-t0,1), "dim": dim}), flush=True)
+
+t0 = time.time()
+plan = adaptive.build_adaptive_plan(grid, cfg)
+print(json.dumps({"platform": jax.devices()[0].platform, "stage": "plan", "seconds": round(time.time()-t0,1),
+      "classes": [[c.route, int(c.own.shape[0]), int(c.qcap_pad), int(c.ccap)]
+                  for c in plan.classes]}), flush=True)
+
+run_one = jax.jit(adaptive._class_flat,
+                  static_argnames=("k", "exclude_self", "tile", "interpret",
+                                   "kernel"))
+for i, cp in enumerate(plan.classes):
+    t0 = time.time()
+    fd, fi = run_one(grid.points, grid.cell_starts, grid.cell_counts, cp,
+                     k=cfg.k, exclude_self=cfg.exclude_self,
+                     tile=cfg.stream_tile, interpret=False, kernel="kpass")
+    jax.block_until_ready((fd, fi))
+    print(json.dumps({"platform": jax.devices()[0].platform, "stage": f"class_{i}", "route": cp.route,
+          "n_sc": int(cp.own.shape[0]), "ccap": int(cp.ccap),
+          "seconds": round(time.time()-t0,1)}), flush=True)
+
+t0 = time.time()
+res = adaptive.solve_adaptive(grid, cfg, plan)
+jax.block_until_ready((res.neighbors, res.dists_sq, res.certified))
+print(json.dumps({"platform": jax.devices()[0].platform, "stage": "full_adaptive_solve", "seconds": round(time.time()-t0,1),
+      "certified": float(np.asarray(res.certified).mean())}), flush=True)
+
+t0 = time.time()
+prob_g = KnnProblem.prepare(points, KnnConfig(k=cfg.k, adaptive=False))
+print(json.dumps({"platform": jax.devices()[0].platform, "stage": "global_prepare", "seconds": round(time.time()-t0,1)}), flush=True)
+t0 = time.time()
+rg = prob_g.solve()
+jax.block_until_ready((rg.neighbors, rg.dists_sq, rg.certified))
+print(json.dumps({"platform": jax.devices()[0].platform, "stage": "global_solve", "seconds": round(time.time()-t0,1)}), flush=True)

@@ -1,0 +1,18 @@
+import os, sys, time
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+import numpy as np, jax
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.io import generate_clustered
+from cuda_knearests_tpu.utils.platform import enable_compile_cache
+enable_compile_cache()
+n = int(os.environ.get("REPRO_N", "300000"))
+points = generate_clustered(n, seed=303)
+print("platform", jax.devices()[0].platform, "n", n, flush=True)
+t0=time.time()
+prob = KnnProblem.prepare(points, KnnConfig(k=10))
+print(f"prepare done {time.time()-t0:.1f}s", flush=True)
+t0=time.time()
+res = prob.solve()
+jax.block_until_ready((res.neighbors, res.dists_sq, res.certified))
+print(f"solve done {time.time()-t0:.1f}s certified={float(np.asarray(res.certified).mean()):.6f}", flush=True)

@@ -1,0 +1,192 @@
+"""A/B the solve epilogue on the live chip (VERDICT r4 next #7).
+
+The on-chip phase breakdown (bench_runs/r5_tpu_phases.json) measured the
+epilogue -- the flat-kernel-output -> per-point (n, k) rows step -- at 51.5%
+of the kpass north-star solve (218.8 ms of 424.6 ms).  The current epilogue
+is two strided *element* gathers of n*k elements (inv_base + i*istride into
+the raw (Sc, k, qcap) flats, adaptive.py:_solve_adaptive); the hypothesis is
+that XLA lowers that irregular element gather poorly and a per-class
+transpose to row-major (Sc*qcap, k) followed by one contiguous *row* gather
+is much faster despite touching the same bytes.
+
+Prints one JSON line per variant: steady-state epilogue seconds on the 900K
+north-star shapes, plus an exactness stamp vs the current epilogue.
+
+Run on a healthy accelerator:  python scripts/epilogue_ab.py
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # PYTHONPATH breaks axon plugin discovery
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_knearests_tpu import KnnConfig
+from cuda_knearests_tpu.io import get_dataset
+from cuda_knearests_tpu.ops import adaptive, gridhash
+from cuda_knearests_tpu.ops.solve import _margin_sq
+from cuda_knearests_tpu.ops.topk import INVALID_ID
+from cuda_knearests_tpu.utils import watchdog
+from cuda_knearests_tpu.utils.platform import enable_compile_cache
+
+enable_compile_cache()
+
+
+def steady(fn, iters=5):
+    fn()  # compile + warmup
+    watchdog.heartbeat()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "k"))
+def _legacy_element_maps(classes, starts, counts, n: int, k: int):
+    """The pre-r5 element-level inverse (inv_base + i*istride into the 1-D
+    raw concat), reconstructed here so the A/B keeps measuring the legacy
+    epilogue after the package moved to the row-major one."""
+    inv_base = jnp.zeros((n,), jnp.int32)
+    inv_istride = jnp.ones((n,), jnp.int32)
+    elem_off = 0
+    for cp in classes:
+        q_idx, q_ok = adaptive.pack_cells(cp.own, starts, counts, cp.qcap_pad)
+        qcap = cp.qcap_pad
+        lane = jnp.broadcast_to(
+            jnp.arange(qcap, dtype=jnp.int32)[None, :], q_idx.shape)
+        rows = jnp.broadcast_to(
+            jnp.arange(cp.n_sc, dtype=jnp.int32)[:, None], q_idx.shape)
+        if cp.route == "pallas":
+            base, istride = elem_off + rows * (k * qcap) + lane, qcap
+        else:
+            base, istride = elem_off + (rows * qcap + lane) * k, 1
+        safe = jnp.where(q_ok, q_idx, n)
+        inv_base = inv_base.at[safe].set(base, mode="drop")
+        inv_istride = inv_istride.at[safe].set(istride, mode="drop")
+        elem_off += cp.n_sc * qcap * k
+    return inv_base, inv_istride
+
+
+def main() -> int:
+    k = 10
+    points = get_dataset("900k_blue_cube.xyz")
+    cfg = KnnConfig(k=k)
+    n = points.shape[0]
+    dim = gridhash.grid_dim_for(n, cfg.density)
+    grid = gridhash.build_grid(jnp.asarray(points, jnp.float32), dim)
+    plan = adaptive.build_adaptive_plan(grid, cfg)
+    plat = jax.devices()[0].platform
+    print(json.dumps({"config": "epilogue A/B 900k k=10", "platform": plat,
+                      "classes": [[c.route, int(c.own.shape[0]),
+                                   int(c.qcap_pad), int(c.ccap)]
+                                  for c in plan.classes]}), flush=True)
+
+    run_one = jax.jit(adaptive._class_flat,
+                      static_argnames=("k", "exclude_self", "tile",
+                                       "interpret", "kernel"))
+    flats = []
+    for cp in plan.classes:
+        fd, fi = run_one(grid.points, grid.cell_starts, grid.cell_counts, cp,
+                         k=k, exclude_self=cfg.exclude_self,
+                         tile=cfg.stream_tile, interpret=False,
+                         kernel="kpass")
+        flats.append((fd, fi))
+    jax.block_until_ready(flats)
+    watchdog.heartbeat()
+
+    flat_d = jnp.concatenate([f[0] for f in flats])
+    flat_i = jnp.concatenate([f[1] for f in flats])
+    los = jnp.concatenate([cp.lo for cp in plan.classes], axis=0)
+    his = jnp.concatenate([cp.hi for cp in plan.classes], axis=0)
+
+    # -- variant A: the legacy element-gather epilogue (inv_base/istride) --
+    inv_base, inv_istride = _legacy_element_maps(
+        plan.classes, grid.cell_starts, grid.cell_counts, n, k)
+
+    @jax.jit
+    def epi_current(flat_d, flat_i, pts):
+        idx = (inv_base[:, None]
+               + jnp.arange(k, dtype=jnp.int32)[None, :]
+               * inv_istride[:, None])
+        row_d = jnp.take(flat_d, idx)
+        row_i = jnp.take(flat_i, idx)
+        raw_kth = row_d[:, k - 1]
+        ok = jnp.isfinite(row_d)
+        row_i = jnp.where(ok, row_i, INVALID_ID)
+        row_d = jnp.where(ok, row_d, jnp.inf)
+        lo = jnp.take(los, plan.inv_box, axis=0)
+        hi = jnp.take(his, plan.inv_box, axis=0)
+        cert = raw_kth <= _margin_sq(pts[:, None, :], lo, hi, grid.domain)[:, 0]
+        return row_i, row_d, cert
+
+    # -- variant B: per-class transpose to row-major + one row gather -------
+    inv_row = plan.inv_row
+
+    @jax.jit
+    def epi_rowmajor(flats, pts):
+        # the PRODUCTION epilogue path: measure adaptive._rows2d itself so
+        # the A/B tracks whatever the shipped code does
+        all_d, all_i = adaptive._rows2d([f[0] for f in flats],
+                                        [f[1] for f in flats],
+                                        plan.classes, k)
+        row_d = jnp.take(all_d, inv_row, axis=0)
+        row_i = jnp.take(all_i, inv_row, axis=0)
+        raw_kth = row_d[:, k - 1]
+        ok = jnp.isfinite(row_d)
+        row_i = jnp.where(ok, row_i, INVALID_ID)
+        row_d = jnp.where(ok, row_d, jnp.inf)
+        lo = jnp.take(los, plan.inv_box, axis=0)
+        hi = jnp.take(his, plan.inv_box, axis=0)
+        cert = raw_kth <= _margin_sq(pts[:, None, :], lo, hi, grid.domain)[:, 0]
+        return row_i, row_d, cert
+
+    # -- attribution: gathers alone vs cert alone (variant A pieces) --------
+    @jax.jit
+    def gathers_only(flat_d, flat_i):
+        idx = (inv_base[:, None]
+               + jnp.arange(k, dtype=jnp.int32)[None, :]
+               * inv_istride[:, None])
+        return jnp.take(flat_d, idx), jnp.take(flat_i, idx)
+
+    @jax.jit
+    def cert_only(row_d, pts):
+        lo = jnp.take(los, plan.inv_box, axis=0)
+        hi = jnp.take(his, plan.inv_box, axis=0)
+        return row_d[:, k - 1] <= _margin_sq(pts[:, None, :], lo, hi,
+                                             grid.domain)[:, 0]
+
+    ra = epi_current(flat_d, flat_i, grid.points)
+    rb = epi_rowmajor(flats, grid.points)
+    jax.block_until_ready((ra, rb))
+    same = bool(jnp.array_equal(ra[0], rb[0]) and jnp.array_equal(ra[1], rb[1])
+                and jnp.array_equal(ra[2], rb[2]))
+
+    rows = {
+        "epilogue_legacy_element_gather": steady(
+            lambda: jax.block_until_ready(epi_current(flat_d, flat_i,
+                                                      grid.points))),
+        "epilogue_rowmajor_transpose_gather": steady(
+            lambda: jax.block_until_ready(epi_rowmajor(flats, grid.points))),
+        "gathers_only_current": steady(
+            lambda: jax.block_until_ready(gathers_only(flat_d, flat_i))),
+        "cert_only": steady(
+            lambda: jax.block_until_ready(cert_only(ra[1], grid.points))),
+    }
+    for name, s in rows.items():
+        print(json.dumps({"config": name, "platform": plat,
+                          "seconds": round(s, 5), "n_points": n, "k": k,
+                          "variants_equal": same}), flush=True)
+    return 0 if same else 1
+
+
+if __name__ == "__main__":
+    watchdog.start(tag="epilogue_ab")
+    sys.exit(main())
