@@ -517,7 +517,18 @@ def main(argv=None) -> int:
                             "exit (rc 0 iff the row carries no error) -- "
                             "used for rc-stamped single-row artifacts, e.g. "
                             "the full-size sharded run")
+    ap.add_argument("--skip", choices=_ALL_CONFIGS, action="append",
+                    default=None,
+                    help="with --all: leave this config out (repeatable). "
+                         "For quarantining a row that kills the backend -- "
+                         "a crashed TPU worker poisons the whole process, "
+                         "so one bad row would otherwise cost every row "
+                         "after it; the skipped row is captured separately "
+                         "via --only.  The skip is visible in the "
+                         "artifact's argv.")
     args = ap.parse_args(argv)
+    if args.skip and not args.all:
+        ap.error("--skip requires --all")
 
     # cheap env stamp for the signal/error paths; refreshed with real jax
     # device info once the backend is safely up (the handler itself must never
@@ -588,6 +599,8 @@ def main(argv=None) -> int:
 
     if args.all:
         for name in _ALL_CONFIGS:
+            if args.skip and name in args.skip:
+                continue
             _watchdog.heartbeat()  # entering a row is forward progress
             try:
                 row = bench_config(name)

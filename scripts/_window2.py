@@ -33,17 +33,34 @@ def main() -> int:
     py = sys.executable
     sdir = os.path.join(REPO, "scripts")
     out = os.path.join(REPO, "bench_runs")
+    # (argv, artifact, timeout_s, env_extra, partial_ok) -- partial_ok only
+    # for experiment matrices whose per-config error rows are results
+    # (tpu_watch's partial_ok rationale); measurement artifacts must be
+    # fully error-free or they re-run next window
     steps = [
         ([py, os.path.join(sdir, "_clustered_bisect.py")],
-         os.path.join(out, "r5_tpu_clustered_bisect.json"), 1200, None),
+         os.path.join(out, "r5_tpu_clustered_bisect.json"), 1200, None,
+         True),
         ([py, os.path.join(sdir, "epilogue_ab.py")],
-         os.path.join(out, "r5_tpu_epilogue_ab.json"), 900, None),
+         os.path.join(out, "r5_tpu_epilogue_ab.json"), 900, None, True),
+        # the north star again, now on the row-major epilogue
+        ([py, os.path.join(REPO, "bench.py")],
+         os.path.join(out, "r5_tpu_north_star_rowmajor.json"), 900, None,
+         False),
+        # full row set with the worker-killing clustered row quarantined
+        # (it gets its own --only artifact below); includes the on-chip
+        # sharded 10M attempt
+        ([py, os.path.join(REPO, "bench.py"), "--all",
+          "--skip", "clustered_300k_adaptive"],
+         os.path.join(out, "r5_tpu_all_rows_v2.json"), 2400,
+         {"BENCH_STALL_TIMEOUT_S": "600"}, False),
         ([py, os.path.join(REPO, "bench.py"), "--only",
           "clustered_300k_adaptive"],
          os.path.join(out, "r5_tpu_clustered_50k.json"), 900,
-         {"BENCH_CLUSTERED_N": "50000"}),
+         {"BENCH_CLUSTERED_N": "50000"}, False),
     ]
     bisect_path = steps[0][1]
+    partial = {p: po for _, p, _, _, po in steps}
 
     def _done(path: str) -> bool:
         # the bisect's last-line-before-death IS the result even on rc!=0
@@ -57,7 +74,7 @@ def main() -> int:
                     return bool(json.load(f).get("lines"))
             except (OSError, ValueError):
                 return False
-        return _artifact_good(path, allow_partial=True)
+        return _artifact_good(path, allow_partial=partial[path])
 
     attempt = 0
     while time.time() < deadline:
@@ -68,7 +85,7 @@ def main() -> int:
               f"({time.time() - t0:.0f}s)", flush=True)
         if platform and platform != "cpu":
             ran = False
-            for argv_i, path_i, timeout_i, env_i in steps:
+            for argv_i, path_i, timeout_i, env_i, partial_i in steps:
                 if _done(path_i):
                     continue
                 if ran:
@@ -78,9 +95,9 @@ def main() -> int:
                               flush=True)
                         break
                 run_and_record(argv_i, path_i, timeout_s=timeout_i,
-                               env_extra=env_i, allow_partial=True)
+                               env_extra=env_i, allow_partial=partial_i)
                 ran = True
-            if all(_done(p) for _, p, _, _ in steps):
+            if all(_done(p) for _, p, _, _, _ in steps):
                 print("[window2] all captured", flush=True)
                 return 0
         time.sleep(max(0.0, min(90.0, deadline - time.time())))

@@ -206,3 +206,33 @@ def test_enable_compile_cache_env_override_wins(monkeypatch, tmp_path):
     assert path == os.path.join(REPO, ".jax_cache")
     assert os.environ["JAX_COMPILATION_CACHE_DIR"] == path
     assert jax.config.jax_compilation_cache_dir == path
+
+
+def test_all_skip_quarantines_row():
+    """--all --skip leaves the named configs out (worker-crash quarantine:
+    one faulting row must not cost every row after it) and --skip without
+    --all is an argparse error.  Drives the real --all loop with every
+    config skipped and a tiny north star, so an inverted skip predicate
+    would print config rows and fail the assertion."""
+    import bench
+
+    assert "clustered_300k_adaptive" in bench._ALL_CONFIGS
+    r = subprocess.run(
+        [sys.executable, BENCH, "--skip", "clustered_300k_adaptive"],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 2 and "--skip requires --all" in r.stderr
+
+    argv = [sys.executable, BENCH, "--all"]
+    for name in bench._ALL_CONFIGS:
+        argv += ["--skip", name]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_NORTH_N="2000",
+               BENCH_ORACLE_SAMPLE="500", BENCH_BRUTE_SAMPLE="300")
+    r = subprocess.run(argv, capture_output=True, text=True, timeout=300,
+                       env=env)
+    rows = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert not any("config" in row for row in rows), rows  # all skipped
+    assert any(row.get("metric", "").startswith("queries/sec/chip")
+               for row in rows)  # the north star still lands
