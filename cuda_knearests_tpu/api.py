@@ -172,8 +172,13 @@ class KnnProblem:
 
     def _resolve_uncertified(self, res: KnnResult) -> KnnResult:
         # Scalar readback first: certification is ~always total, so the common
-        # path costs an 8-byte transfer, not the full (n,) mask.
-        if int(jax.device_get(jax.numpy.sum(~res.certified))) == 0:
+        # path costs an 8-byte transfer, not the full (n,) mask.  The solve
+        # programs compute the count in-program (KnnResult.uncert_count), so
+        # the common path is ONE readback with no eager device dispatches --
+        # each eager op is a round trip on remote-tunnel backends.
+        cnt = (res.uncert_count if res.uncert_count is not None
+               else jax.numpy.sum(~res.certified))
+        if int(jax.device_get(cnt)) == 0:
             return res
         cert = from_device(res.certified)
         bad = np.nonzero(~cert)[0].astype(np.int32)

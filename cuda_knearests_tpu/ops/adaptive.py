@@ -632,7 +632,7 @@ def _solve_adaptive(points: jax.Array, starts: jax.Array, counts: jax.Array,
     hi = jnp.take(jnp.concatenate(his, axis=0), plan.inv_box, axis=0)
     cert = raw_kth <= _margin_sq(points[:, None, :], lo, hi,
                                  domain)[:, 0]
-    return row_i, row_d, cert
+    return row_i, row_d, cert, jnp.sum(~cert, dtype=jnp.int32)
 
 
 def solve_adaptive(grid: GridHash, cfg: KnnConfig,
@@ -642,11 +642,12 @@ def solve_adaptive(grid: GridHash, cfg: KnnConfig,
     exact fallback)."""
     if plan is None:
         plan = build_adaptive_plan(grid, cfg)
-    nbr, d2, cert = _solve_adaptive(
+    nbr, d2, cert, n_unc = _solve_adaptive(
         grid.points, grid.cell_starts, grid.cell_counts, plan, cfg.k,
         cfg.exclude_self, grid.domain, cfg.interpret, cfg.stream_tile,
         cfg.effective_kernel())
-    return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert)
+    return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
+                     uncert_count=n_unc)
 
 
 # -- external queries through the class schedule ------------------------------

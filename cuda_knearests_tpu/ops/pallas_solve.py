@@ -482,7 +482,7 @@ def _solve_packed(pack: PallasPack, points: jax.Array, k: int,
     lo = jnp.take(pack.lo, pack.inv_sc, axis=0)            # (n, 3)
     hi = jnp.take(pack.hi, pack.inv_sc, axis=0)
     cert = raw_kth <= _margin_sq(points[:, None, :], lo, hi, domain)[:, 0]
-    return row_i, row_d, cert
+    return row_i, row_d, cert, jnp.sum(~cert, dtype=jnp.int32)
 
 
 def solve_pallas(grid: GridHash, cfg, plan: SolvePlan | None = None,
@@ -501,8 +501,9 @@ def solve_pallas(grid: GridHash, cfg, plan: SolvePlan | None = None,
         pack = build_pack(grid.points, grid.cell_starts, grid.cell_counts, plan)
     from ..config import resolve_kernel
 
-    nbr, d2, cert = _solve_packed(pack, grid.points, cfg.k, cfg.exclude_self,
-                                  grid.domain, cfg.interpret,
-                                  resolve_kernel(cfg.effective_kernel(),
-                                                 cfg.k, pack.ccap))
-    return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert)
+    nbr, d2, cert, n_unc = _solve_packed(
+        pack, grid.points, cfg.k, cfg.exclude_self, grid.domain,
+        cfg.interpret, resolve_kernel(cfg.effective_kernel(), cfg.k,
+                                      pack.ccap))
+    return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
+                     uncert_count=n_unc)

@@ -76,6 +76,12 @@ class KnnResult:
     neighbors: np.ndarray | jax.Array  # (n, k) i32, ascending by distance
     dists_sq: np.ndarray | jax.Array   # (n, k) f32
     certified: np.ndarray | jax.Array  # (n,) bool
+    # 0-d i32 count of uncertified rows, computed INSIDE the solve program
+    # when the producing path supports it: the fallback dispatch then costs
+    # one scalar readback instead of two eager device ops + readback (each
+    # eager dispatch is a round trip on remote-tunnel backends).  None =
+    # caller computes it (oracle/fallback-constructed results).
+    uncert_count: np.ndarray | jax.Array | None = None
 
 
 def _boxes_grid(n_sc: int) -> np.ndarray:
@@ -293,7 +299,7 @@ def _solve_planned(points: jax.Array, starts: jax.Array, counts: jax.Array,
     (out_d, out_i, out_cert), _ = jax.lax.scan(
         step, (out_d, out_i, out_cert),
         (plan.own_cells, plan.cand_cells, plan.box_lo, plan.box_hi))
-    return out_i, out_d, out_cert
+    return out_i, out_d, out_cert, jnp.sum(~out_cert, dtype=jnp.int32)
 
 
 def pick_backend(cfg: KnnConfig, qcap: int, ccap: int) -> str:
@@ -351,10 +357,11 @@ def solve(grid: GridHash, cfg: KnnConfig, plan: SolvePlan | None = None,
         from .pallas_solve import solve_pallas  # local import: avoid cycle
 
         return solve_pallas(grid, cfg, plan, pack)
-    nbr, d2, cert = _solve_planned(grid.points, grid.cell_starts, grid.cell_counts,
-                                   plan, cfg.k, cfg.dist_method, cfg.exclude_self,
-                                   grid.domain)
-    return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert)
+    nbr, d2, cert, n_unc = _solve_planned(
+        grid.points, grid.cell_starts, grid.cell_counts, plan, cfg.k,
+        cfg.dist_method, cfg.exclude_self, grid.domain)
+    return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
+                     uncert_count=n_unc)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "exclude_self", "tile"))
