@@ -705,19 +705,20 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
         out_d, out_i = _pallas_topk(qxq, qyq, qzq, cx, cy, cz, qid3, cid3,
                                     q2cap, cp.ccap, k, False, interpret,
                                     resolve_kernel(kernel, k, cp.ccap))
-        # gather straight from the raw (Sc, k, q2cap) layout (no transpose):
-        # query at (row, rank) reads elem row*k*q2cap + i*q2cap + rank
-        if cp.n_sc * k * q2cap > 2**31 - 1:
+        # transpose the raw (Sc, k, q2cap) kernel layout to row-major and
+        # gather whole rows -- same pattern as the self-solve epilogue
+        # (_rows2d): element gathers of m*k strided indices lose to one
+        # vectorized transpose + a contiguous row gather
+        if cp.n_sc * q2cap > 2**31 - 1:
             # ValueError, not assert: under `python -O` a wrapped int32
             # index would gather wrong-yet-certified neighbors
             raise ValueError(
-                "raw query output exceeds int32 indexing; reduce the query "
-                "batch or k")
-        base = (inv // q2cap) * (k * q2cap) + inv % q2cap
-        qidx = (base[:, None]
-                + jnp.arange(k, dtype=jnp.int32)[None, :] * q2cap)
-        row_d = jnp.take(out_d.reshape(-1), qidx)            # (m_c, k)
-        row_i = jnp.take(out_i.reshape(-1), qidx)
+                "query output exceeds int32 row indexing; reduce the query "
+                "batch")
+        rows_d = jnp.swapaxes(out_d, 1, 2).reshape(-1, k)    # (Sc*q2cap, k)
+        rows_i = jnp.swapaxes(out_i, 1, 2).reshape(-1, k)
+        row_d = jnp.take(rows_d, inv, axis=0)                # (m_c, k)
+        row_i = jnp.take(rows_i, inv, axis=0)
     elif route == "dense":
         q = jnp.take(qsorted, safe_qs, axis=0)
         flat_d, flat_i = _dense_query_topk(points, starts, counts, cp.cand,
