@@ -58,6 +58,9 @@ def main() -> int:
           "clustered_300k_adaptive"],
          os.path.join(out, "r5_tpu_clustered_50k.json"), 900,
          {"BENCH_CLUSTERED_N": "50000"}, False),
+        # real-hardware (non-interpret) blocked==kpass exactness pass
+        ([py, os.path.join(sdir, "blocked_exactness.py")],
+         os.path.join(out, "r5_tpu_blocked_exact.json"), 900, None, False),
     ]
     bisect_path = steps[0][1]
     partial = {p: po for _, p, _, _, po in steps}

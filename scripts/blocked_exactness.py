@@ -1,0 +1,68 @@
+"""Real-hardware (non-interpret) exactness pass for the blocked kernel
+(VERDICT r4 next #2's remaining sub-item).
+
+The blocked kernel's equality with kpass was pinned in interpret mode only
+(tests/conftest.py hard-pins the suite to the emulated CPU mesh, by design);
+this script runs the same differential on the live chip: explicit
+kernel='blocked' vs 'kpass' end-to-end on a blue-noise and a clustered
+fixture, neighbors/distances must match exactly and both must be fully
+certified after fallback.  One JSON line per (fixture, k).
+
+Run on a healthy accelerator:  python scripts/blocked_exactness.py
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # PYTHONPATH breaks axon plugin discovery
+
+import jax
+import numpy as np
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.io import generate_blue_noise, generate_clustered
+from cuda_knearests_tpu.utils import watchdog
+from cuda_knearests_tpu.utils.platform import enable_compile_cache
+
+enable_compile_cache()
+
+
+def main() -> int:
+    plat = jax.devices()[0].platform
+    rc = 0
+    for name, pts in (("blue_15k", generate_blue_noise(15_000, seed=7)),
+                      ("clustered_20k", generate_clustered(20_000, seed=5))):
+        for k in (10, 20):
+            row = {"config": f"blocked-vs-kpass {name} k={k}",
+                   "platform": plat}
+            try:
+                outs = {}
+                for kern in ("kpass", "blocked"):
+                    p = KnnProblem.prepare(pts, KnnConfig(k=k, kernel=kern))
+                    res = p.solve()
+                    watchdog.heartbeat()
+                    outs[kern] = (p.get_knearests_original(),
+                                  np.asarray(jax.device_get(res.dists_sq)),
+                                  float(np.asarray(res.certified).mean()))
+                ids_eq = bool(np.array_equal(outs["kpass"][0],
+                                             outs["blocked"][0]))
+                d2_eq = bool(np.array_equal(outs["kpass"][1],
+                                            outs["blocked"][1]))
+                row.update(ids_equal=ids_eq, dists_equal=d2_eq,
+                           certified_kpass=outs["kpass"][2],
+                           certified_blocked=outs["blocked"][2],
+                           n_points=int(pts.shape[0]))
+                if not (ids_eq and d2_eq and outs["kpass"][2] == 1.0
+                        and outs["blocked"][2] == 1.0):
+                    rc = 1
+            except Exception as e:  # noqa: BLE001 -- every cell must report
+                row["error"] = f"{type(e).__name__}: {e}"
+                rc = 1
+            print(json.dumps(row), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    watchdog.start(tag="blocked_exactness")
+    sys.exit(main())
