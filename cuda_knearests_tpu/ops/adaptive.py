@@ -143,7 +143,7 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
     streaming merge's extra tile copies are not), streaming only tiles past
     the dense byte ceiling.
     """
-    from .pallas_solve import pallas_fits
+    from .pallas_solve import pick_qsub
 
     def cand_at(rows: np.ndarray, radius: int) -> np.ndarray:
         return pts_cum[rows, radius]
@@ -175,7 +175,10 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
         ccap = _round_up(max(int(cand_at(rows, radius).max()), cfg.k), 128)
         qcap_pad = -(-qcap // 128) * 128
         if on_kernel_platform:
-            route = ("pallas" if pallas_fits(qcap_pad, ccap, cfg.k)
+            # oversized query axes no longer demote (pick_qsub grids over
+            # query sub-blocks); only a candidate axis too wide for VMEM
+            # at a 128-wide query block streams
+            route = ("pallas" if pick_qsub(qcap_pad, ccap, cfg.k)
                      else "streamed")
         else:
             route = ("dense" if qcap * ccap * 4 <= _DENSE_TILE_BYTES
@@ -764,7 +767,7 @@ def launch_class_query(points, starts, counts, cp: ClassPlan,
     the flat-slot inverse.  Returns (order, r_i, r_d, r_c): ``order`` sorts
     ``queries_sel`` row-major; the device results are in that order.
     """
-    from .pallas_solve import pallas_fits
+    from .pallas_solve import pick_qsub
 
     order = np.argsort(rows_sel, kind="stable")
     rows_sorted = rows_sel[order]
@@ -776,7 +779,7 @@ def launch_class_query(points, starts, counts, cp: ClassPlan,
     # (bounds recompiles across query sets)
     q2cap_pal = -(-max_q // 128) * 128
     route = cp.route
-    if route == "pallas" and not pallas_fits(q2cap_pal, cp.ccap, k):
+    if route == "pallas" and not pick_qsub(q2cap_pal, cp.ccap, k):
         route = "streamed"
     q2cap = (q2cap_pal if route == "pallas"
              else 1 << max(3, (max_q - 1).bit_length()))

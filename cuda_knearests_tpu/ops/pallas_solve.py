@@ -46,8 +46,14 @@ _PAD_C = -3
 
 _BIG_ID = 2**31 - 1
 
-# Conservative per-program VMEM budget (bytes) for choosing this path.
-_VMEM_BUDGET = 12 * 1024 * 1024
+# Per-program VMEM budget (bytes) for choosing this path.  v5e has 128 MiB
+# of VMEM; 32 MiB leaves headroom for Mosaic's own double-buffering and was
+# validated by the round-5 on-chip A/B running ccap-10368 tiles (~24 MB by
+# this estimate) cleanly.  Oversized QUERY axes no longer disqualify the
+# kernel at all -- pick_qsub splits the query block across grid steps while
+# the candidate block stays resident -- so this budget effectively gates on
+# the candidate-axis footprint.
+_VMEM_BUDGET = 32 * 1024 * 1024
 
 # k above which the extraction loop is rolled (fori_loop) instead of unrolled.
 _UNROLL_K_MAX = 64
@@ -305,17 +311,56 @@ def pallas_fits(qcap: int, ccap: int, k: int) -> bool:
     return vmem_bytes_estimate(qcap, ccap, k) <= _VMEM_BUDGET
 
 
+def pick_qsub(qcap: int, ccap: int, k: int) -> int:
+    """Largest per-grid-step query-block width for a (qcap, ccap) class.
+
+    Returns qcap itself when the full tile fits VMEM; otherwise the widest
+    128-multiple divisor of the 128-rounded qcap whose (qsub, ccap) tile
+    fits (the kernel then grids over query sub-blocks while the candidate
+    block stays resident -- see _pallas_topk); 0 when even a 128-wide query
+    block does not fit, i.e. the CANDIDATE axis alone blows the budget and
+    the class must stream.  This is what routes dense-blob classes (huge
+    qcap from thousands of coincident queries) onto the kernel instead of
+    the streamed scan."""
+    qcap = -(-qcap // 128) * 128
+    lanes = qcap // 128
+    best = 0
+    for d in range(1, lanes + 1):
+        if lanes % d:
+            continue
+        qsub = 128 * d
+        if pallas_fits(qsub, ccap, k):
+            best = qsub
+    return best
+
+
 def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
                  k: int, exclude_self: bool, interpret: bool,
                  kernel: str = "kpass"):
-    """Launch the kernel over a flat supercell grid.  Returns ((S,k,Q) dists,
-    (S,k,Q) ids) -- raw, untransposed.  ``kernel`` picks the extraction
-    strategy ('kpass' | 'blocked', see config.KnnConfig.kernel); ineligible
-    blocked shapes silently take the kpass body."""
+    """Launch the kernel over a (supercell, query-sub-block) grid.  Returns
+    ((S,k,Q) dists, (S,k,Q) ids) -- raw, untransposed.  ``kernel`` picks the
+    extraction strategy ('kpass' | 'blocked', see config.KnnConfig.kernel);
+    ineligible blocked shapes silently take the kpass body.
+
+    When the full (qcap, ccap) tile exceeds the VMEM budget the query axis
+    splits into qcap/qsub grid steps (pick_qsub): the candidate blocks'
+    index map is constant over the inner axis, so Pallas keeps them
+    resident across the sub-steps and only the (1, 1, qsub) query/output
+    blocks move -- dense-blob classes (huge qcap) run on the kernel with no
+    candidate re-fetch instead of demoting to the streamed scan."""
     from ..config import blocked_topm
 
     s_total = qx.shape[0]
+    qsub = pick_qsub(qcap, ccap, k)
+    if qsub in (0, qcap):
+        qsub = qcap  # ungated call (explicit backend='pallas'): full tile
+    n_q = qcap // qsub
     m = blocked_topm(k, ccap) if kernel == "blocked" else 0
+    if m and n_q > 1:
+        # the blocked body's VMEM survivor-pool scratch is sized by the full
+        # qcap; blocked shapes are only eligible un-split (it is explicit-
+        # request-only anyway -- config.resolve_kernel)
+        m = 0
     scratch_shapes = []
     if m:
         body = functools.partial(_kernel_blocked, k=k, m=m,
@@ -326,7 +371,7 @@ def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
         g = ccap // 128
         cx, cy, cz = (a.reshape(s_total, g, 128) for a in (cx, cy, cz))
         cid3 = cid3.reshape(s_total, g, 128)
-        c_spec = pl.BlockSpec((1, g, 128), lambda b: (b, 0, 0),
+        c_spec = pl.BlockSpec((1, g, 128), lambda b, j: (b, 0, 0),
                               memory_space=pltpu.VMEM)
         # VMEM survivor pool for the rolled stage-1 path (unused but cheap
         # -- tens of KB -- when the unrolled path keeps it in registers)
@@ -335,31 +380,18 @@ def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
                           pltpu.VMEM((g, qcap), jnp.float32)]
     else:
         body = functools.partial(_kernel, k=k, exclude_self=exclude_self)
-        c_spec = pl.BlockSpec((1, 1, ccap), lambda b: (b, 0, 0),
+        c_spec = pl.BlockSpec((1, 1, ccap), lambda b, j: (b, 0, 0),
                               memory_space=pltpu.VMEM)
+    q_spec = pl.BlockSpec((1, 1, qsub), lambda b, j: (b, 0, j),
+                          memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec((1, k, qsub), lambda b, j: (b, 0, j),
+                            memory_space=pltpu.VMEM)
     return pl.pallas_call(
         body,
-        grid=(s_total,),
-        in_specs=[
-            pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            c_spec,
-            c_spec,
-            c_spec,
-            pl.BlockSpec((1, 1, qcap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            c_spec,
-        ],
-        out_specs=[
-            pl.BlockSpec((1, k, qcap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k, qcap), lambda b: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        grid=(s_total, n_q),
+        in_specs=[q_spec, q_spec, q_spec, c_spec, c_spec, c_spec,
+                  q_spec, c_spec],
+        out_specs=[out_spec, out_spec],
         out_shape=[
             jax.ShapeDtypeStruct((s_total, k, qcap), jnp.float32),
             jax.ShapeDtypeStruct((s_total, k, qcap), jnp.int32),
@@ -493,10 +525,11 @@ def solve_pallas(grid: GridHash, cfg, plan: SolvePlan | None = None,
     repeat solves (api.KnnProblem caches one)."""
     if plan is None:
         plan = build_plan(grid, cfg)
-    if not pallas_fits(plan.qcap, plan.ccap, cfg.k):
+    if not pick_qsub(plan.qcap, plan.ccap, cfg.k):
         raise ValueError(
-            f"supercell tile qcap={plan.qcap} x ccap={plan.ccap} exceeds the "
-            f"VMEM budget; use a smaller config.supercell or backend='xla'")
+            f"candidate axis ccap={plan.ccap} exceeds the VMEM budget even "
+            f"at a 128-wide query block; use a smaller config.supercell or "
+            f"backend='xla'")
     if pack is None:
         pack = build_pack(grid.points, grid.cell_starts, grid.cell_counts, plan)
     from ..config import resolve_kernel

@@ -155,9 +155,9 @@ def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
     # per-supercell capacity (clustered queries can exceed the stored-point
     # pack's budget), and backend='xla' configs never take the kernel.  The
     # safe route is exact tiled brute force over all queries.
-    from .pallas_solve import pallas_fits
+    from .pallas_solve import pick_qsub
 
-    use_kernel = pack is not None and pallas_fits(q2cap, pack.ccap, k)
+    use_kernel = pack is not None and pick_qsub(q2cap, pack.ccap, k) > 0
     if use_kernel:
         out_i, out_d, cert = _query_packed(
             qs, jnp.asarray(starts), jnp.asarray(sc_counts),

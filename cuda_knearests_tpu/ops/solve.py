@@ -322,11 +322,11 @@ def pick_backend(cfg: KnnConfig, qcap: int, ccap: int) -> str:
         return cfg.backend
     if cfg.dist_method == "dot":
         return "xla"  # the kernel has no 'dot' arithmetic; honor the request
-    from .pallas_solve import pallas_fits  # local import: avoid cycle
+    from .pallas_solve import pick_qsub  # local import: avoid cycle
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    if (on_tpu or cfg.interpret) and pallas_fits(qcap, ccap, cfg.k):
-        return "pallas"
+    if (on_tpu or cfg.interpret) and pick_qsub(qcap, ccap, cfg.k):
+        return "pallas"  # oversized query axes split across grid steps
     return "xla"
 
 

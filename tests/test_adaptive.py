@@ -129,16 +129,30 @@ def test_interpret_kernel_classes_match_streamed(blue_8k):
                           ps.get_knearests_original())
 
 
-def test_mixed_pallas_and_streamed_classes():
-    """A dense blob forces its class over the VMEM gate (streamed) while the
-    background class stays on the kernel -- the per-class routing that
-    replaces round 1's whole-solve demotion."""
+def test_mixed_pallas_and_streamed_classes(monkeypatch):
+    """A class whose CANDIDATE axis overflows the VMEM budget streams while
+    the background class stays on the kernel -- the per-class routing that
+    replaces round 1's whole-solve demotion.  The budget is shrunk so the
+    blob class's ccap alone overflows it: since pick_qsub landed, an
+    oversized QUERY axis no longer demotes (the kernel grids over query
+    sub-blocks), so only candidate-axis overflow can force streaming."""
+    import jax
+
+    from cuda_knearests_tpu.ops import pallas_solve as ps
+
     rng = np.random.default_rng(5)
     blob = rng.normal((500, 500, 500), 4, (3000, 3))
     bg = rng.uniform(0, 1000, (6000, 3))
     pts = np.clip(np.concatenate([blob, bg]), 0, 1000).astype(np.float32)
-    p = KnnProblem.prepare(pts, KnnConfig(k=10, interpret=True))
-    res = p.solve()
+    # fits a 128x1152 background tile but not the blob's wide candidate axis
+    monkeypatch.setattr(ps, "_VMEM_BUDGET",
+                        ps.vmem_bytes_estimate(128, 2048, 10))
+    jax.clear_caches()
+    try:
+        p = KnnProblem.prepare(pts, KnnConfig(k=10, interpret=True))
+        res = p.solve()
+    finally:
+        jax.clear_caches()  # shrunk-budget traces must not leak
     kinds = {c.use_pallas for c in p.aplan.classes}
     assert kinds == {True, False}, (
         f"expected mixed routing, got {[(c.n_sc, c.qcap_pad, c.ccap, c.use_pallas) for c in p.aplan.classes]}")

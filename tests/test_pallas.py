@@ -203,3 +203,45 @@ def test_blocked_kernel_matches_kpass_large_fixture():
         outs[kern] = p.get_knearests_original()
     np.testing.assert_array_equal(outs["kpass"], outs["blocked"])
 
+
+
+def test_qsplit_matches_full_tile(monkeypatch):
+    """Query-axis grid splitting (pick_qsub) must be invisible in results.
+
+    The clustered fixture's dense class pads to a multi-block qcap that
+    genuinely splits at the DEFAULT budget (asserted: n_q > 1, so the
+    multi-step grid path -- candidate block resident, query/output blocks
+    moving -- is the thing under test, not a vacuous n_q == 1 relaunch).
+    The reference run forces no-split by raising the budget."""
+    import jax
+
+    from cuda_knearests_tpu.io import generate_clustered
+    from cuda_knearests_tpu.ops import pallas_solve as ps
+
+    points = generate_clustered(20000, seed=5)
+    cfg = KnnConfig(k=10, interpret=True)
+    try:
+        # reference: budget high enough that every class runs full-tile
+        monkeypatch.setattr(ps, "_VMEM_BUDGET", 1 << 32)
+        jax.clear_caches()
+        full = KnnProblem.prepare(points, cfg)
+        assert all(ps.pick_qsub(c.qcap_pad, c.ccap, cfg.k) == c.qcap_pad
+                   for c in full.aplan.classes if c.route == "pallas")
+        rf = full.solve()
+
+        # under test: the default budget genuinely splits the dense class
+        monkeypatch.undo()
+        jax.clear_caches()
+        split = KnnProblem.prepare(points, cfg)
+        n_qs = [c.qcap_pad // ps.pick_qsub(c.qcap_pad, c.ccap, cfg.k)
+                for c in split.aplan.classes if c.route == "pallas"]
+        assert any(nq > 1 for nq in n_qs), n_qs
+        rs = split.solve()
+        np.testing.assert_array_equal(np.asarray(rf.neighbors),
+                                      np.asarray(rs.neighbors))
+        np.testing.assert_array_equal(np.asarray(rf.dists_sq),
+                                      np.asarray(rs.dists_sq))
+        np.testing.assert_array_equal(np.asarray(rf.certified),
+                                      np.asarray(rs.certified))
+    finally:
+        jax.clear_caches()  # inflated-budget traces must not leak
