@@ -61,6 +61,13 @@ def main() -> int:
         # real-hardware (non-interpret) blocked==kpass exactness pass
         ([py, os.path.join(sdir, "blocked_exactness.py")],
          os.path.join(out, "r5_tpu_blocked_exact.json"), 900, None, False),
+        # full-size clustered attempt LAST: qsplit moved its dense-blob
+        # class off the streamed route (the crash suspect), so this may
+        # now survive -- but a worker crash here must not cost other steps
+        ([py, os.path.join(REPO, "bench.py"), "--only",
+          "clustered_300k_adaptive"],
+         os.path.join(out, "r5_tpu_clustered_300k.json"), 1200, None,
+         False),
     ]
     bisect_path = steps[0][1]
     partial = {p: po for _, p, _, _, po in steps}
