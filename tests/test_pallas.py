@@ -245,3 +245,17 @@ def test_qsplit_matches_full_tile(monkeypatch):
                                       np.asarray(rs.certified))
     finally:
         jax.clear_caches()  # inflated-budget traces must not leak
+
+
+def test_pick_qsub_policy():
+    """Full fit -> qcap; query overflow -> widest fitting 128-divisor;
+    candidate-axis overflow at 128-wide queries -> 0 (stream)."""
+    from cuda_knearests_tpu.ops.pallas_solve import (_VMEM_BUDGET, pick_qsub,
+                                                     vmem_bytes_estimate)
+
+    assert pick_qsub(256, 1152, 10) == 256           # full tile fits
+    got = pick_qsub(14592, 22912, 10)
+    assert got and got < 14592 and 14592 % got == 0  # genuine split
+    assert vmem_bytes_estimate(got, 22912, 10) <= _VMEM_BUDGET
+    assert pick_qsub(128, 1 << 20, 10) == 0          # candidate axis alone
+    assert pick_qsub(100, 1152, 10) == 128           # qcap 128-rounded
