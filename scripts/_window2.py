@@ -1,12 +1,11 @@
 """Second-healthy-window driver for the round-5 session.
 
 Probes the transport like tpu_watch and, when it answers, runs the queued
-diagnostics/experiments in value order, each rc-stamped into bench_runs/:
-
-  1. the clustered-300K class bisect (finds the worker-crash stage)
-  2. the epilogue A/B (the 51.5%-of-solve question)
-  3. an rc-stamped clustered row at 50K (the on-chip adaptive-vs-global
-     record; 300K crashes the worker -- that is what the bisect is for)
+captures in value-per-minute order (see the steps list), each rc-stamped
+into bench_runs/: the row-major-epilogue north star, the epilogue A/B, the
+clustered rows (50K then the full 300K that used to crash the worker), the
+quarantined --all row set, the hardware blocked==kpass exactness pass, and
+the class bisect.
 
 Run:  python scripts/_window2.py
 """
@@ -34,42 +33,46 @@ def main() -> int:
     sdir = os.path.join(REPO, "scripts")
     out = os.path.join(REPO, "bench_runs")
     # (argv, artifact, timeout_s, env_extra, partial_ok) -- partial_ok only
-    # for experiment matrices whose per-config error rows are results
-    # (tpu_watch's partial_ok rationale); measurement artifacts must be
-    # fully error-free or they re-run next window
+    # for experiment matrices whose per-config error rows are results;
+    # measurement artifacts must be fully error-free or they re-run next
+    # window.  Ordered by
+    # value-per-minute for a SHORT window: the row-major-epilogue north star
+    # (the round's headline) first, experiment matrices next, the
+    # worker-crash-prone clustered attempts and the bisect LAST so a crash
+    # or a long diagnostic cannot cost the cheap high-value captures.
     steps = [
-        ([py, os.path.join(sdir, "_clustered_bisect.py")],
-         os.path.join(out, "r5_tpu_clustered_bisect.json"), 1200, None,
-         True),
-        ([py, os.path.join(sdir, "epilogue_ab.py")],
-         os.path.join(out, "r5_tpu_epilogue_ab.json"), 900, None, True),
-        # the north star again, now on the row-major epilogue
         ([py, os.path.join(REPO, "bench.py")],
          os.path.join(out, "r5_tpu_north_star_rowmajor.json"), 900, None,
          False),
-        # full row set with the worker-killing clustered row quarantined
-        # (it gets its own --only artifact below); includes the on-chip
-        # sharded 10M attempt
-        ([py, os.path.join(REPO, "bench.py"), "--all",
-          "--skip", "clustered_300k_adaptive"],
-         os.path.join(out, "r5_tpu_all_rows_v2.json"), 2400,
-         {"BENCH_STALL_TIMEOUT_S": "600"}, False),
+        ([py, os.path.join(sdir, "epilogue_ab.py")],
+         os.path.join(out, "r5_tpu_epilogue_ab.json"), 900, None, True),
         ([py, os.path.join(REPO, "bench.py"), "--only",
           "clustered_300k_adaptive"],
          os.path.join(out, "r5_tpu_clustered_50k.json"), 900,
          {"BENCH_CLUSTERED_N": "50000"}, False),
+        # full row set with the worker-killing clustered row quarantined;
+        # includes the on-chip sharded 10M attempt
+        ([py, os.path.join(REPO, "bench.py"), "--all",
+          "--skip", "clustered_300k_adaptive"],
+         os.path.join(out, "r5_tpu_all_rows_v2.json"), 2400,
+         {"BENCH_STALL_TIMEOUT_S": "600"}, False),
         # real-hardware (non-interpret) blocked==kpass exactness pass
         ([py, os.path.join(sdir, "blocked_exactness.py")],
          os.path.join(out, "r5_tpu_blocked_exact.json"), 900, None, False),
-        # full-size clustered attempt LAST: qsplit moved its dense-blob
-        # class off the streamed route (the crash suspect), so this may
-        # now survive -- but a worker crash here must not cost other steps
+        # full-size clustered attempt: qsplit moved its dense-blob class
+        # off the streamed route (the crash suspect), so this may now
+        # survive -- run late so a worker crash cannot cost other steps
         ([py, os.path.join(REPO, "bench.py"), "--only",
           "clustered_300k_adaptive"],
          os.path.join(out, "r5_tpu_clustered_300k.json"), 1200, None,
          False),
+        # the class bisect is archaeology if the row above now passes;
+        # it crashes the worker when the fault persists, so it goes last
+        ([py, os.path.join(sdir, "_clustered_bisect.py")],
+         os.path.join(out, "r5_tpu_clustered_bisect.json"), 1200, None,
+         True),
     ]
-    bisect_path = steps[0][1]
+    bisect_path = steps[-1][1]
     partial = {p: po for _, p, _, _, po in steps}
 
     def _done(path: str) -> bool:
