@@ -243,7 +243,8 @@ class KnnProblem:
                      or jax.devices()[0].platform == "cpu")
         return query_knn(self.grid, self.plan, pack, queries, k,
                          self.config.supercell, interpret,
-                         self.config.fallback)
+                         self.config.fallback,
+                         self.config.resolved_epilogue())
 
     def query_radius(self, queries, radius: float,
                      max_neighbors: int | None = None):

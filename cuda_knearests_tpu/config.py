@@ -105,6 +105,24 @@ class KnnConfig:
       max_classes: cap on adaptive capacity classes (one compiled launch each).
       stream_tile: candidate-axis tile of the streamed (non-kernel) class
         solver; bounds its peak memory independently of ccap.
+      epilogue: how raw per-class solver outputs become the final per-query
+        (n, k) rows.  'gather' = the round-5 path: per-class transpose of the
+        raw (Sc, k, qcap) kernel layout to row-major, one concatenation, one
+        contiguous per-point row gather (AdaptivePlan.inv_row).  'scatter' =
+        the kernel itself emits row-major (qsub, k) blocks at data-dependent
+        output offsets (scalar-prefetched block maps,
+        pallas_solve._pallas_topk_rows), and each class's rows scatter
+        straight into the preallocated final buffer through its prepare-time
+        forward row map (ClassPlan.tgt) -- no transpose pass, no row-major
+        concatenation, no separate gather program; the epilogue stops
+        existing as a standalone phase (DESIGN.md section 2c; the r5 phase
+        table put the standalone epilogue at 51.5% of the on-chip solve).
+        'auto' = scatter on kernel platforms (TPU / interpret, where the
+        scalar-prefetch kernel runs), gather elsewhere -- the host routes
+        keep the round-5 measured path unless scatter is requested
+        explicitly.  Both modes are byte-identical by differential test
+        (tests/test_epilogue.py); resolve through resolved_epilogue(),
+        never the raw field.
       kernel: top-k extraction strategy inside the Pallas kernel.  'kpass' =
         k min-and-mask sweeps of the full (Q, C) distance tile (the
         shared-memory-heap analog, knearests.cu:127-133).  'blocked' =
@@ -136,6 +154,7 @@ class KnnConfig:
     max_classes: int = 4
     stream_tile: int = 2048
     kernel: str = "kpass"  # solvers read effective_kernel(), not this field
+    epilogue: str = "auto"  # solvers read resolved_epilogue(), not this field
 
     def resolved_ring_radius(self) -> int:
         if self.ring_radius is not None:
@@ -154,6 +173,39 @@ class KnnConfig:
         if self.fallback == "none" and self.kernel in ("blocked", "auto"):
             return "kpass"
         return self.kernel
+
+    def resolved_epilogue(self) -> str:
+        """resolve_epilogue() against THIS process's default backend: every
+        solver call site reads this, never the raw ``epilogue`` field, so
+        the kernel-platform predicate (TPU, or interpret mode standing in
+        for one) lives in exactly one place -- same single-source rule as
+        effective_kernel()."""
+        import jax  # deferred: config must import without a backend
+
+        on_kernel = jax.devices()[0].platform == "tpu" or self.interpret
+        return resolve_epilogue(self.epilogue, on_kernel)
+
+
+def resolve_epilogue(epilogue: str, on_kernel_platform: bool) -> str:
+    """'auto' -> 'scatter' on kernel platforms, 'gather' elsewhere.
+
+    Kernel platforms (TPU, or interpret mode standing in for one) run the
+    scalar-prefetch row-major kernel (pallas_solve._pallas_topk_rows), so the
+    per-class transpose + row-major concat + row gather of the gather
+    epilogue collapse into the kernel launch plus one forward-map scatter --
+    the r5 phase table charged the standalone epilogue 51.5% of the on-chip
+    solve (bench_runs/r5_tpu_phases.json).  Host platforms default to the
+    measured round-5 gather path (dense/streamed solvers already emit
+    row-major rows there, so scatter only swaps the final gather for an XLA
+    scatter -- available explicitly, not assumed faster).  Both modes are
+    byte-identical by differential test."""
+    if epilogue not in ("auto", "scatter", "gather"):
+        raise ValueError(
+            f"unknown epilogue {epilogue!r}: expected 'auto', 'scatter' or "
+            f"'gather'")  # a typo must not silently benchmark the wrong path
+    if epilogue == "auto":
+        return "scatter" if on_kernel_platform else "gather"
+    return epilogue
 
 
 def blocked_topm(k: int, ccap: int) -> int:

@@ -192,7 +192,7 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=("own", "cand", "lo", "hi", "pk"),
+    data_fields=("own", "cand", "lo", "hi", "pk", "tgt"),
     meta_fields=("radius", "qcap", "qcap_pad", "ccap", "route"),
 )
 @dataclasses.dataclass(frozen=True)
@@ -207,7 +207,15 @@ class ClassPlan:
     in-solve re-pack cost the adaptive path 3.3x (708 ms vs 215 ms on the
     900k north star).  None = pack in-solve (dense/streamed routes; the
     sharded engine prepacks per chip in _chip_ready_state against the
-    halo-extended arrays)."""
+    halo-extended arrays).
+
+    ``tgt`` is the class's FORWARD row map for the scatter epilogue
+    (config.epilogue): (Sc * qcap_pad,) i32 destination row in the final
+    output per slot (sentinel = one-past-the-end, dropped by the scatter) --
+    the inverse of this class's stretch of AdaptivePlan.inv_row, built by
+    the same _class_inverse_update pass at prepare time.  None only on
+    plans that predate the scatter epilogue (gather mode needs no forward
+    map)."""
 
     own: jax.Array    # (Sc, s^3) i32, -1 pad
     cand: jax.Array   # (Sc, (s+2*radius)^3) i32, -1 pad
@@ -219,6 +227,7 @@ class ClassPlan:
     ccap: int
     route: str        # 'pallas' | 'dense' | 'streamed'
     pk: "ClassPack | None" = None
+    tgt: "jax.Array | None" = None
 
     @property
     def use_pallas(self) -> bool:
@@ -318,8 +327,10 @@ def build_adaptive_plan(grid: GridHash, cfg: KnnConfig,
                 cp.own, cp.cand, cp.qcap_pad, cp.ccap))
         classes.append(cp)
 
-    inv_row, inv_box = _invert_partition(
+    inv_row, inv_box, tgts = _invert_partition(
         tuple(classes), grid.cell_starts, grid.cell_counts, grid.n_points)
+    classes = [dataclasses.replace(cp, tgt=t)
+               for cp, t in zip(classes, tgts)]
     return AdaptivePlan(classes=tuple(classes), inv_row=inv_row,
                         inv_box=inv_box,
                         class_of_sc=jnp.asarray(class_of),
@@ -350,7 +361,10 @@ def _class_inverse_update(inv_row, inv_box, cp: ClassPlan,
     (Sc*qcap, k)) is handled by `_rows2d`'s per-class transpose in the
     epilogue instead of being encoded into element strides here (see
     AdaptivePlan.inv_row for the measured reason).  Returns the updated
-    arrays plus the advanced (row_off, box_off).
+    arrays, the advanced (row_off, box_off), and the class's FORWARD map
+    ``tgt`` (slot -> destination row, ``sentinel`` where the slot is pad)
+    for the scatter epilogue -- the same pack_cells pass feeds both
+    directions, so the two maps cannot drift apart.
     """
     q_idx, q_ok = pack_cells(cp.own, starts, counts, cp.qcap_pad)
     qcap = cp.qcap_pad
@@ -361,6 +375,7 @@ def _class_inverse_update(inv_row, inv_box, cp: ClassPlan,
     safe = jnp.where(q_ok, q_idx, sentinel)
     inv_row = inv_row.at[safe].set(row_off + rows * qcap + lane, mode="drop")
     inv_box = inv_box.at[safe].set(box_off + rows, mode="drop")
+    tgt = safe.reshape(-1).astype(jnp.int32)
     row_off += cp.n_sc * qcap
     box_off += cp.n_sc
     # past the int32 ceiling jnp.take's clip mode would return silently
@@ -370,7 +385,7 @@ def _class_inverse_update(inv_row, inv_box, cp: ClassPlan,
         raise ValueError(
             f"solver output exceeds int32 row indexing "
             f"({row_off} rows): shard the problem")
-    return inv_row, inv_box, row_off, box_off
+    return inv_row, inv_box, row_off, box_off, tgt
 
 
 def _rows2d(flats_d, flats_i, classes, k: int):
@@ -395,16 +410,19 @@ def _rows2d(flats_d, flats_i, classes, k: int):
 def _invert_partition(classes: Tuple[ClassPlan, ...], starts: jax.Array,
                       counts: jax.Array, n: int):
     """One prepare-time scatter: stored point -> (output row, supercell
-    row).  See AdaptivePlan.inv_row."""
+    row), plus the per-class forward maps for the scatter epilogue.  See
+    AdaptivePlan.inv_row and ClassPlan.tgt."""
     inv_row = jnp.zeros((n,), jnp.int32)
     inv_box = jnp.zeros((n,), jnp.int32)
     row_off = 0
     box_off = 0
+    tgts = []
     for cp in classes:
-        inv_row, inv_box, row_off, box_off = (
+        inv_row, inv_box, row_off, box_off, tgt = (
             _class_inverse_update(inv_row, inv_box, cp,
                                   starts, counts, n, row_off, box_off))
-    return inv_row, inv_box
+        tgts.append(tgt)
+    return inv_row, inv_box, tuple(tgts)
 
 
 def _streamed_topk(points: jax.Array, starts: jax.Array, counts: jax.Array,
@@ -575,12 +593,12 @@ def _class_flat(points: jax.Array, starts: jax.Array, counts: jax.Array,
     return fd.reshape(-1), fi.reshape(-1)
 
 
-def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
-                  cp: ClassPlan, k: int, exclude_self: bool, interpret: bool,
-                  kernel: str = "kpass"):
-    """Fused-kernel class solver (the hot route).  Returns (Sc * qcap_pad, k)
-    flat dists/ids, ascending -- same layout contract as _streamed_class."""
-    from .pallas_solve import _pack_inputs, _pallas_topk
+def _class_kernel_inputs(points: jax.Array, starts: jax.Array,
+                         counts: jax.Array, cp: ClassPlan):
+    """One class's kernel input blocks: the prepacked ClassPack when the
+    plan carries one, else an in-solve _pack_inputs pass.  Shared by the
+    gather- and row-major (scatter-epilogue) launches."""
+    from .pallas_solve import _pack_inputs
 
     if cp.pk is not None:
         pk = cp.pk
@@ -592,11 +610,21 @@ def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                 f"ClassPack/plan mismatch: pk blocks {pk.cx.shape} vs plan "
                 f"(n_sc={cp.n_sc}, ccap={cp.ccap}); was this plan built "
                 f"against a different grid?")
-        qx, qy, qz, cx, cy, cz = pk.qx, pk.qy, pk.qz, pk.cx, pk.cy, pk.cz
-        qid3, cid3 = pk.qid3, pk.cid3
-    else:
-        _, _, qx, qy, qz, cx, cy, cz, qid3, cid3 = _pack_inputs(
-            points, starts, counts, cp.own, cp.cand, cp.qcap_pad, cp.ccap)
+        return (pk.qx, pk.qy, pk.qz, pk.cx, pk.cy, pk.cz, pk.qid3, pk.cid3)
+    _, _, qx, qy, qz, cx, cy, cz, qid3, cid3 = _pack_inputs(
+        points, starts, counts, cp.own, cp.cand, cp.qcap_pad, cp.ccap)
+    return (qx, qy, qz, cx, cy, cz, qid3, cid3)
+
+
+def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                  cp: ClassPlan, k: int, exclude_self: bool, interpret: bool,
+                  kernel: str = "kpass"):
+    """Fused-kernel class solver (the hot route).  Returns (Sc * qcap_pad, k)
+    flat dists/ids, ascending -- same layout contract as _streamed_class."""
+    from .pallas_solve import _pallas_topk
+
+    qx, qy, qz, cx, cy, cz, qid3, cid3 = _class_kernel_inputs(
+        points, starts, counts, cp)
     from ..config import resolve_kernel
 
     out_d, out_i = _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3,
@@ -608,23 +636,81 @@ def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
     return out_d.reshape(-1), out_i.reshape(-1)
 
 
+def _class_rows(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                cp: ClassPlan, k: int, exclude_self: bool, tile: int,
+                interpret: bool, kernel: str = "kpass"):
+    """One class's self-solve as ROW-MAJOR (Sc * qcap_pad, k) dists/ids --
+    the scatter-epilogue twin of _class_flat.  pallas classes go through
+    pallas_solve._topk_rows_or_transpose (the shared eligibility gate:
+    scalar-prefetch row-major kernel when the resolved body is kpass and
+    the row-out tile fits VMEM, gather launch + XLA transpose otherwise --
+    byte-identical either way).  dense/streamed routes already emit
+    row-major rows."""
+    from ..config import resolve_kernel
+    from .pallas_solve import _PAD_Q, _topk_rows_or_transpose
+
+    if cp.route == "pallas":
+        qx, qy, qz, cx, cy, cz, qid3, cid3 = _class_kernel_inputs(
+            points, starts, counts, cp)
+        q_ok = (qid3 != _PAD_Q).reshape(cp.n_sc, cp.qcap_pad)
+        return _topk_rows_or_transpose(
+            qx, qy, qz, cx, cy, cz, qid3, cid3, cp.qcap_pad, cp.ccap, k,
+            exclude_self, interpret, q_ok, resolve_kernel(kernel, k, cp.ccap))
+    fd, fi = _class_flat(points, starts, counts, cp, k, exclude_self, tile,
+                         interpret, kernel)
+    return fd.reshape(-1, k), fi.reshape(-1, k)
+
+
+def _scatter_classes(points: jax.Array, starts: jax.Array, counts: jax.Array,
+                     classes: Tuple[ClassPlan, ...], n_rows: int, k: int,
+                     exclude_self: bool, tile: int, interpret: bool,
+                     kernel: str = "kpass"):
+    """Scatter epilogue: every class's row-major rows land in the final
+    (n_rows, k) buffers through its prepare-time forward map (ClassPlan.tgt,
+    pad slots -> dropped sentinel).  Replaces the gather epilogue's
+    transpose + row-major concatenation + per-point row gather with direct
+    placement -- there is no standalone epilogue program left to time
+    (DESIGN.md section 2c).  Every stored point owns exactly one valid slot,
+    so all n_rows rows are written and the init values never survive;
+    byte-identity with the gather path is pinned by tests/test_epilogue.py.
+    """
+    out_d = jnp.full((n_rows, k), jnp.inf, jnp.float32)
+    out_i = jnp.full((n_rows, k), INVALID_ID, jnp.int32)
+    for cp in classes:
+        if cp.tgt is None:  # pre-scatter plan (no forward map persisted)
+            raise ValueError(
+                "this plan predates the scatter epilogue (ClassPlan.tgt is "
+                "None); rebuild it or use epilogue='gather'")
+        rows_d, rows_i = _class_rows(points, starts, counts, cp, k,
+                                     exclude_self, tile, interpret, kernel)
+        out_d = out_d.at[cp.tgt].set(rows_d, mode="drop")
+        out_i = out_i.at[cp.tgt].set(rows_i, mode="drop")
+    return out_d, out_i
+
+
 @functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
-                                             "interpret", "tile", "kernel"))
+                                             "interpret", "tile", "kernel",
+                                             "epilogue"))
 def _solve_adaptive(points: jax.Array, starts: jax.Array, counts: jax.Array,
                     plan: AdaptivePlan, k: int, exclude_self: bool,
                     domain: float, interpret: bool, tile: int,
-                    kernel: str = "kpass"):
-    flats_d, flats_i, los, his = [], [], [], []
-    for cp in plan.classes:
-        fd, fi = _class_flat(points, starts, counts, cp, k, exclude_self,
-                             tile, interpret, kernel)
-        flats_d.append(fd)
-        flats_i.append(fi)
-        los.append(cp.lo)
-        his.append(cp.hi)
-    all_d, all_i = _rows2d(flats_d, flats_i, plan.classes, k)
-    row_d = jnp.take(all_d, plan.inv_row, axis=0)            # (n, k)
-    row_i = jnp.take(all_i, plan.inv_row, axis=0)
+                    kernel: str = "kpass", epilogue: str = "gather"):
+    los = [cp.lo for cp in plan.classes]
+    his = [cp.hi for cp in plan.classes]
+    if epilogue == "scatter":
+        row_d, row_i = _scatter_classes(
+            points, starts, counts, plan.classes, plan.n_points, k,
+            exclude_self, tile, interpret, kernel)
+    else:
+        flats_d, flats_i = [], []
+        for cp in plan.classes:
+            fd, fi = _class_flat(points, starts, counts, cp, k, exclude_self,
+                                 tile, interpret, kernel)
+            flats_d.append(fd)
+            flats_i.append(fi)
+        all_d, all_i = _rows2d(flats_d, flats_i, plan.classes, k)
+        row_d = jnp.take(all_d, plan.inv_row, axis=0)        # (n, k)
+        row_i = jnp.take(all_i, plan.inv_row, axis=0)
     # raw k-th BEFORE sanitization: blocked-kernel deficit rows carry NaN
     # there, and NaN <= margin is false even for an infinite margin
     raw_kth = row_d[:, k - 1]
@@ -648,7 +734,7 @@ def solve_adaptive(grid: GridHash, cfg: KnnConfig,
     nbr, d2, cert, n_unc = _solve_adaptive(
         grid.points, grid.cell_starts, grid.cell_counts, plan, cfg.k,
         cfg.exclude_self, grid.domain, cfg.interpret, cfg.stream_tile,
-        cfg.effective_kernel())
+        cfg.effective_kernel(), cfg.resolved_epilogue())
     return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
                      uncert_count=n_unc)
 
@@ -657,13 +743,13 @@ def solve_adaptive(grid: GridHash, cfg: KnnConfig,
 
 @functools.partial(jax.jit, static_argnames=("q2cap", "k", "route",
                                              "domain", "interpret", "tile",
-                                             "kernel"))
+                                             "kernel", "epilogue"))
 def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
                  cp: ClassPlan, qsorted: jax.Array, rstarts: jax.Array,
                  rcounts: jax.Array, inv: jax.Array, rows_sel: jax.Array,
                  q2cap: int, k: int, route: str, domain: float,
                  interpret: bool, tile: int, ids_map: jax.Array | None = None,
-                 kernel: str = "kpass"):
+                 kernel: str = "kpass", epilogue: str = "gather"):
     """One class's external-query launch: build the per-supercell query block
     from the row-bucketed queries, run the class solver (kernel or streamed),
     gather each query's row back, and certify against the class's dilated
@@ -703,23 +789,32 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
         qxq, qyq, qzq = (jnp.take(qaxes[ax], safe_qs, axis=0)
                          .reshape(cp.n_sc, 1, q2cap) for ax in range(3))
         from ..config import resolve_kernel
+        from .pallas_solve import _topk_rows_or_transpose
 
         qid3 = jnp.full((cp.n_sc, 1, q2cap), _PAD_Q, jnp.int32)
-        out_d, out_i = _pallas_topk(qxq, qyq, qzq, cx, cy, cz, qid3, cid3,
-                                    q2cap, cp.ccap, k, False, interpret,
-                                    resolve_kernel(kernel, k, cp.ccap))
-        # transpose the raw (Sc, k, q2cap) kernel layout to row-major and
-        # gather whole rows -- same pattern as the self-solve epilogue
-        # (_rows2d): element gathers of m*k strided indices lose to one
-        # vectorized transpose + a contiguous row gather
+        resolved = resolve_kernel(kernel, k, cp.ccap)
         if cp.n_sc * q2cap > 2**31 - 1:
             # ValueError, not assert: under `python -O` a wrapped int32
             # index would gather wrong-yet-certified neighbors
             raise ValueError(
                 "query output exceeds int32 row indexing; reduce the query "
                 "batch")
-        rows_d = jnp.swapaxes(out_d, 1, 2).reshape(-1, k)    # (Sc*q2cap, k)
-        rows_i = jnp.swapaxes(out_i, 1, 2).reshape(-1, k)
+        if epilogue == "scatter":
+            # shared eligibility gate: row-major kernel when possible,
+            # gather launch + XLA transpose otherwise
+            rows_d, rows_i = _topk_rows_or_transpose(
+                qxq, qyq, qzq, cx, cy, cz, qid3, cid3, q2cap, cp.ccap, k,
+                False, interpret, qs_ok, resolved)
+        else:
+            out_d, out_i = _pallas_topk(qxq, qyq, qzq, cx, cy, cz, qid3,
+                                        cid3, q2cap, cp.ccap, k, False,
+                                        interpret, resolved)
+            # transpose the raw (Sc, k, q2cap) kernel layout to row-major and
+            # gather whole rows -- same pattern as the self-solve epilogue
+            # (_rows2d): element gathers of m*k strided indices lose to one
+            # vectorized transpose + a contiguous row gather
+            rows_d = jnp.swapaxes(out_d, 1, 2).reshape(-1, k)  # (Sc*q2cap, k)
+            rows_i = jnp.swapaxes(out_i, 1, 2).reshape(-1, k)
         row_d = jnp.take(rows_d, inv, axis=0)                # (m_c, k)
         row_i = jnp.take(rows_i, inv, axis=0)
     elif route == "dense":
@@ -792,7 +887,7 @@ def launch_class_query(points, starts, counts, cp: ClassPlan,
         jnp.asarray(rcounts), jnp.asarray(inv),
         jnp.asarray(rows_sorted.astype(np.int32)), q2cap, k,
         route, domain, cfg.interpret, cfg.stream_tile, ids_map,
-        cfg.effective_kernel())
+        cfg.effective_kernel(), cfg.resolved_epilogue())
     return order, r_i, r_d, r_c
 
 

@@ -62,7 +62,7 @@ _UNROLL_K_MAX = 64
 @functools.partial(
     jax.tree_util.register_dataclass,
     data_fields=("qx", "qy", "qz", "cx", "cy", "cz", "qid3", "cid3",
-                 "q_idx", "q_ok", "lo", "hi", "inv_flat", "inv_sc"),
+                 "q_idx", "q_ok", "lo", "hi", "inv_flat", "inv_sc", "tgt"),
     meta_fields=("qcap", "ccap", "s_total"),
 )
 @dataclasses.dataclass(frozen=True)
@@ -85,6 +85,11 @@ class PallasPack:
               instead of the (S*qcap)-row scatter it replaced (scatter was
               ~45% of round-1 solve time, DESIGN.md section 2).
     inv_sc:   (n,) i32 -- inv_flat // qcap (the owning supercell per point).
+    tgt:      (S*qcap,) i32 -- the FORWARD slot map for the scatter
+              epilogue: flat slot s writes output row tgt[s]; pad slots
+              carry the sentinel n and are dropped.  Built from the same
+              safe-index pass as inv_flat so the two directions cannot
+              drift apart (the ClassPlan.tgt rule).
     """
 
     qx: jax.Array
@@ -101,6 +106,7 @@ class PallasPack:
     hi: jax.Array
     inv_flat: jax.Array
     inv_sc: jax.Array
+    tgt: jax.Array
     qcap: int
     ccap: int
     s_total: int
@@ -294,24 +300,30 @@ def _kernel_blocked(qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref, qid_ref,
     out_d_ref[0, k - 1, :] = jnp.where(deficit, jnp.nan, t)
 
 
-def vmem_bytes_estimate(qcap: int, ccap: int, k: int) -> int:
+def vmem_bytes_estimate(qcap: int, ccap: int, k: int,
+                        row_out: bool = False) -> int:
     """Rough per-program VMEM need: d2 tile + in/out blocks (f32/i32 = 4B),
-    with lane/sublane padding accounted."""
+    with lane/sublane padding accounted.  ``row_out`` models the scatter
+    epilogue's row-major (Q, k) output blocks (queries on sublanes, k padded
+    to the 128-lane tile) instead of the gather layout's (k, Q) blocks."""
     q_pad = -(-qcap // 128) * 128
     k_pad = -(-k // 8) * 8
     tile = q_pad * ccap                       # d2 (+ the masked copy is fused)
     # 3 coord + 1 id block per side, each a (1, 1, N) VMEM tile occupying
     # 8 sublanes x N lanes
     inputs = 4 * 8 * q_pad + 4 * 8 * ccap
-    outputs = 2 * k_pad * q_pad
+    if row_out:
+        outputs = 2 * q_pad * (-(-k // 128) * 128)
+    else:
+        outputs = 2 * k_pad * q_pad
     return 4 * (2 * tile + inputs + outputs)
 
 
-def pallas_fits(qcap: int, ccap: int, k: int) -> bool:
-    return vmem_bytes_estimate(qcap, ccap, k) <= _VMEM_BUDGET
+def pallas_fits(qcap: int, ccap: int, k: int, row_out: bool = False) -> bool:
+    return vmem_bytes_estimate(qcap, ccap, k, row_out) <= _VMEM_BUDGET
 
 
-def pick_qsub(qcap: int, ccap: int, k: int) -> int:
+def pick_qsub(qcap: int, ccap: int, k: int, row_out: bool = False) -> int:
     """Largest per-grid-step query-block width for a (qcap, ccap) class.
 
     Returns qcap itself when the full tile fits VMEM; otherwise the widest
@@ -329,9 +341,149 @@ def pick_qsub(qcap: int, ccap: int, k: int) -> int:
         if lanes % d:
             continue
         qsub = 128 * d
-        if pallas_fits(qsub, ccap, k):
+        if pallas_fits(qsub, ccap, k, row_out):
             best = qsub
     return best
+
+
+def _check_qcap(qcap: int) -> None:
+    """qcap must be lane-aligned BEFORE the grid is built: pick_qsub
+    128-rounds internally, so an unaligned qcap (say 100) would get qsub=128
+    and a silently EMPTY grid (n_q = 100 // 128 == 0) whose output buffers
+    come back uninitialized with no error (ADVICE r5).  Every production
+    caller pads to 128 in its pack; this guard keeps the contract loud."""
+    if qcap % 128 != 0:
+        raise ValueError(
+            f"qcap={qcap} is not a multiple of 128 (the TPU lane width): an "
+            f"unaligned qcap would build an empty or misaligned kernel grid "
+            f"with uninitialized outputs; pad the query capacity to 128 "
+            f"(see _pack_inputs)")
+
+
+def _kernel_rows(off_ref, qx_ref, qy_ref, qz_ref, cx_ref, cy_ref, cz_ref,
+                 qid_ref, cid_ref, out_d_ref, out_i_ref, *, k: int,
+                 exclude_self: bool):
+    """Row-major twin of :func:`_kernel` for the scatter epilogue: the same
+    k-pass min-and-mask, but the per-pass (Q,) winners accumulate into a
+    (Q, k) tile that is written to the output block in one store -- the
+    lane->sublane transpose the gather epilogue paid as a separate HBM pass
+    (adaptive._rows2d) happens here on VMEM-resident registers instead.
+
+    ``off_ref`` is the scalar-prefetched destination-block map (consumed by
+    the output index map in _pallas_topk_rows, not read here): program
+    (b, j) lands its rows at output row-block off[b*n_q + j], so fully
+    padded sub-blocks route to a sink block and their write-back is skipped.
+    """
+    del off_ref  # consumed by the output BlockSpec index map
+    d2 = None
+    # same x,y,z accumulation order as knearests.cu:125
+    for q_ref, c_ref in ((qx_ref, cx_ref), (qy_ref, cy_ref), (qz_ref, cz_ref)):
+        qa = q_ref[0, 0, :].reshape(-1, 1)    # (Q, 1)
+        ca = c_ref[0, 0, :].reshape(1, -1)    # (1, C)
+        diff = qa - ca
+        d2 = diff * diff if d2 is None else d2 + diff * diff
+    ci = cid_ref[0, 0, :].reshape(1, -1)
+    drop = ci == _PAD_C
+    if exclude_self:
+        qi = qid_ref[0, 0, :].reshape(-1, 1)
+        drop = drop | (qi == ci)
+    d2 = jnp.where(drop, jnp.inf, d2)
+    q = d2.shape[0]
+    if k <= _UNROLL_K_MAX:
+        kd, ki = [], []
+        for i in range(k):
+            m = jnp.min(d2, axis=1)
+            sel = d2 == m[:, None]
+            bid = jnp.min(jnp.where(sel, ci, _BIG_ID), axis=1)
+            kd.append(m)
+            ki.append(bid)
+            if i + 1 < k:
+                d2 = jnp.where(sel & (ci == bid[:, None]), jnp.inf, d2)
+        out_d_ref[:, :] = jnp.stack(kd, axis=1)
+        out_i_ref[:, :] = jnp.stack(ki, axis=1)
+    else:
+        # large k: rolled loop (compile-time bound, like _kernel).  The
+        # neighbor axis is on LANES here, where dynamic offsets are not
+        # supported -- each pass lands its column through an iota mask on
+        # loop-carried (Q, k) accumulators instead of a pl.ds store.
+        lane_i = jax.lax.broadcasted_iota(jnp.int32, (q, k), 1)
+
+        def body(i, carry):
+            d2, acc_d, acc_i = carry
+            m = jnp.min(d2, axis=1)
+            sel = d2 == m[:, None]
+            bid = jnp.min(jnp.where(sel, ci, _BIG_ID), axis=1)
+            hit = lane_i == i
+            acc_d = jnp.where(hit, m[:, None], acc_d)
+            acc_i = jnp.where(hit, bid[:, None], acc_i)
+            return (jnp.where(sel & (ci == bid[:, None]), jnp.inf, d2),
+                    acc_d, acc_i)
+
+        _, acc_d, acc_i = jax.lax.fori_loop(
+            0, k, body, (d2, jnp.full((q, k), jnp.inf, jnp.float32),
+                         jnp.full((q, k), _BIG_ID, jnp.int32)))
+        out_d_ref[:, :] = acc_d
+        out_i_ref[:, :] = acc_i
+
+
+def _pallas_topk_rows(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int,
+                      ccap: int, k: int, exclude_self: bool, interpret: bool,
+                      q_ok=None):
+    """Scatter-epilogue launch: row-major ((S*qcap, k) dists, ids) straight
+    from the kernel, no transpose pass and no raw (S, k, Q) intermediate.
+
+    The output BlockSpec's index map is DATA-DEPENDENT: the per-program
+    destination-block map (built here from ``q_ok`` when given) rides the
+    scalar-prefetch channel (pltpu.PrefetchScalarGridSpec), so each program
+    DMAs its (qsub, k) row block to a runtime-chosen offset.  Today the map
+    encodes (supercell, query-sub-block) -> row block plus a sink block for
+    fully padded sub-blocks (their rows are never read -- every consumer
+    reads only valid slots through inv_flat/ClassPlan.tgt -- so skipping
+    their write-back is free bandwidth); it is the hook per-class placement
+    folds into.  Only the kpass extraction body exists in row-major form:
+    the blocked kernel stays gather-layout (explicit-request-only since r5)
+    and scatter-mode callers transpose its output in XLA instead."""
+    _check_qcap(qcap)
+    s_total = qx.shape[0]
+    qsub = pick_qsub(qcap, ccap, k, row_out=True)
+    if qsub == 0:
+        # every production caller gates through _topk_rows_or_transpose;
+        # launching the full tile here would just die later with an opaque
+        # Mosaic VMEM error, so refuse loudly instead
+        raise ValueError(
+            f"row-out tile (qcap={qcap}, ccap={ccap}, k={k}) exceeds the "
+            f"VMEM budget: gate on pick_qsub(row_out=True) and fall back "
+            f"to the gather-layout launch (_topk_rows_or_transpose)")
+    n_q = qcap // qsub
+    n_blk = s_total * n_q
+    if q_ok is not None:
+        # sink fully-padded sub-blocks (block n_blk is the sink)
+        blk_ok = q_ok.reshape(n_blk, qsub).any(axis=1)
+        off = jnp.where(blk_ok, jnp.arange(n_blk, dtype=jnp.int32), n_blk)
+    else:
+        off = jnp.arange(n_blk, dtype=jnp.int32)
+    q_spec = pl.BlockSpec((1, 1, qsub), lambda b, j, off: (b, 0, j))
+    c_spec = pl.BlockSpec((1, 1, ccap), lambda b, j, off: (b, 0, 0))
+    out_spec = pl.BlockSpec((qsub, k), lambda b, j, off: (off[b * n_q + j], 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_total, n_q),
+        in_specs=[q_spec, q_spec, q_spec, c_spec, c_spec, c_spec,
+                  q_spec, c_spec],
+        out_specs=[out_spec, out_spec],
+    )
+    out_d, out_i = pl.pallas_call(
+        functools.partial(_kernel_rows, k=k, exclude_self=exclude_self),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(((n_blk + 1) * qsub, k), jnp.float32),
+            jax.ShapeDtypeStruct(((n_blk + 1) * qsub, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(off, qx, qy, qz, cx, cy, cz, qid3, cid3)
+    # drop the sink block: rows [p*qsub, (p+1)*qsub) of the remainder are
+    # program p = b*n_q + j, i.e. row-major (S*qcap, k) slot order
+    return out_d[: s_total * qcap], out_i[: s_total * qcap]
 
 
 def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
@@ -350,6 +502,7 @@ def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
     candidate re-fetch instead of demoting to the streamed scan."""
     from ..config import blocked_topm
 
+    _check_qcap(qcap)
     s_total = qx.shape[0]
     qsub = pick_qsub(qcap, ccap, k)
     if qsub in (0, qcap):
@@ -399,6 +552,25 @@ def _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int, ccap: int,
         scratch_shapes=scratch_shapes,
         interpret=interpret,
     )(qx, qy, qz, cx, cy, cz, qid3, cid3)
+
+
+def _topk_rows_or_transpose(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap: int,
+                            ccap: int, k: int, exclude_self: bool,
+                            interpret: bool, q_ok, kernel: str = "kpass"):
+    """Row-major ((S*qcap, k) dists, ids) for the scatter epilogue, behind
+    the ONE eligibility gate every consumer shares: the scalar-prefetch
+    row-major body exists only for the kpass extraction (`blocked` has no
+    row-out twin) and only when the (qsub, k) row-out tile fits VMEM
+    (pick_qsub row_out=True); ineligible launches keep the gather-layout
+    kernel and transpose its raw (S, k, Q) output with XLA -- byte-identical
+    either way, the transpose just isn't fused into the kernel."""
+    if kernel == "kpass" and pick_qsub(qcap, ccap, k, row_out=True):
+        return _pallas_topk_rows(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap,
+                                 ccap, k, exclude_self, interpret, q_ok=q_ok)
+    out_d, out_i = _pallas_topk(qx, qy, qz, cx, cy, cz, qid3, cid3, qcap,
+                                ccap, k, exclude_self, interpret, kernel)
+    return (jnp.swapaxes(out_d, 1, 2).reshape(-1, k),
+            jnp.swapaxes(out_i, 1, 2).reshape(-1, k))
 
 
 def _pack_inputs(points: jax.Array, starts: jax.Array, counts: jax.Array,
@@ -465,44 +637,60 @@ def build_pack(points: jax.Array, starts: jax.Array, counts: jax.Array,
         qx=qx, qy=qy, qz=qz, cx=cx, cy=cy, cz=cz, qid3=qid3, cid3=cid3,
         q_idx=q_idx, q_ok=q_ok,
         lo=plan.box_lo.reshape(s_total, 3), hi=plan.box_hi.reshape(s_total, 3),
-        inv_flat=inv_flat, inv_sc=inv_flat // qcap,
+        inv_flat=inv_flat, inv_sc=inv_flat // qcap, tgt=safe,
         qcap=int(qcap), ccap=int(plan.ccap), s_total=int(s_total))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
-                                             "interpret", "kernel"))
+                                             "interpret", "kernel",
+                                             "epilogue"))
 def _solve_packed(pack: PallasPack, points: jax.Array, k: int,
                   exclude_self: bool, domain: float, interpret: bool = False,
-                  kernel: str = "kpass"):
-    """Steady-state solve: kernel launch + un-pad gather + certificates.
+                  kernel: str = "kpass", epilogue: str = "gather"):
+    """Steady-state solve: kernel launch + un-pad + certificates.
     Returns ((n,k) ids, (n,k) d2, (n,) certified), sorted indexing.
 
-    The epilogue is gather-only: pack.inv_flat maps every output row to its
-    kernel slot, sentinel fixups and the certificate run on the (n, k) result
+    epilogue='gather': pack.inv_flat maps every output row to its kernel
+    slot, sentinel fixups and the certificate run on the (n, k) result
     (smaller than the padded (S, Q, k) block), and the query coordinate of
     sorted row r is just points[r] -- no scatter, no padded-side compute.
+    epilogue='scatter': the kernel itself emits row-major slot rows at
+    scalar-prefetched block offsets (_pallas_topk_rows) and the valid rows
+    scatter through the forward slot map into the final buffer -- no raw
+    (S, k, Q) intermediate and no index composition.  Byte-identical.
     """
-    out_d, out_i = _pallas_topk(pack.qx, pack.qy, pack.qz,
-                                pack.cx, pack.cy, pack.cz,
-                                pack.qid3, pack.cid3, pack.qcap, pack.ccap, k,
-                                exclude_self, interpret, kernel)
-
-    # One gather straight from the kernel's raw (S, k, Q) layout: row r is
-    # supercell inv_sc[r], query lane inv_flat[r] % qcap, neighbor i at
-    # 1-D offset sc*k*qcap + i*qcap + lane.  Composing the index maps kills
-    # the (S,k,Q)->(S*Q,k) transposes that used to precede the row gather
-    # (VERDICT r3 weak #2: they survived in the hot path).
+    n = points.shape[0]
     qcap = pack.qcap
-    if pack.s_total * k * qcap > 2**31 - 1:
-        raise ValueError(
-            f"raw kernel output exceeds int32 indexing "
-            f"({pack.s_total * k * qcap} elements): shard the problem or "
-            f"reduce k")  # wrapped indices would gather wrong-yet-certifiable rows
-    lane = pack.inv_flat % qcap
-    base = pack.inv_sc * (k * qcap) + lane                 # (n,)
-    idx = base[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :] * qcap
-    row_d = jnp.take(out_d.reshape(-1), idx)               # (n, k) ascending
-    row_i = jnp.take(out_i.reshape(-1), idx)
+    if epilogue == "scatter":
+        rows_d, rows_i = _topk_rows_or_transpose(
+            pack.qx, pack.qy, pack.qz, pack.cx, pack.cy, pack.cz,
+            pack.qid3, pack.cid3, qcap, pack.ccap, k, exclude_self,
+            interpret, pack.q_ok, kernel)
+        row_d = jnp.full((n, k), jnp.inf, jnp.float32).at[pack.tgt].set(
+            rows_d, mode="drop")
+        row_i = jnp.full((n, k), INVALID_ID, jnp.int32).at[pack.tgt].set(
+            rows_i, mode="drop")
+    else:
+        out_d, out_i = _pallas_topk(pack.qx, pack.qy, pack.qz,
+                                    pack.cx, pack.cy, pack.cz,
+                                    pack.qid3, pack.cid3, qcap, pack.ccap, k,
+                                    exclude_self, interpret, kernel)
+
+        # One gather straight from the kernel's raw (S, k, Q) layout: row r
+        # is supercell inv_sc[r], query lane inv_flat[r] % qcap, neighbor i
+        # at 1-D offset sc*k*qcap + i*qcap + lane.  Composing the index maps
+        # kills the (S,k,Q)->(S*Q,k) transposes that used to precede the row
+        # gather (VERDICT r3 weak #2: they survived in the hot path).
+        if pack.s_total * k * qcap > 2**31 - 1:
+            raise ValueError(
+                f"raw kernel output exceeds int32 indexing "
+                f"({pack.s_total * k * qcap} elements): shard the problem or "
+                f"reduce k")  # wrapped indices would gather wrong-yet-certifiable rows
+        lane = pack.inv_flat % qcap
+        base = pack.inv_sc * (k * qcap) + lane             # (n,)
+        idx = base[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :] * qcap
+        row_d = jnp.take(out_d.reshape(-1), idx)           # (n, k) ascending
+        row_i = jnp.take(out_i.reshape(-1), idx)
     # Certificate from the RAW k-th value, before sanitization: the blocked
     # kernel marks deficit rows with NaN there, and NaN <= margin is false
     # even for an infinite margin (inf would wrongly certify).
@@ -537,6 +725,7 @@ def solve_pallas(grid: GridHash, cfg, plan: SolvePlan | None = None,
     nbr, d2, cert, n_unc = _solve_packed(
         pack, grid.points, cfg.k, cfg.exclude_self, grid.domain,
         cfg.interpret, resolve_kernel(cfg.effective_kernel(), cfg.k,
-                                      pack.ccap))
+                                      pack.ccap),
+        cfg.resolved_epilogue())
     return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
                      uncert_count=n_unc)

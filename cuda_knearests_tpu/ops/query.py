@@ -59,19 +59,23 @@ def bucket_queries(queries: np.ndarray, grid: GridHash, supercell: int,
 
 
 @functools.partial(jax.jit, static_argnames=("q2cap", "k", "exclude_hint",
-                                             "domain", "interpret"))
+                                             "domain", "interpret",
+                                             "epilogue"))
 def _query_packed(queries_sorted: jax.Array, sc_starts: jax.Array,
                   sc_counts: jax.Array, inv_flat: jax.Array,
                   inv_sc: jax.Array, pack, plan: SolvePlan, q2cap: int,
                   k: int, exclude_hint: bool, domain: float,
-                  interpret: bool = False):
+                  interpret: bool = False, epilogue: str = "gather"):
     """Kernel launch over the plan's supercells with external query blocks.
 
     Returns ((m,k) ids in *sorted stored-point* indexing, (m,k) d2,
-    (m,) certified), rows in *sorted query* order.  Same gather-only epilogue
-    as pallas_solve._solve_packed: inv_flat/inv_sc un-pad the slot blocks.
+    (m,) certified), rows in *sorted query* order.  epilogue='gather' is the
+    same transpose + row-gather epilogue as pallas_solve._solve_packed;
+    'scatter' has the kernel emit row-major rows at scalar-prefetched block
+    offsets (_pallas_topk_rows, empty supercells sink) so only the inv_flat
+    row gather remains.  inv_flat/inv_sc un-pad the slot blocks either way.
     """
-    from .pallas_solve import _PAD_Q, _pallas_topk
+    from .pallas_solve import _PAD_Q, _pallas_topk, _topk_rows_or_transpose
 
     s_total = pack.s_total
     slots = jnp.arange(q2cap, dtype=jnp.int32)
@@ -87,11 +91,19 @@ def _query_packed(queries_sorted: jax.Array, sc_starts: jax.Array,
     # block is all-_PAD_Q and exclusion is compiled out.
     qid3 = jnp.full((s_total, 1, q2cap), _PAD_Q, jnp.int32)
 
-    out_d, out_i = _pallas_topk(qx, qy, qz, pack.cx, pack.cy, pack.cz,
-                                qid3, pack.cid3,
-                                q2cap, pack.ccap, k, exclude_hint, interpret)
-    flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
-    flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)
+    if epilogue == "scatter":
+        # shared eligibility gate (kpass-only surface: this path never
+        # resolves a blocked body, so only the VMEM check can fall back)
+        flat_d, flat_i = _topk_rows_or_transpose(
+            qx, qy, qz, pack.cx, pack.cy, pack.cz, qid3, pack.cid3,
+            q2cap, pack.ccap, k, exclude_hint, interpret, qs_ok)
+    else:
+        out_d, out_i = _pallas_topk(qx, qy, qz, pack.cx, pack.cy, pack.cz,
+                                    qid3, pack.cid3,
+                                    q2cap, pack.ccap, k, exclude_hint,
+                                    interpret)
+        flat_d = out_d.transpose(0, 2, 1).reshape(-1, k)
+        flat_i = out_i.transpose(0, 2, 1).reshape(-1, k)
     row_d = jnp.take(flat_d, inv_flat, axis=0)             # (m, k)
     row_i = jnp.take(flat_i, inv_flat, axis=0)
     ok = jnp.isfinite(row_d)
@@ -136,7 +148,8 @@ def brute_force_by_coords(points: jax.Array, queries: jax.Array, k: int,
 
 def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
               k: int, supercell: int, interpret: bool = False,
-              fallback: str = "brute") -> Tuple[np.ndarray, np.ndarray]:
+              fallback: str = "brute",
+              epilogue: str = "gather") -> Tuple[np.ndarray, np.ndarray]:
     """Full external-query pipeline.  Returns ((m,k) neighbor ids in ORIGINAL
     point indexing, ascending; (m,k) squared distances), rows in query order.
 
@@ -162,7 +175,7 @@ def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
         out_i, out_d, cert = _query_packed(
             qs, jnp.asarray(starts), jnp.asarray(sc_counts),
             jnp.asarray(inv_flat), jnp.asarray(inv_sc), pack, plan,
-            q2cap, k, False, grid.domain, interpret)
+            q2cap, k, False, grid.domain, interpret, epilogue)
         out_i = np.asarray(jax.device_get(out_i))
         out_d = np.asarray(jax.device_get(out_d))
         cert = np.asarray(jax.device_get(cert))

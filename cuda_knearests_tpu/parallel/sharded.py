@@ -41,6 +41,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+# jax >= 0.5 exposes shard_map at the top level; 0.4.x keeps it experimental.
+# One alias so the build program below works on both.
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ..config import DOMAIN_SIZE, KnnConfig, default_ring_radius
 from ..ops.adaptive import (ClassPlan, _class_flat, _prepack_kernel_inputs,
                             _rows2d, build_class_specs, select_radii)
@@ -145,7 +151,7 @@ def _build_program(meta: ShardMeta, mesh: Mesh):
     metadata + mesh so repeated prepares with the same shapes reuse one
     compile."""
     spec = P("z")
-    return jax.jit(jax.shard_map(
+    return jax.jit(_shard_map(
         _make_build_fn(meta), mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=(spec,) * 9))
 
@@ -394,14 +400,27 @@ def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
             cp = dataclasses.replace(cp, pk=_prepack_kernel_inputs(
                 ext_pts, ext_starts, ext_counts, cp.own, cp.cand,
                 cp.qcap_pad, cp.ccap))
-        packed.append(cp)
         # invert this class's slot partition (local rows only own slots
         # here: own cells never cover halo layers) via the shared layout
         # encoder -- one source of truth for the output-row index maps
-        inv_row, inv_box, row_off, box_off = (
+        inv_row, inv_box, row_off, box_off, tgt = (
             _class_inverse_update(inv_row, inv_box, cp,
                                   ext_starts, ext_counts, n_ext,
                                   row_off, box_off))
+        # forward map for the scatter epilogue, in LOCAL row units: valid
+        # slots hold ext indices in [hcap, hcap + pcap) (own cells never
+        # cover halo layers), the n_ext sentinel lands at pcap + hcap and
+        # is dropped by the (pcap, k) scatter.  mode='drop' only protects
+        # the high side: if the own-cells-never-cover-halo invariant ever
+        # broke, an ext index below hcap would go negative and JAX scatter
+        # indexing wraps negatives into arbitrary local rows -- silently
+        # wrong yet certifiable.  Route such slots to the dropped sentinel
+        # instead (trace-safe; this runs under jit): the starved local row
+        # then keeps its init values and fails its certificate -- loud,
+        # never wrong.
+        tgt_loc = tgt - hcap
+        packed.append(dataclasses.replace(
+            cp, tgt=jnp.where(tgt_loc < 0, n_ext - hcap, tgt_loc)))
 
     loc = slice(hcap, hcap + pcap)
     box_loc = inv_box[loc]
@@ -414,26 +433,39 @@ def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
 
 
 @functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
-                                             "interpret", "tile", "kernel"))
+                                             "interpret", "tile", "kernel",
+                                             "epilogue"))
 def _chip_solve(spts, ext_pts, ext_ids, ext_starts, ext_counts,
                 classes: Tuple[ClassPlan, ...], inv_loc, lo_rows, hi_rows,
                 k: int, exclude_self: bool, domain: float, interpret: bool,
-                tile: int, kernel: str = "kpass"):
+                tile: int, kernel: str = "kpass", epilogue: str = "gather"):
     """One chip's steady-state solve over its prepared state: per-class
-    launches (prepacked kernel inputs for pallas routes), one local-row
-    gather, original-id translation through the exchanged id blocks, and the
-    completeness certificate.  Returns ((pcap, k) original-id neighbors,
-    (pcap, k) d2 ascending, (pcap,) certified), rows in local sorted order.
+    launches (prepacked kernel inputs for pallas routes), the local-row
+    un-pad (epilogue='gather': row-major concat + one gather through
+    inv_loc; 'scatter': row-major kernel output placed directly through the
+    per-class forward maps -- see adaptive._scatter_classes), original-id
+    translation through the exchanged id blocks, and the completeness
+    certificate.  Returns ((pcap, k) original-id neighbors, (pcap, k) d2
+    ascending, (pcap,) certified), rows in local sorted order; pad rows
+    (beyond the slab population) carry unread filler either way.
     """
-    flats_d, flats_i = [], []
-    for cp in classes:
-        fd, fi = _class_flat(ext_pts, ext_starts, ext_counts, cp, k,
-                             exclude_self, tile, interpret, kernel)
-        flats_d.append(fd)
-        flats_i.append(fi)
-    all_d, all_i = _rows2d(flats_d, flats_i, classes, k)
-    row_d = jnp.take(all_d, inv_loc, axis=0)                 # (pcap, k)
-    row_i = jnp.take(all_i, inv_loc, axis=0)
+    pcap = spts.shape[0]
+    if epilogue == "scatter":
+        from ..ops.adaptive import _scatter_classes
+
+        row_d, row_i = _scatter_classes(
+            ext_pts, ext_starts, ext_counts, classes, pcap, k, exclude_self,
+            tile, interpret, kernel)
+    else:
+        flats_d, flats_i = [], []
+        for cp in classes:
+            fd, fi = _class_flat(ext_pts, ext_starts, ext_counts, cp, k,
+                                 exclude_self, tile, interpret, kernel)
+            flats_d.append(fd)
+            flats_i.append(fi)
+        all_d, all_i = _rows2d(flats_d, flats_i, classes, k)
+        row_d = jnp.take(all_d, inv_loc, axis=0)             # (pcap, k)
+        row_i = jnp.take(all_i, inv_loc, axis=0)
     # raw k-th BEFORE sanitization (blocked-kernel deficit rows carry NaN)
     raw_kth = row_d[:, k - 1]
     ok = jnp.isfinite(row_d)
@@ -714,6 +746,7 @@ class ShardedKnnProblem:
         host assembly (solve()) is single-controller.
         """
         cfg, meta = self.config, self.meta
+        epilogue = cfg.resolved_epilogue()
         outs = {}
         for d in self.local_chips():
             if not self.chip_plans[d].classes:   # empty slab: nothing to do
@@ -725,7 +758,7 @@ class ShardedKnnProblem:
                 spts, ext_pts, ext_ids, ext_starts,
                 ext_counts, classes, inv_loc, lo_rows, hi_rows,
                 cfg.k, cfg.exclude_self, meta.domain, cfg.interpret,
-                cfg.stream_tile, cfg.effective_kernel())
+                cfg.stream_tile, cfg.effective_kernel(), epilogue)
         # memoized for stats() margin telemetry (released by drop_ready)
         self._device_out_cache = outs
         return outs

@@ -18,7 +18,7 @@ enable_compile_cache()
 n = int(os.environ.get("REPRO_N", "300000"))
 points = generate_clustered(n, seed=303)
 cfg = KnnConfig(k=10)
-print(json.dumps({"platform": jax.devices()[0].platform, "stage": "init", "platform": jax.devices()[0].platform, "n": n}), flush=True)
+print(json.dumps({"platform": jax.devices()[0].platform, "stage": "init", "n": n}), flush=True)
 
 dim = gridhash.grid_dim_for(n, cfg.density)
 t0 = time.time()

@@ -29,8 +29,11 @@ enable_compile_cache()
 
 
 def main() -> int:
+    from cuda_knearests_tpu.config import resolve_kernel
+
     plat = jax.devices()[0].platform
     rc = 0
+    compared = 0
     for name, pts in (("blue_15k", generate_blue_noise(15_000, seed=7)),
                       ("clustered_20k", generate_clustered(20_000, seed=5))):
         for k in (10, 20):
@@ -38,8 +41,37 @@ def main() -> int:
                    "platform": plat}
             try:
                 outs = {}
-                for kern in ("kpass", "blocked"):
-                    p = KnnProblem.prepare(pts, KnnConfig(k=k, kernel=kern))
+                p_blocked = KnnProblem.prepare(
+                    pts, KnnConfig(k=k, kernel="blocked"))
+                # record what actually RAN per class: resolve_kernel
+                # silently degrades ineligible blocked shapes to kpass, and
+                # a cell where EVERY class degraded would compare kpass
+                # against itself -- a vacuous pass that must be flagged,
+                # not banked as hardware exactness evidence (ADVICE r5)
+                resolved = [resolve_kernel("blocked", k, c.ccap)
+                            if c.route == "pallas" else c.route
+                            for c in p_blocked.aplan.classes]
+                row["resolved_kernels"] = resolved
+                if "blocked" not in resolved:
+                    # two distinct vacuous cases, recorded distinctly: the
+                    # planner may not route ANY class to the pallas kernel
+                    # (dense/streamed only -- the kernel was never in play),
+                    # vs pallas classes whose shapes resolve_kernel demoted
+                    # to kpass
+                    if "kpass" in resolved:
+                        why = ("blocked degraded to kpass on every "
+                               "pallas-routed class (ineligible shapes)")
+                    else:
+                        why = ("no pallas-routed class (planner chose "
+                               f"{sorted(set(resolved))} routes only)")
+                    row.update(skipped=True,
+                               reason=why + ": the differential would be "
+                                            "vacuous")
+                    print(json.dumps(row), flush=True)
+                    continue
+                for kern, prob in (("kpass", None), ("blocked", p_blocked)):
+                    p = prob or KnnProblem.prepare(
+                        pts, KnnConfig(k=k, kernel=kern))
                     res = p.solve()
                     watchdog.heartbeat()
                     outs[kern] = (p.get_knearests_original(),
@@ -53,6 +85,7 @@ def main() -> int:
                            certified_kpass=outs["kpass"][2],
                            certified_blocked=outs["blocked"][2],
                            n_points=int(pts.shape[0]))
+                compared += 1
                 if not (ids_eq and d2_eq and outs["kpass"][2] == 1.0
                         and outs["blocked"][2] == 1.0):
                     rc = 1
@@ -60,6 +93,14 @@ def main() -> int:
                 row["error"] = f"{type(e).__name__}: {e}"
                 rc = 1
             print(json.dumps(row), flush=True)
+    if compared == 0 and rc == 0:
+        # every cell skipped as vacuous: rc 0 would bank the run as
+        # exactness evidence although zero comparisons executed (the same
+        # all-rows-missing guard phase_breakdown.py applies)
+        print(json.dumps({"config": "summary", "platform": plat,
+                          "error": "all cells vacuous: no blocked-vs-kpass "
+                                   "comparison executed"}), flush=True)
+        rc = 1
     return rc
 
 

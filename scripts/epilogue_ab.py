@@ -163,11 +163,40 @@ def main() -> int:
         return row_d[:, k - 1] <= _margin_sq(pts[:, None, :], lo, hi,
                                              grid.domain)[:, 0]
 
+    # -- variant C (round 6): the scatter epilogue has no standalone
+    # epilogue program to time (the kernel launch itself places final rows
+    # through ClassPlan.tgt), so its comparable span is kernel+epilogue;
+    # span_gather measures the same span on the gather path for a fair A/B.
+    @jax.jit
+    def span_gather(pts):
+        fl = [adaptive._class_flat(pts, grid.cell_starts, grid.cell_counts,
+                                   cp, k, cfg.exclude_self, cfg.stream_tile,
+                                   False, "kpass") for cp in plan.classes]
+        all_d, all_i = adaptive._rows2d([f[0] for f in fl],
+                                        [f[1] for f in fl], plan.classes, k)
+        return (jnp.take(all_d, inv_row, axis=0),
+                jnp.take(all_i, inv_row, axis=0))
+
+    @jax.jit
+    def span_scatter(pts):
+        return adaptive._scatter_classes(
+            pts, grid.cell_starts, grid.cell_counts, plan.classes, n, k,
+            cfg.exclude_self, cfg.stream_tile, False, "kpass")
+
     ra = epi_current(flat_d, flat_i, grid.points)
     rb = epi_rowmajor(flats, grid.points)
-    jax.block_until_ready((ra, rb))
-    same = bool(jnp.array_equal(ra[0], rb[0]) and jnp.array_equal(ra[1], rb[1])
-                and jnp.array_equal(ra[2], rb[2]))
+    rg = span_gather(grid.points)
+    rs = span_scatter(grid.points)
+    jax.block_until_ready((ra, rb, rg, rs))
+    # two separate flags so a divergence in a rare healthy-chip window is
+    # attributable from the artifact alone: legacy element-gather vs
+    # row-major A/B, and gather-span vs scatter-span byte identity
+    legacy_equal = bool(jnp.array_equal(ra[0], rb[0])
+                        and jnp.array_equal(ra[1], rb[1])
+                        and jnp.array_equal(ra[2], rb[2]))
+    scatter_equal = bool(jnp.array_equal(rg[0], rs[0])
+                         and jnp.array_equal(rg[1], rs[1]))
+    same = legacy_equal and scatter_equal
 
     rows = {
         "epilogue_legacy_element_gather": steady(
@@ -179,11 +208,17 @@ def main() -> int:
             lambda: jax.block_until_ready(gathers_only(flat_d, flat_i))),
         "cert_only": steady(
             lambda: jax.block_until_ready(cert_only(ra[1], grid.points))),
+        "span_kernel_plus_gather_epilogue": steady(
+            lambda: jax.block_until_ready(span_gather(grid.points))),
+        "span_kernel_scatter_fused": steady(
+            lambda: jax.block_until_ready(span_scatter(grid.points))),
     }
     for name, s in rows.items():
         print(json.dumps({"config": name, "platform": plat,
                           "seconds": round(s, 5), "n_points": n, "k": k,
-                          "variants_equal": same}), flush=True)
+                          "variants_equal": same,
+                          "legacy_equal": legacy_equal,
+                          "scatter_equal": scatter_equal}), flush=True)
     return 0 if same else 1
 
 

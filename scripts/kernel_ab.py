@@ -100,8 +100,10 @@ def main() -> int:
                                                        c.ccap)
                                         if c.route == "pallas" else None)}
                    for c in p.aplan.classes]
+        epi = cfg.resolved_epilogue()
         print(json.dumps({
             "config": tag, "kernel_requested": cfg.kernel,
+            "epilogue_requested": cfg.epilogue, "epilogue": epi,
             "classes": classes,
             "supercell": cfg.supercell,
             "solve_s": round(t, 4),
@@ -144,8 +146,13 @@ def main() -> int:
 
     ks = (10,) if args.quick else (10, 20)
     for k in ks:
-        for kern in ("kpass", "blocked"):
-            try_measure(f"north star 900k (k={k})", KnnConfig(k=k, kernel=kern))
+        # the epilogue A/B rides the kpass rows (scatter = in-kernel row
+        # placement, gather = r5's transpose + row gather); blocked has no
+        # row-major body and stays on its gather baseline
+        for kern, epi in (("kpass", "gather"), ("kpass", "scatter"),
+                          ("blocked", "gather")):
+            try_measure(f"north star 900k (k={k}, {epi})",
+                        KnnConfig(k=k, kernel=kern, epilogue=epi))
     if not args.quick:
         # blocked shifts the cost balance toward per-block fixed work, so a
         # bigger supercell (more candidates amortized per tile) may win where

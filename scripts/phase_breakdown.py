@@ -39,19 +39,35 @@ from cuda_knearests_tpu.utils import watchdog
 
 
 def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
-    from cuda_knearests_tpu.ops.adaptive import (_class_flat, _solve_adaptive)
+    from cuda_knearests_tpu.ops.adaptive import (_class_flat,
+                                                 _scatter_classes,
+                                                 _solve_adaptive)
 
     platform = jax.devices()[0].platform
+    epi = cfg.resolved_epilogue()
     p = KnnProblem.prepare(points, cfg)
     watchdog.heartbeat()
     plan = p.aplan
     grid = p.grid
+    n = points.shape[0]
 
-    kernel_only = jax.jit(
-        lambda pts, st, ct, classes: [
-            _class_flat(pts, st, ct, cp, cfg.k, cfg.exclude_self,
-                        cfg.stream_tile, cfg.interpret, cfg.effective_kernel())
-            for cp in classes])
+    if epi == "scatter":
+        # the scatter epilogue has no standalone epilogue program: the
+        # class launches themselves place final (n, k) rows (in-kernel
+        # row-major output + forward-map scatter), so the "kernel" phase
+        # here IS kernel + placement and the epilogue phase measures only
+        # what remains outside it (the certificate)
+        kernel_only = jax.jit(
+            lambda pts, st, ct, classes: _scatter_classes(
+                pts, st, ct, classes, n, cfg.k, cfg.exclude_self,
+                cfg.stream_tile, cfg.interpret, cfg.effective_kernel()))
+    else:
+        kernel_only = jax.jit(
+            lambda pts, st, ct, classes: [
+                _class_flat(pts, st, ct, cp, cfg.k, cfg.exclude_self,
+                            cfg.stream_tile, cfg.interpret,
+                            cfg.effective_kernel())
+                for cp in classes])
 
     def t_kernel():
         out = kernel_only(grid.points, grid.cell_starts, grid.cell_counts,
@@ -62,7 +78,7 @@ def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
         out = _solve_adaptive(grid.points, grid.cell_starts,
                               grid.cell_counts, plan, cfg.k,
                               cfg.exclude_self, grid.domain, cfg.interpret,
-                              cfg.stream_tile, cfg.effective_kernel())
+                              cfg.stream_tile, cfg.effective_kernel(), epi)
         jax.block_until_ready(out)
 
     def t_full():
@@ -72,7 +88,6 @@ def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
     ms_k = steady(t_kernel) * 1e3
     ms_e = steady(t_epilogue) * 1e3
     ms_f = steady(t_full) * 1e3
-    n = points.shape[0]
     from cuda_knearests_tpu.utils.roofline import (problem_traffic,
                                                    roofline_fields)
 
@@ -85,6 +100,7 @@ def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
     print(json.dumps({
         "config": tag, "platform": platform,
         "kernel": cfg.effective_kernel(),
+        "epilogue": epi,
         "n_points": int(n),
         "kernel_ms": round(ms_k, 2),
         "kernel_plus_epilogue_ms": round(ms_e, 2),
@@ -103,6 +119,9 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ten-m", action="store_true",
                     help="also profile the 10M single-chip config")
+    ap.add_argument("--fixture", choices=("900k", "20k"), default="900k",
+                    help="'20k' = the reference's pts20K fixture, kpass "
+                         "only -- the CI smoke profile (runs fine on CPU)")
     args = ap.parse_args()
     watchdog.start(tag="phase_breakdown")
     if jax.devices()[0].platform == "cpu":
@@ -129,10 +148,20 @@ def main() -> int:
                               "error": f"{type(e).__name__}: {e}"}),
                   flush=True)
 
-    blue = get_dataset("900k_blue_cube.xyz")
-    for kern in ("kpass", "blocked"):
-        try_breakdown(f"north star 900k k=10 [{kern}]", blue,
-                      KnnConfig(k=10, kernel=kern))
+    # the epilogue dimension is the round-6 question: gather = r5's
+    # transpose + row-gather phase, scatter = in-kernel row placement
+    # (the standalone epilogue phase should read ~0 there)
+    if args.fixture == "20k":
+        pts = get_dataset("pts20K.xyz")
+        for epi in ("gather", "scatter"):
+            try_breakdown(f"pts20K k=10 [kpass/{epi}]", pts,
+                          KnnConfig(k=10, epilogue=epi))
+    else:
+        blue = get_dataset("900k_blue_cube.xyz")
+        for kern in ("kpass", "blocked"):
+            for epi in ("gather", "scatter"):
+                try_breakdown(f"north star 900k k=10 [{kern}/{epi}]", blue,
+                              KnnConfig(k=10, kernel=kern, epilogue=epi))
     if args.ten_m:
         try_breakdown("uniform 10M k=10 [kpass]", generate_uniform(
             10_000_000, seed=10), KnnConfig(k=10))

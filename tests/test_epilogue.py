@@ -1,0 +1,169 @@
+"""Scatter vs gather epilogue: byte-identity across every consumer.
+
+The scatter epilogue (config.epilogue, DESIGN.md section 2c) changes WHERE
+results are laid out -- the kernel emits row-major rows at scalar-prefetched
+offsets and classes place them through prepare-time forward maps -- but must
+never change a single output byte.  These differentials pin ids, squared
+distances, certified flags, and the in-program uncertified count equal
+between the two modes on:
+
+  * the interpret-mode Pallas kernel path (the TPU stand-in), adaptive and
+    legacy single-pack both,
+  * the compiled CPU path (dense/streamed class routes -- no kernel, the
+    scatter placement alone),
+  * a clustered fixture whose plan DROPS empty supercells,
+  * external queries (both the adaptive class schedule and the legacy
+    ops/query.py pipeline),
+  * the sharded multi-chip engine on the emulated 8-device mesh.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.config import resolve_epilogue
+from cuda_knearests_tpu.io import (generate_blue_noise, generate_clustered,
+                                   generate_uniform)
+
+
+def _triple(res):
+    return (np.asarray(res.neighbors), np.asarray(res.dists_sq),
+            np.asarray(res.certified),
+            int(np.asarray(res.uncert_count))
+            if res.uncert_count is not None else None)
+
+
+def _solve_both(points, **cfg_kw):
+    outs = {}
+    for epi in ("gather", "scatter"):
+        p = KnnProblem.prepare(points, KnnConfig(epilogue=epi, **cfg_kw))
+        outs[epi] = _triple(p.solve())
+    return outs
+
+
+def _assert_identical(outs):
+    g, s = outs["gather"], outs["scatter"]
+    np.testing.assert_array_equal(g[0], s[0])
+    np.testing.assert_array_equal(g[1], s[1])
+    np.testing.assert_array_equal(g[2], s[2])
+    assert g[3] == s[3]
+
+
+def test_resolve_epilogue_policy():
+    assert resolve_epilogue("auto", on_kernel_platform=True) == "scatter"
+    assert resolve_epilogue("auto", on_kernel_platform=False) == "gather"
+    assert resolve_epilogue("gather", True) == "gather"
+    assert resolve_epilogue("scatter", False) == "scatter"
+    with pytest.raises(ValueError, match="unknown epilogue"):
+        resolve_epilogue("scattr", True)
+
+
+@pytest.mark.parametrize("fixture_name", ["uniform_10k", "blue_8k"])
+def test_scatter_matches_gather_interpret_pallas(fixture_name, request):
+    """Adaptive interpret-mode kernel path: the scalar-prefetch row-major
+    kernel vs the raw-layout kernel + transpose + row gather."""
+    points = request.getfixturevalue(fixture_name)
+    _assert_identical(_solve_both(points, k=10, backend="pallas",
+                                  interpret=True))
+
+
+def test_scatter_matches_gather_compiled_cpu(pts20k):
+    """Compiled (non-interpret) CPU path: dense class routes, scatter
+    placement only -- the 'compiled CPU' half of the differential."""
+    _assert_identical(_solve_both(pts20k, k=10))
+
+
+def test_scatter_matches_gather_empty_supercells():
+    """Clustered data leaves most supercells EMPTY (dropped from every
+    class): the forward maps must still cover exactly the stored points and
+    the sink rows must never surface."""
+    points = generate_clustered(9_000, seed=31)
+    _assert_identical(_solve_both(points, k=10, backend="pallas",
+                                  interpret=True))
+    _assert_identical(_solve_both(points, k=10))  # compiled CPU routes
+
+
+def test_scatter_matches_gather_legacy_single_pack():
+    """adaptive=False pins the legacy PallasPack path
+    (pallas_solve._solve_packed's own scatter branch)."""
+    points = generate_uniform(7_000, seed=13)
+    _assert_identical(_solve_both(points, k=8, backend="pallas",
+                                  interpret=True, adaptive=False))
+
+
+def test_scatter_matches_gather_blocked_kernel():
+    """kernel='blocked' has no row-major body: scatter mode must route it
+    through the gather-layout launch + XLA transpose, byte-identically."""
+    points = generate_blue_noise(7_000, seed=23)
+    _assert_identical(_solve_both(points, k=10, backend="pallas",
+                                  interpret=True, kernel="blocked"))
+
+
+def test_scatter_matches_gather_external_queries(blue_8k, rng):
+    """External queries through the adaptive class schedule and through the
+    legacy ops/query.py pipeline, both epilogues."""
+    queries = rng.uniform(0.0, 1000.0, (700, 3)).astype(np.float32)
+    for extra in ({}, {"adaptive": False}):
+        outs = {}
+        for epi in ("gather", "scatter"):
+            p = KnnProblem.prepare(blue_8k, KnnConfig(
+                k=8, backend="pallas", interpret=True, epilogue=epi, **extra))
+            outs[epi] = p.query(queries)
+        np.testing.assert_array_equal(outs["gather"][0], outs["scatter"][0])
+        np.testing.assert_array_equal(outs["gather"][1], outs["scatter"][1])
+
+
+@pytest.mark.parametrize("backend,interpret", [("auto", True),
+                                               ("xla", False)])
+def test_scatter_matches_gather_sharded(backend, interpret):
+    """The sharded engine: per-chip scatter placement through the
+    halo-extended forward maps (backend='xla' pins the streamed route, so
+    the non-kernel scatter placement is covered too)."""
+    from cuda_knearests_tpu.parallel.sharded import ShardedKnnProblem
+
+    points = generate_uniform(12_000, seed=8)
+    outs = {}
+    for epi in ("gather", "scatter"):
+        p = ShardedKnnProblem.prepare(points, n_devices=8, config=KnnConfig(
+            k=8, backend=backend, interpret=interpret, epilogue=epi))
+        outs[epi] = p.solve()
+    for i in range(3):
+        np.testing.assert_array_equal(outs["gather"][i], outs["scatter"][i])
+
+
+def test_unaligned_qcap_refused():
+    """An unaligned qcap must raise BEFORE the grid is built -- pick_qsub
+    128-rounds internally, so qcap=100 would silently produce an EMPTY grid
+    (n_q = 100 // 128 == 0) with uninitialized outputs (ADVICE r5)."""
+    import jax.numpy as jnp
+
+    from cuda_knearests_tpu.ops.pallas_solve import (_pallas_topk,
+                                                     _pallas_topk_rows)
+
+    qcap, ccap, k = 100, 128, 4
+    q = jnp.zeros((1, 1, qcap), jnp.float32)
+    c = jnp.zeros((1, 1, ccap), jnp.float32)
+    qi = jnp.zeros((1, 1, qcap), jnp.int32)
+    ci = jnp.zeros((1, 1, ccap), jnp.int32)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        _pallas_topk(q, q, q, c, c, c, qi, ci, qcap, ccap, k,
+                     exclude_self=False, interpret=True)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        _pallas_topk_rows(q, q, q, c, c, c, qi, ci, qcap, ccap, k,
+                          exclude_self=False, interpret=True)
+
+
+def test_scatter_refuses_planless_forward_map(uniform_10k):
+    """A plan without forward maps (e.g. deserialized from a pre-scatter
+    build) must fail loudly in scatter mode, not produce init-value rows."""
+    p = KnnProblem.prepare(uniform_10k, KnnConfig(
+        k=8, backend="pallas", interpret=True, epilogue="scatter"))
+    stripped = dataclasses.replace(
+        p.aplan,
+        classes=tuple(dataclasses.replace(cp, tgt=None)
+                      for cp in p.aplan.classes))
+    p.aplan = stripped
+    with pytest.raises(ValueError, match="predates the scatter epilogue"):
+        p.solve()

@@ -8,7 +8,10 @@ are drawn from small fixed buckets so the jit-compile universe stays bounded.
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from cuda_knearests_tpu import KnnConfig, KnnProblem
 from cuda_knearests_tpu.io import normalize_points, validate_points
