@@ -27,6 +27,17 @@ Every accelerated row also carries static-shape roofline fields
 (utils/roofline.py): moved bytes, achieved GB/s and GFLOP/s, and on TPU the
 percent of the v5e HBM peak -- the falsifiable form of "bandwidth-bound".
 
+``--all`` is SUPERVISED by default: every row (and the north star) runs in an
+isolated worker process (cuda_knearests_tpu/runtime/) speaking a framed JSON
+result protocol.  A worker crash -- the r5 clustered-input SIGKILL that used
+to poison every subsequent row (r5_tpu_all_rows.json rc=1) -- now costs only
+its row: the driver emits the row with a typed ``failure`` record (kind in
+{crash, timeout, oom, transport, assertion}), auto-quarantines the config,
+and hands the next row a fresh worker.  Transient transport faults retry
+with bounded exponential backoff (recovered rows carry ``attempts`` > 1).
+``--no-supervise`` restores the in-process loop; manual ``--skip`` always
+wins over auto-quarantine (a skipped row never reaches a worker at all).
+
 Timing matches the reference's convention: compile/context cost excluded
 (steady-state min over repeats, the analog of test_knearests.cu:138-144
 keeping CUDA context creation outside the inner timer), device-side completion
@@ -519,16 +530,27 @@ def main(argv=None) -> int:
                             "the full-size sharded run")
     ap.add_argument("--skip", choices=_ALL_CONFIGS, action="append",
                     default=None,
-                    help="with --all: leave this config out (repeatable). "
-                         "For quarantining a row that kills the backend -- "
-                         "a crashed TPU worker poisons the whole process, "
-                         "so one bad row would otherwise cost every row "
-                         "after it; the skipped row is captured separately "
-                         "via --only.  The skip is visible in the "
-                         "artifact's argv.")
+                    help="with --all: leave this config out entirely "
+                         "(repeatable).  The MANUAL quarantine -- it always "
+                         "wins over the supervisor's automatic one: a "
+                         "skipped row is never even handed to a worker, and "
+                         "is visible only in the artifact's argv.  The "
+                         "skipped row can be captured separately via "
+                         "--only.")
+    ap.add_argument("--no-supervise", action="store_true",
+                    help="with --all: run every row in THIS process (the "
+                         "pre-supervisor behavior, where a worker crash "
+                         "poisons every subsequent row).  By default each "
+                         "row runs in an isolated supervised worker process "
+                         "(cuda_knearests_tpu.runtime): a crash costs only "
+                         "its row (typed FailureRecord, auto-quarantine, "
+                         "fresh worker for the next row) and transient "
+                         "transport faults retry with backoff.")
     args = ap.parse_args(argv)
     if args.skip and not args.all:
         ap.error("--skip requires --all")
+    if args.no_supervise and not args.all:
+        ap.error("--no-supervise requires --all")
 
     # cheap env stamp for the signal/error paths; refreshed with real jax
     # device info once the backend is safely up (the handler itself must never
@@ -579,6 +601,55 @@ def main(argv=None) -> int:
                                                    honor_jax_platforms_env)
     honor_jax_platforms_env()
     enable_compile_cache()  # remote-tunnel compiles persist across runs
+
+    if args.all and not args.no_supervise:
+        # Supervised mode (default for --all): each row runs in an isolated
+        # child (cuda_knearests_tpu/runtime/worker.py).  A SIGKILL/Mosaic
+        # abort/libtpu wedge kills only that row: the driver records a
+        # typed FailureRecord, the config auto-quarantines, and the next
+        # row gets a FRESH worker -- rc stays 0 with explicit failure rows
+        # instead of the r5 "first crash poisons the session" mode
+        # (r5_tpu_all_rows.json).  Transient transport faults retry with
+        # bounded backoff; a recovered row lands with attempts > 1 stamped.
+        #
+        # The parent must NOT initialize a backend here (no _env_fields):
+        # on hardware the accelerator is exclusive-access, and a parent
+        # holding it would starve every worker.  Workers stamp their own
+        # platform/n_devices; failure rows carry the probe's platform.
+        # The parent's stall watchdog disarms too -- it does no device
+        # work, and each child is bounded by its own watchdog plus the
+        # supervisor's row timeout.
+        _watchdog.disable()
+        from cuda_knearests_tpu.runtime import Supervisor
+
+        names = [n for n in _ALL_CONFIGS
+                 if not (args.skip and n in args.skip)]
+        sup = Supervisor()
+        for name in names:
+            row, failure = sup.run_job(
+                name, {"job": "bench_config", "name": name})
+            if failure is not None:
+                row = {"config": name,
+                       "error": f"supervised worker failed "
+                                f"[{failure.kind}]: {failure.message}",
+                       "failure": failure.to_json(),
+                       "platform": platform}
+            print(json.dumps(row), flush=True)
+        out, failure = sup.run_job("north_star", {"job": "north_star"})
+        if failure is not None:
+            line = _error_line(
+                f"supervised north-star worker failed "
+                f"[{failure.kind}]: {failure.message}")
+            line["failure"] = failure.to_json()
+            print(json.dumps(line), flush=True)
+            state["emitted"] = True
+            return 1
+        if note:
+            out["backend_note"] = note
+        print(json.dumps(out), flush=True)
+        state["emitted"] = True
+        return 0 if out.get("recall_at_10", 0.0) >= 0.999 else 1
+
     env = _env_fields(platform)
     state["env"] = env
 
@@ -598,9 +669,11 @@ def main(argv=None) -> int:
         return 0 if "error" not in row else 1
 
     if args.all:
-        for name in _ALL_CONFIGS:
-            if args.skip and name in args.skip:
-                continue
+        # the in-process loop (--no-supervise): manual --skip wins here
+        # exactly as in supervised mode
+        names = [n for n in _ALL_CONFIGS
+                 if not (args.skip and n in args.skip)]
+        for name in names:
             _watchdog.heartbeat()  # entering a row is forward progress
             try:
                 row = bench_config(name)

@@ -125,38 +125,50 @@ def main(argv=None) -> int:
         summary["backend_note"] = backend_note
 
     # --- accelerated solve (reference "knn gpu" phase, test_knearests.cu:136) ---
-    if args.sharded:
-        from .parallel.sharded import ShardedKnnProblem
-        with Stopwatch("prepare (grid + slab plan)"):
-            sp = ShardedKnnProblem.prepare(points, n_devices=args.sharded,
-                                           config=cfg)
-        watchdog.heartbeat()
-        # device-side steady state, compile split out -- same convention (and
-        # the same JSON summary schema) as the single-chip branch below
-        dev_out, t = timed(lambda: sp.solve_device(), warmup=1, iters=1)
-        watchdog.heartbeat()
-        print(f"solve (sharded): compile+first {t['warmup_s']:.3f}s, "
-              f"steady {t['min_s']:.3f}s "
-              f"({n / t['min_s']:.0f} queries/sec)")
-        summary["solve_s"] = t["min_s"]
-        summary["qps"] = n / t["min_s"]
-        with Stopwatch("assemble (host readback)"):
-            neighbors, d2, cert = sp.solve(device_out=dev_out)
-        perm = sp.permutation()
-    else:
-        with Stopwatch("prepare (grid + plan)"):
-            problem = KnnProblem.prepare(points, cfg)
-        watchdog.heartbeat()
-        _, t = timed(lambda: problem.solve(), warmup=1, iters=1)
-        watchdog.heartbeat()
-        print(f"solve: compile+first {t['warmup_s']:.3f}s, "
-              f"steady {t['min_s']:.3f}s "
-              f"({n / t['min_s']:.0f} queries/sec)")
-        summary["solve_s"] = t["min_s"]
-        summary["qps"] = n / t["min_s"]
-        problem.print_stats()
-        neighbors = problem.get_knearests_original()
-        perm = problem.get_permutation()
+    # Classified failure containment: a preflight refusal (LaunchBudgetError,
+    # kind 'oom') or a transient tunnel death (TransportError, kind
+    # 'transport') exits rc 4 with a machine-readable line carrying
+    # failure_kind, so the supervisor/watcher can classify the run without
+    # parsing a traceback -- instead of the stack trace + rc 1 a crash gives.
+    from .utils.memory import DeviceMemoryError
+    try:
+        if args.sharded:
+            from .parallel.sharded import ShardedKnnProblem
+            with Stopwatch("prepare (grid + slab plan)"):
+                sp = ShardedKnnProblem.prepare(points, n_devices=args.sharded,
+                                               config=cfg)
+            watchdog.heartbeat()
+            # device-side steady state, compile split out -- same convention
+            # (and the same JSON summary schema) as the single-chip branch
+            dev_out, t = timed(lambda: sp.solve_device(), warmup=1, iters=1)
+            watchdog.heartbeat()
+            print(f"solve (sharded): compile+first {t['warmup_s']:.3f}s, "
+                  f"steady {t['min_s']:.3f}s "
+                  f"({n / t['min_s']:.0f} queries/sec)")
+            summary["solve_s"] = t["min_s"]
+            summary["qps"] = n / t["min_s"]
+            with Stopwatch("assemble (host readback)"):
+                neighbors, d2, cert = sp.solve(device_out=dev_out)
+            perm = sp.permutation()
+        else:
+            with Stopwatch("prepare (grid + plan)"):
+                problem = KnnProblem.prepare(points, cfg)
+            watchdog.heartbeat()
+            _, t = timed(lambda: problem.solve(), warmup=1, iters=1)
+            watchdog.heartbeat()
+            print(f"solve: compile+first {t['warmup_s']:.3f}s, "
+                  f"steady {t['min_s']:.3f}s "
+                  f"({n / t['min_s']:.0f} queries/sec)")
+            summary["solve_s"] = t["min_s"]
+            summary["qps"] = n / t["min_s"]
+            problem.print_stats()
+            neighbors = problem.get_knearests_original()
+            perm = problem.get_permutation()
+    except DeviceMemoryError as e:
+        summary.update(error=str(e), failure_kind=e.kind)
+        print(json.dumps(summary), flush=True)
+        print(f"REFUSED [{e.kind}]: {e}", file=sys.stderr, flush=True)
+        return 4
 
     # device work done; the remaining phases (oracle, tie analysis) are
     # local CPU and may legitimately exceed the stall limit at k=50

@@ -123,6 +123,15 @@ class KnnConfig:
         explicitly.  Both modes are byte-identical by differential test
         (tests/test_epilogue.py); resolve through resolved_epilogue(),
         never the raw field.
+      hbm_budget_bytes: HBM budget (bytes) one kernel launch may commit to,
+        consumed by the preflight (ops/pallas_solve.preflight_launch /
+        hbm_fits).  None -> resolve from the KNTPU_HBM_BUDGET_BYTES env knob,
+        else 80% of the device's reported bytes_limit, else unbounded; <= 0
+        forces unbounded.  Over-budget launches are DEMOTED where a cheaper
+        route exists (adaptive classes stream) and otherwise REFUSED with a
+        structured oom-kind LaunchBudgetError before any grid is built --
+        never left to crash the worker mid-launch (the r5 clustered-input
+        failure mode; see DESIGN.md section 9).
       kernel: top-k extraction strategy inside the Pallas kernel.  'kpass' =
         k min-and-mask sweeps of the full (Q, C) distance tile (the
         shared-memory-heap analog, knearests.cu:127-133).  'blocked' =
@@ -153,6 +162,7 @@ class KnnConfig:
     adaptive: bool = True
     max_classes: int = 4
     stream_tile: int = 2048
+    hbm_budget_bytes: Optional[int] = None
     kernel: str = "kpass"  # solvers read effective_kernel(), not this field
     epilogue: str = "auto"  # solvers read resolved_epilogue(), not this field
 

@@ -143,7 +143,11 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
     streaming merge's extra tile copies are not), streaming only tiles past
     the dense byte ceiling.
     """
-    from .pallas_solve import pick_qsub
+    from ..config import resolve_epilogue, resolve_kernel
+    from .pallas_solve import (hbm_budget_bytes, hbm_fits, launch_row_out,
+                               pick_qsub)
+
+    hbm_budget = hbm_budget_bytes(cfg)
 
     def cand_at(rows: np.ndarray, radius: int) -> np.ndarray:
         return pts_cum[rows, radius]
@@ -176,9 +180,21 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
         qcap_pad = -(-qcap // 128) * 128
         if on_kernel_platform:
             # oversized query axes no longer demote (pick_qsub grids over
-            # query sub-blocks); only a candidate axis too wide for VMEM
-            # at a 128-wide query block streams
+            # query sub-blocks); a candidate axis too wide for VMEM at a
+            # 128-wide query block streams, and so does a class whose
+            # launch-scale pack would overflow the HBM budget (the
+            # preflight's demotion arm: stream the one dense-blob class,
+            # keep the kernel for the rest -- DESIGN.md section 9).  The
+            # HBM model uses the layout this class's launch will actually
+            # allocate: row-major output blocks (k padded to 128 lanes)
+            # when the scatter path is taken, gather blocks otherwise.
+            row_out = launch_row_out(
+                qcap_pad, ccap, cfg.k,
+                resolve_kernel(cfg.effective_kernel(), cfg.k, ccap),
+                resolve_epilogue(cfg.epilogue, True))
             route = ("pallas" if pick_qsub(qcap_pad, ccap, cfg.k)
+                     and hbm_fits(qcap_pad, ccap, cfg.k, rows.size,
+                                  row_out=row_out, budget=hbm_budget)
                      else "streamed")
         else:
             route = ("dense" if qcap * ccap * 4 <= _DENSE_TILE_BYTES

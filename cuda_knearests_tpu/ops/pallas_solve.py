@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -344,6 +346,121 @@ def pick_qsub(qcap: int, ccap: int, k: int, row_out: bool = False) -> int:
         if pallas_fits(qsub, ccap, k, row_out):
             best = qsub
     return best
+
+
+def hbm_bytes_estimate(qcap: int, ccap: int, k: int, s_total: int,
+                       row_out: bool = False) -> int:
+    """Modeled HBM footprint (bytes) of one kernel launch: the PallasPack's
+    per-supercell coordinate/id lane blocks and slot maps, plus the kernel's
+    output buffers.  The VMEM estimate above gates what one *program* holds;
+    this gates what the whole launch allocates -- the quantity that actually
+    OOMs a device when a dense class's ccap explodes (the r5 clustered crash
+    was a launch-scale failure, not a per-program one).  Deliberately a
+    slight overestimate (pad slots counted, per-axis lane blocks at full
+    width): preflight must refuse marginal launches, not bless them."""
+    q_pad = -(-qcap // 128) * 128
+    # qx/qy/qz/qid3 (q side) + cx/cy/cz/cid3 (c side), 4B each, per supercell
+    pack = s_total * 4 * (4 * q_pad + 4 * ccap)
+    pack += s_total * 4 * 2 * q_pad               # q_idx + q_ok
+    if row_out:
+        # row-major ((n_blk+1)*qsub, k) dists + ids, k padded to lanes
+        out = 2 * 4 * (s_total * q_pad + q_pad) * (-(-k // 128) * 128)
+    else:
+        out = 2 * 4 * s_total * k * q_pad          # raw (S, k, Q) d + i
+    return pack + out
+
+
+_HBM_BUDGET_ENV = "KNTPU_HBM_BUDGET_BYTES"
+# Fraction of the device's reported bytes_limit the preflight will commit to
+# one launch: headroom for the grid CSR, the result buffers the epilogue
+# scatters into, and XLA's own temporaries.
+_HBM_BUDGET_FRACTION = 0.8
+
+
+def hbm_budget_bytes(cfg=None) -> int | None:
+    """The HBM budget one launch must fit, or None for unbounded.
+
+    Resolution order: an explicit ``KnnConfig.hbm_budget_bytes`` wins, then
+    the ``KNTPU_HBM_BUDGET_BYTES`` env knob (<= 0 means unbounded -- the
+    escape hatch), then 80% of the device's reported ``bytes_limit``.  Hosts
+    whose backend reports no limit (CPU fallback) run unbounded: the OS can
+    page, and refusing launches there would fail workloads that succeed."""
+    explicit = getattr(cfg, "hbm_budget_bytes", None) if cfg is not None \
+        else None
+    if explicit is not None:
+        return int(explicit) if explicit > 0 else None
+    raw = os.environ.get(_HBM_BUDGET_ENV)
+    if raw is not None:
+        try:
+            v = int(float(raw))  # OverflowError: 'inf' means unbounded too
+        except (ValueError, OverflowError):
+            print(f"ignoring malformed {_HBM_BUDGET_ENV}={raw!r}",
+                  file=sys.stderr, flush=True)
+            return None
+        return v if v > 0 else None
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        return int(limit * _HBM_BUDGET_FRACTION) if limit > 0 else None
+    except Exception:  # noqa: BLE001 -- no stats = no bound, never an error
+        return None
+
+
+def preflight_launch(qcap: int, ccap: int, k: int, s_total: int, *,
+                     row_out: bool = False, site: str = "pallas",
+                     budget: int | None = None) -> None:
+    """HBM+VMEM preflight for a kernel launch: raise a structured
+    :class:`LaunchBudgetError` (``kind == 'oom'``) BEFORE any grid is built
+    when the launch cannot fit, instead of letting Mosaic/libtpu discover it
+    mid-flight and wedge or kill the worker (the r5 clustered-input crash
+    mode).  VMEM: the candidate axis must fit a 128-wide query block
+    (pick_qsub > 0 -- wider query blocks only split further).  HBM: the
+    modeled launch footprint must fit ``budget`` when one is known.  Callers
+    that can demote (adaptive class routing) check :func:`hbm_fits` /
+    :func:`pick_qsub` instead of calling this."""
+    from ..utils.memory import LaunchBudgetError
+
+    if pick_qsub(qcap, ccap, k, row_out) == 0:
+        raise LaunchBudgetError(
+            f"{site}: candidate axis ccap={ccap} (k={k}) exceeds the "
+            f"{_VMEM_BUDGET} byte VMEM budget even at a 128-wide query "
+            f"block; use a smaller config.supercell, backend='xla', or the "
+            f"streamed route",
+            requested=vmem_bytes_estimate(128, ccap, k, row_out),
+            budget=_VMEM_BUDGET, site=site)
+    if budget is not None:
+        need = hbm_bytes_estimate(qcap, ccap, k, s_total, row_out)
+        if need > budget:
+            raise LaunchBudgetError(
+                f"{site}: modeled launch footprint {need} bytes "
+                f"(qcap={qcap}, ccap={ccap}, k={k}, supercells={s_total}) "
+                f"exceeds the {budget} byte HBM budget; shard the problem, "
+                f"lower config.supercell, or raise "
+                f"config.hbm_budget_bytes / {_HBM_BUDGET_ENV}",
+                requested=need, budget=budget, site=site)
+
+
+def hbm_fits(qcap: int, ccap: int, k: int, s_total: int,
+             row_out: bool = False, budget: int | None = None) -> bool:
+    """True iff the modeled launch footprint fits ``budget`` (always True
+    when unbounded).  The demotion predicate: adaptive class routing keys on
+    this to stream a would-OOM class instead of refusing the whole solve."""
+    return (budget is None
+            or hbm_bytes_estimate(qcap, ccap, k, s_total, row_out) <= budget)
+
+
+def launch_row_out(qcap: int, ccap: int, k: int, kernel: str,
+                   epilogue: str) -> bool:
+    """True iff this launch will actually take the row-major scatter path.
+    Mirrors _topk_rows_or_transpose's gate exactly (kpass body only, row-out
+    tile must fit VMEM -- ineligible scatter launches fall back to the
+    gather kernel + XLA transpose).  The preflight/demotion callers MUST
+    model the same layout the launch will allocate: the row-out output
+    blocks pad k to 128 lanes, up to ~12.8x the gather layout's at k=10, so
+    modeling the wrong layout either blesses a launch that OOMs or refuses
+    a config the fallback would have solved."""
+    return (epilogue == "scatter" and kernel == "kpass"
+            and pick_qsub(qcap, ccap, k, row_out=True) > 0)
 
 
 def _check_qcap(qcap: int) -> None:
@@ -711,21 +828,28 @@ def solve_pallas(grid: GridHash, cfg, plan: SolvePlan | None = None,
     contract as solve.solve (sorted indexing, uncertified rows left for the
     api-level exact fallback).  Pass a prebuilt ``pack`` for steady-state
     repeat solves (api.KnnProblem caches one)."""
-    if plan is None:
-        plan = build_plan(grid, cfg)
-    if not pick_qsub(plan.qcap, plan.ccap, cfg.k):
-        raise ValueError(
-            f"candidate axis ccap={plan.ccap} exceeds the VMEM budget even "
-            f"at a 128-wide query block; use a smaller config.supercell or "
-            f"backend='xla'")
-    if pack is None:
-        pack = build_pack(grid.points, grid.cell_starts, grid.cell_counts, plan)
     from ..config import resolve_kernel
 
+    if plan is None:
+        plan = build_plan(grid, cfg)
+    kernel = resolve_kernel(cfg.effective_kernel(), cfg.k, plan.ccap)
+    epilogue = cfg.resolved_epilogue()
+    # HBM+VMEM preflight: refuse a would-OOM launch with a structured
+    # oom-kind error BEFORE any pack allocation or kernel grid exists --
+    # the supervised driver records it as a FailureRecord row instead of
+    # losing the process (DESIGN.md section 9).  Modeled at the layout the
+    # launch will actually allocate (launch_row_out): a row-out-ineligible
+    # scatter config falls back to the gather kernel, so it is gated -- and
+    # HBM-modeled -- as gather, not refused.
+    preflight_launch(plan.qcap, plan.ccap, cfg.k,
+                     plan.n_chunks * plan.batch,
+                     row_out=launch_row_out(plan.qcap, plan.ccap, cfg.k,
+                                            kernel, epilogue),
+                     site="solve_pallas", budget=hbm_budget_bytes(cfg))
+    if pack is None:
+        pack = build_pack(grid.points, grid.cell_starts, grid.cell_counts, plan)
     nbr, d2, cert, n_unc = _solve_packed(
         pack, grid.points, cfg.k, cfg.exclude_self, grid.domain,
-        cfg.interpret, resolve_kernel(cfg.effective_kernel(), cfg.k,
-                                      pack.ccap),
-        cfg.resolved_epilogue())
+        cfg.interpret, kernel, epilogue)
     return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
                      uncert_count=n_unc)

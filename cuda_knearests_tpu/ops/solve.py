@@ -339,8 +339,20 @@ def prepare_pack(grid: GridHash, cfg: KnnConfig, plan: SolvePlan):
     (for callers that cache it across repeat solves); None for the xla path."""
     if resolve_backend(cfg, plan) != "pallas":
         return None
-    from .pallas_solve import build_pack  # local import: avoid cycle
+    from ..config import resolve_kernel
+    from .pallas_solve import (build_pack, hbm_budget_bytes,  # local import:
+                               launch_row_out, preflight_launch)  # avoid cycle
 
+    # refuse a would-OOM pack BEFORE allocating it: the pack itself is the
+    # launch-scale HBM commitment the preflight models.  Same actual-layout
+    # modeling as solve_pallas (launch_row_out), so a scatter-mode refusal
+    # fires HERE -- before the pack allocation -- not after it.
+    kernel = resolve_kernel(cfg.effective_kernel(), cfg.k, plan.ccap)
+    preflight_launch(plan.qcap, plan.ccap, cfg.k,
+                     plan.n_chunks * plan.batch,
+                     row_out=launch_row_out(plan.qcap, plan.ccap, cfg.k,
+                                            kernel, cfg.resolved_epilogue()),
+                     site="prepare_pack", budget=hbm_budget_bytes(cfg))
     return build_pack(grid.points, grid.cell_starts, grid.cell_counts, plan)
 
 

@@ -1,0 +1,14 @@
+"""Fault-isolated execution runtime: the supervised launch layer.
+
+``supervisor`` runs each device job in an isolated child process speaking a
+structured JSON result protocol, so a worker crash (SIGKILL, Mosaic abort,
+libtpu wedge) kills only that job; ``worker`` is the minimal child entry
+module.  See DESIGN.md section 9 for the protocol, the failure taxonomy, and
+the preflight/demotion matrix.
+"""
+
+from .supervisor import (FAILURE_KINDS, RESULT_PREFIX, FailureRecord,
+                         RetryPolicy, Supervisor)
+
+__all__ = ["FailureRecord", "RetryPolicy", "Supervisor", "FAILURE_KINDS",
+           "RESULT_PREFIX"]

@@ -1,0 +1,267 @@
+"""Fault-isolated execution supervisor: crash containment + retry/backoff.
+
+The round-5 record shows the engine's worst failures are process-level, not
+numerical: a legal clustered input hard-crashed the TPU worker and the
+poisoned process then failed every subsequent bench row with UNAVAILABLE
+(``r5_tpu_all_rows.json`` rc=1) -- one bad row cost the whole session.  The
+reference never dies on legal input because every CUDA call is checked and
+exits synchronously (knearests.cu:163-167, 205-231); this environment's
+accelerator fails asynchronously (SIGKILL from libtpu, Mosaic aborts, RPC
+hangs), so containment has to come from process isolation instead of
+per-call checks.
+
+The supervisor runs each job in a child process (``runtime/worker.py``)
+speaking a one-line JSON result protocol:
+
+    parent --argv--> worker:  {"job": ..., "label": ..., "attempt": N, ...}
+    worker --stdout-> parent: "@@KNTPU-RESULT@@ " + json(result row)
+                              (or json({"error":..., "failure_kind":...}))
+
+A worker death of any shape maps onto a typed :class:`FailureRecord` (kind in
+:data:`FAILURE_KINDS`) via :func:`classify_exit`; *transient* kinds (the
+transport bucket -- the tunneled TPU's observed dark windows) retry with the
+same bounded exponential backoff law as backend acquisition
+(utils/platform.backoff_schedule), everything else quarantines the job label
+so nothing re-runs a config that already killed a worker.  Because every job
+gets a FRESH child, a crash can never poison the next row -- the property the
+round-5 session lacked.
+
+Fault injection (CPU-testable, env-triggered -- see worker._inject_fault)
+makes the whole layer verifiable in tier-1 CI without hardware:
+``KNTPU_FAULT="abort:<label>"`` SIGKILLs the worker, ``hang:<label>`` wedges
+it (timeout path), ``transient:<label>:<n>`` raises TransportError on the
+first n attempts (retry path), ``oom:<label>`` raises a synthetic
+LaunchBudgetError (preflight path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+from ..utils.memory import classify_fault_text
+from ..utils.platform import _env_number, backoff_schedule
+
+# The complete failure taxonomy.  Every FailureRecord.kind is one of these;
+# retry policy and artifact consumers key on them, never on message text.
+FAILURE_KINDS = ("crash", "timeout", "oom", "transport", "assertion")
+
+# Frame marker for the worker->parent result protocol.  A prefix (not bare
+# JSON) so library chatter that happens to print a '{' line can never be
+# mistaken for the result.
+RESULT_PREFIX = "@@KNTPU-RESULT@@ "
+
+_TIMEOUT_ENV = "BENCH_ROW_TIMEOUT_S"
+_RETRIES_ENV = "BENCH_ROW_RETRIES"
+_RETRY_BASE_ENV = "BENCH_RETRY_BASE_S"
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass
+class FailureRecord:
+    """One typed, machine-readable account of a failed supervised job.
+
+    kind:        one of FAILURE_KINDS.
+    config:      the job label (bench config name / "north_star" / ...).
+    message:     one-line human summary (exception text, signal name, ...).
+    rc:          child exit code, None if it never exited (timeout kill).
+    signal:      POSIX signal number that killed the child, else None.
+    attempts:    how many child launches were spent on this job (>= 1).
+    stderr_tail: last chunk of the final child's stderr -- the evidence.
+    """
+
+    kind: str
+    config: str
+    message: str
+    rc: Optional[int] = None
+    signal: Optional[int] = None
+    attempts: int = 1
+    stderr_tail: str = ""
+
+    def __post_init__(self):
+        if self.kind not in FAILURE_KINDS:
+            raise ValueError(f"unknown failure kind {self.kind!r}: "
+                             f"expected one of {FAILURE_KINDS}")
+
+    def to_json(self) -> dict:
+        """The stable artifact schema (tests/test_supervisor.py pins it):
+        every key always present, kind validated, attempts >= 1."""
+        return {"kind": self.kind, "config": self.config,
+                "message": self.message, "rc": self.rc,
+                "signal": self.signal, "attempts": int(self.attempts),
+                "stderr_tail": self.stderr_tail}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FailureRecord":
+        return cls(kind=d["kind"], config=d["config"], message=d["message"],
+                   rc=d.get("rc"), signal=d.get("signal"),
+                   attempts=int(d.get("attempts", 1)),
+                   stderr_tail=d.get("stderr_tail", ""))
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry-with-exponential-backoff, keyed on fault kind.  Only
+    'transport' retries by default: transient tunnel loss is the one fault
+    that a fresh attempt can fix; crashes/ooms/assertions are deterministic
+    for a given config and retrying them just burns the wall budget."""
+
+    tries: int = 3
+    base_delay_s: float = 2.0
+    factor: float = 2.0
+    retry_kinds: Tuple[str, ...] = ("transport",)
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        return cls(tries=max(1, _env_number(_RETRIES_ENV, 3, int)),
+                   base_delay_s=_env_number(_RETRY_BASE_ENV, 2.0, float))
+
+
+def classify_exit(rc: Optional[int], sig: Optional[int],
+                  frame: Optional[dict], stderr: str) -> Tuple[str, str]:
+    """(kind, message) for a failed worker exit.
+
+    Priority: the worker's own framed ``failure_kind`` (it caught the
+    exception and knows the taxonomy class -- TransportError/
+    LaunchBudgetError stamp themselves), then signal death (crash), then the
+    stall watchdog's rc 3 (timeout: the worker detected its own hang), then
+    stderr text classification (UNAVAILABLE -> transport, RESOURCE_EXHAUSTED
+    -> oom), then AssertionError spelling, then crash."""
+    if frame and frame.get("failure_kind") in FAILURE_KINDS:
+        return frame["failure_kind"], str(frame.get("error", ""))
+    if sig is not None:
+        return "crash", f"worker killed by signal {sig}"
+    if rc == 3 or "stall watchdog" in stderr:
+        return "timeout", f"worker stall watchdog tripped (rc {rc})"
+    text_kind = classify_fault_text(stderr)
+    if text_kind:
+        return text_kind, f"worker exited rc {rc} ({text_kind} per stderr)"
+    if "AssertionError" in stderr:
+        return "assertion", f"worker assertion failed (rc {rc})"
+    return "crash", f"worker exited rc {rc} with no result frame"
+
+
+def parse_result_frame(stdout: str) -> Optional[dict]:
+    """The LAST well-formed result frame in a worker's stdout, or None."""
+    frame = None
+    for line in stdout.splitlines():
+        if line.startswith(RESULT_PREFIX):
+            try:
+                frame = json.loads(line[len(RESULT_PREFIX):])
+            except json.JSONDecodeError:
+                pass
+    return frame
+
+
+class Supervisor:
+    """Runs jobs in isolated worker children; owns retry and quarantine.
+
+    One Supervisor per driver run.  ``quarantined`` maps job label ->
+    FailureRecord for every job that exhausted its attempts; a label already
+    quarantined short-circuits (no child is spawned) and returns the stored
+    record, so a config that killed a worker once cannot kill another one
+    later in the same session.
+    """
+
+    def __init__(self, policy: Optional[RetryPolicy] = None,
+                 timeout_s: Optional[float] = None,
+                 sleep=time.sleep, stderr_tail_chars: int = 2000):
+        self.policy = policy or RetryPolicy.from_env()
+        # a containment bound, not a perf budget: generous enough that no
+        # legitimate CPU-fallback row (the slow emulated 10M configs) can
+        # trip it, small enough that a wedged worker cannot pin a capture
+        # window.  BENCH_ROW_TIMEOUT_S overrides (fault tests set ~seconds).
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else _env_number(_TIMEOUT_ENV, 1800.0, float))
+        self._sleep = sleep
+        self._tail = stderr_tail_chars
+        self.quarantined: dict[str, FailureRecord] = {}
+
+    # -- public API ---------------------------------------------------------
+
+    def run_job(self, label: str, job: dict) \
+            -> Tuple[Optional[dict], Optional[FailureRecord]]:
+        """Run one job to completion: (result_row, None) on success --
+        stamped ``attempts`` when recovery took more than one -- or
+        (None, FailureRecord) after containment.  Retries only the kinds the
+        policy names, with the shared backoff law; the terminal failure
+        auto-quarantines the label."""
+        if label in self.quarantined:
+            return None, self.quarantined[label]
+        delays = backoff_schedule(self.policy.tries,
+                                  base_s=self.policy.base_delay_s,
+                                  factor=self.policy.factor)
+        failure: Optional[FailureRecord] = None
+        for attempt in range(1, self.policy.tries + 1):
+            row, failure = self._run_once(label, job, attempt)
+            if failure is None:
+                assert row is not None
+                if attempt > 1:
+                    row["attempts"] = attempt
+                return row, None
+            failure.attempts = attempt
+            if failure.kind not in self.policy.retry_kinds:
+                break
+            if attempt <= len(delays):
+                self._sleep(delays[attempt - 1])
+        assert failure is not None
+        self.quarantined[label] = failure
+        return None, failure
+
+    # -- internals ----------------------------------------------------------
+
+    def _worker_cmd(self, spec: str) -> list[str]:
+        return [sys.executable, "-m", "cuda_knearests_tpu.runtime.worker",
+                spec]
+
+    def _worker_env(self) -> dict:
+        env = dict(os.environ)
+        # the package must be importable from the child regardless of the
+        # parent's cwd (bench.py is usually run from the repo root, but the
+        # contract must not depend on it)
+        env["PYTHONPATH"] = _REPO_ROOT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        return env
+
+    def _run_once(self, label: str, job: dict, attempt: int) \
+            -> Tuple[Optional[dict], Optional[FailureRecord]]:
+        spec = json.dumps({**job, "label": label, "attempt": attempt})
+        try:
+            proc = subprocess.run(
+                self._worker_cmd(spec), capture_output=True, text=True,
+                timeout=self.timeout_s, env=self._worker_env())
+        except subprocess.TimeoutExpired as e:
+            # subprocess.run already killed the child on expiry
+            stderr = e.stderr if isinstance(e.stderr, str) else \
+                (e.stderr or b"").decode(errors="replace")
+            return None, FailureRecord(
+                kind="timeout", config=label,
+                message=f"worker exceeded the {self.timeout_s:.0f}s row "
+                        f"timeout and was killed",
+                rc=None, signal=None,
+                stderr_tail=(stderr or "")[-self._tail:])
+        except OSError as e:
+            return None, FailureRecord(
+                kind="crash", config=label,
+                message=f"worker failed to spawn: {e}", rc=None)
+        frame = parse_result_frame(proc.stdout)
+        sig = -proc.returncode if proc.returncode < 0 else None
+        if proc.returncode == 0 and frame is not None \
+                and "error" not in frame:
+            return frame, None
+        kind, message = classify_exit(proc.returncode, sig, frame,
+                                      proc.stderr or "")
+        if proc.returncode == 0 and frame is None:
+            message = "worker exited rc 0 without a result frame"
+            kind = "crash"
+        return None, FailureRecord(
+            kind=kind, config=label, message=message,
+            rc=proc.returncode if proc.returncode >= 0 else None,
+            signal=sig, stderr_tail=(proc.stderr or "")[-self._tail:])

@@ -1,0 +1,149 @@
+"""Minimal worker entry module for the execution supervisor.
+
+``python -m cuda_knearests_tpu.runtime.worker '<json job spec>'`` runs ONE
+device job and reports through the one-line framed JSON protocol
+(supervisor.RESULT_PREFIX).  Job kinds:
+
+  {"job": "bench_config", "name": "<BASELINE config>"}  -> bench.bench_config
+  {"job": "north_star"}                                 -> bench.bench_north_star
+  {"job": "selftest"}    -> a trivial well-formed row, no device work (the
+                            fast vehicle for the fault-injection tests)
+
+Every spec also carries ``label`` (the supervisor's quarantine key) and
+``attempt`` (1-based -- the transient fault injector keys on it).  The
+worker exits 0 with a result frame, or nonzero with an error frame whose
+``failure_kind`` is the taxonomy class of what went wrong; deaths that emit
+no frame at all (SIGKILL, Mosaic abort) are classified by the supervisor
+from rc/signal/stderr.  The worker arms its own stall watchdog so a hang on
+a dead transport self-exits rc 3 (classified 'timeout') before the
+supervisor's harder row timeout has to fire.
+
+Fault injection (``KNTPU_FAULT``, comma-separable ``kind:label[:arg]``):
+  abort:<label>         SIGKILL self (crash containment path)
+  hang:<label>[:secs]   sleep (timeout / stall-watchdog path)
+  transient:<label>[:n] raise TransportError while attempt <= n (retry path)
+  oom:<label>           raise a synthetic LaunchBudgetError (preflight path)
+Faults fire before any heavy import, so the crash case dies exactly as hard
+as a real libtpu SIGKILL would.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+# bench/dataset work stays inside main() so an injected fault hits before
+# it; the supervisor constants are shared, not copied (running this module
+# via -m already imports runtime/__init__ -> supervisor, so there is no
+# import to save and a drifted copy would break frame parsing silently)
+from .supervisor import _REPO_ROOT, FAILURE_KINDS, RESULT_PREFIX
+
+
+def _emit(obj: dict) -> None:
+    print(RESULT_PREFIX + json.dumps(obj), flush=True)
+
+
+def _inject_fault(label: str, attempt: int) -> None:
+    spec = os.environ.get("KNTPU_FAULT", "")
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        parts = item.split(":")
+        kind = parts[0]
+        target = parts[1] if len(parts) > 1 else ""
+        arg = parts[2] if len(parts) > 2 else ""
+        if target and target != label:
+            continue
+        if kind == "abort":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif kind == "hang":
+            time.sleep(float(arg or 3600.0))
+        elif kind == "transient":
+            if attempt <= int(arg or 1):
+                from ..utils.memory import TransportError
+
+                raise TransportError(
+                    f"injected transient fault: backend UNAVAILABLE "
+                    f"(attempt {attempt} <= {int(arg or 1)} forced failures)")
+        elif kind == "oom":
+            from ..utils.memory import LaunchBudgetError
+
+            raise LaunchBudgetError(
+                "injected synthetic over-budget launch",
+                requested=1 << 40, budget=1 << 30, site="fault-injection")
+        else:
+            print(f"ignoring unknown KNTPU_FAULT kind {kind!r}",
+                  file=sys.stderr, flush=True)
+
+
+def _failure_kind(exc: BaseException) -> str:
+    """Taxonomy class for an exception the worker caught itself: the
+    DeviceMemoryError hierarchy self-stamps via its ``kind`` attribute,
+    AssertionError is 'assertion', everything else classifies by text and
+    falls back to 'crash'."""
+    kind = getattr(exc, "kind", None)
+    from ..utils.memory import classify_fault_text
+
+    if kind in FAILURE_KINDS:
+        return kind
+    if isinstance(exc, AssertionError):
+        return "assertion"
+    return classify_fault_text(f"{type(exc).__name__}: {exc}") or "crash"
+
+
+def _run_job(job: dict) -> dict:
+    label = job.get("label") or job.get("name") or job.get("job", "")
+    _inject_fault(label, int(job.get("attempt", 1)))
+    if job.get("job") == "selftest":
+        return {"config": "selftest", "value": 1.0, "unit": "ok",
+                "label": label}
+
+    # real bench work: same entry hygiene as the parent driver, minus the
+    # subprocess probe (the parent already acquired the backend and pinned
+    # the env this child inherited)
+    if _REPO_ROOT not in sys.path:
+        sys.path.insert(0, _REPO_ROOT)  # bench.py lives at the repo root
+    from ..utils import watchdog
+    from ..utils.platform import enable_compile_cache, honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    enable_compile_cache()
+    watchdog.start(tag=f"worker:{label}")
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and not os.environ.get("BENCH_STALL_FORCE"):
+        watchdog.disable()  # local CPU work cannot hang on the transport
+
+    import bench
+
+    if job.get("job") == "bench_config":
+        row = bench.bench_config(job["name"])
+    elif job.get("job") == "north_star":
+        row = bench.bench_north_star()
+    else:
+        raise ValueError(f"unknown worker job {job.get('job')!r}")
+    row.setdefault("platform", platform)
+    row.setdefault("n_devices", len(jax.devices()))
+    return row
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        job = json.loads(argv[0]) if argv else json.load(sys.stdin)
+        row = _run_job(job)
+    except BaseException as e:  # noqa: BLE001 -- every failure must frame
+        import traceback
+
+        traceback.print_exc()
+        _emit({"error": f"{type(e).__name__}: {e}",
+               "failure_kind": _failure_kind(e)})
+        return 1
+    _emit(row)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

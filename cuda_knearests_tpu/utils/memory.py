@@ -11,6 +11,7 @@ equivalents are: validated H2D staging (`to_device`), D2H extraction
 
 from __future__ import annotations
 
+import re
 from typing import Any, Optional
 
 import jax
@@ -20,7 +21,100 @@ import numpy as np
 
 class DeviceMemoryError(RuntimeError):
     """Raised when staging fails validation (analog of the reference's
-    print-and-exit in gpuMalloc*, knearests.cu:205-231 -- but recoverable)."""
+    print-and-exit in gpuMalloc*, knearests.cu:205-231 -- but recoverable).
+
+    Root of the device-fault hierarchy: every subclass stamps a ``kind`` from
+    the supervisor's failure taxonomy (runtime/supervisor.py) so retry policy
+    can key on fault kind instead of string-matching messages.  The base class
+    covers checked-invariant refusals (non-finite staging data and the like),
+    which are deterministic -- retrying them is never useful."""
+
+    kind = "assertion"
+
+
+class TransportError(DeviceMemoryError):
+    """A *transient* accelerator-transport fault: the backend RPC layer
+    reported UNAVAILABLE / connection loss rather than a real allocation or
+    validation failure.  This environment's tunneled TPU transport goes dark
+    and comes back (VERDICT r5: dark from 03:56 UTC to session end), so these
+    are the one fault kind worth bounded retry-with-backoff -- the supervisor
+    retries ``kind == 'transport'`` and quarantines everything else."""
+
+    kind = "transport"
+
+
+class DeviceOOMError(DeviceMemoryError):
+    """A *runtime* allocation exhaustion reported by the backend
+    (RESOURCE_EXHAUSTED from device_put / execute).  Same taxonomy bucket as
+    a preflight refusal (kind 'oom') but after the fact: the preflight's
+    model missed, or the allocation was outside its scope.  Deterministic
+    for a given launch -- never retried, the fix is a smaller launch."""
+
+    kind = "oom"
+
+
+class LaunchBudgetError(DeviceMemoryError):
+    """A launch refused by the HBM/VMEM preflight BEFORE any kernel grid is
+    built (ops/pallas_solve.preflight_launch): the modeled footprint exceeds
+    the budget, so running it would OOM or wedge the device.  Structured so
+    callers can demote (smaller tile, streamed route, xla backend) instead of
+    dying: ``requested``/``budget`` are bytes, ``site`` names the launch."""
+
+    kind = "oom"
+
+    def __init__(self, message: str, *, requested: Optional[int] = None,
+                 budget: Optional[int] = None, site: str = ""):
+        super().__init__(message)
+        self.requested = requested
+        self.budget = budget
+        self.site = site
+
+
+# Lowercased substrings that identify a transient transport fault in backend
+# error text.  UNAVAILABLE is the gRPC status the dead tunnel produces
+# (r5_tpu_all_rows.json: every post-crash device_put failed UNAVAILABLE);
+# the rest are the dark-probe / dropped-connection shapes seen in stderr.
+_TRANSPORT_PATTERNS = (
+    "unavailable", "deadline_exceeded", "deadline exceeded",
+    "connection reset", "connection refused", "failed to connect",
+    "socket closed", "transport is closing", "broken pipe",
+)
+
+# Real allocation exhaustion (distinct from transport: retrying the same
+# launch cannot help; the fix is a smaller launch).  Anchored regexes, not
+# bare substrings: 'oom' must be a standalone word ('headroom'/'zoom' in an
+# unrelated traceback must NOT classify a crash as oom -- the taxonomy is
+# what retry/quarantine policy keys on).
+_OOM_RE = re.compile(
+    r"resource[_ ]exhausted|out of memory|\boom\b|allocation failure"
+    r"|failed to allocate")
+
+
+def classify_fault_text(text: str) -> Optional[str]:
+    """Map backend/stderr error text onto the failure taxonomy: 'transport'
+    for transient connection loss, 'oom' for allocation exhaustion, None when
+    the text matches neither (callers keep their own default kind).
+    Transport wins ties: a dark tunnel produces UNAVAILABLE wrapped around
+    all sorts of secondary allocator noise, and misclassifying a transient
+    fault as oom would wrongly disable retry."""
+    low = (text or "").lower()
+    if any(p in low for p in _TRANSPORT_PATTERNS):
+        return "transport"
+    if _OOM_RE.search(low):
+        return "oom"
+    return None
+
+
+def wrap_device_error(exc: BaseException, context: str) -> DeviceMemoryError:
+    """Wrap a backend exception in the taxonomy subclass its text indicates
+    (TransportError for UNAVAILABLE/dark-tunnel shapes, DeviceOOMError for
+    allocation exhaustion, base DeviceMemoryError otherwise), preserving the
+    failing site like the reference's checked helpers do
+    (knearests.cu:205-231)."""
+    kind = classify_fault_text(f"{type(exc).__name__}: {exc}")
+    cls = {"transport": TransportError,
+           "oom": DeviceOOMError}.get(kind, DeviceMemoryError)
+    return cls(f"{context}: {type(exc).__name__}: {exc}")
 
 
 def to_device(x: np.ndarray, dtype: Any = jnp.float32,
@@ -38,8 +132,11 @@ def to_device(x: np.ndarray, dtype: Any = jnp.float32,
     try:
         return jax.device_put(arr, sharding)
     except Exception as e:  # surface the failing site like the reference does
-        raise DeviceMemoryError(f"device_put failed for shape={arr.shape} "
-                                f"dtype={arr.dtype}: {e}") from e
+        # classified wrap: a dead-tunnel UNAVAILABLE raises TransportError
+        # (retryable by the supervisor), everything else the base class
+        raise wrap_device_error(
+            e, f"device_put failed for shape={arr.shape} "
+               f"dtype={arr.dtype}") from e
 
 
 def from_device(x: jax.Array) -> np.ndarray:

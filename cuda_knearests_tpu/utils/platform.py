@@ -98,6 +98,22 @@ _CACHE_TTL_ENV = "BENCH_PROBE_CACHE_TTL_S"
 _CACHE_TTL_DEFAULT = 60.0
 
 
+def backoff_schedule(tries: int, base_s: float = 5.0, factor: float = 2.0,
+                     max_s: float = 120.0) -> list[float]:
+    """Delays (seconds) between retry attempts: ``tries - 1`` entries of
+    capped exponential backoff.  The one backoff law shared by every retry
+    loop in the engine -- acquire_backend's probe retry below and the
+    execution supervisor's transient-transport retry
+    (runtime/supervisor.py) -- so changing the policy cannot leave one
+    caller on a stale curve."""
+    delays = []
+    d = max(0.0, base_s)
+    for _ in range(max(0, tries - 1)):
+        delays.append(min(d, max_s))
+        d *= factor
+    return delays
+
+
 def _env_number(name, default, cast):
     """Parse a numeric env knob; a malformed value must not crash every
     entry point -- fall back to the default with a stderr note."""
@@ -205,16 +221,15 @@ def acquire_backend(tries: int | None = None, timeout_s: float | None = None,
         timeout_s = _env_number("BENCH_PROBE_TIMEOUT_S", 75.0, float)
     if probe is None:
         probe = _probe_default_backend
-    delay = 5.0
+    delays = backoff_schedule(tries, base_s=5.0)
     for i in range(tries):
         platform = probe(timeout_s)
         if platform:
             if ttl_s > 0:
                 _write_healthy_probe_cache(platform)
             return platform, None
-        if i + 1 < tries:
-            time.sleep(delay)
-            delay *= 2
+        if i < len(delays):
+            time.sleep(delays[i])
     # Persistent failure: pin cpu in the env (for any child process) AND at
     # jax config level -- jax is typically already imported by the package
     # __init__ at this point, so the env var alone would be a no-op here.

@@ -24,13 +24,42 @@ the outer watcher's timeout kill -- never worse than without the watchdog.
 """
 from __future__ import annotations
 
+import faulthandler
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
 _ENV = "BENCH_STALL_TIMEOUT_S"
+_FAILURE_DIR_ENV = "KNTPU_FAILURE_DIR"
+
+
+def _dump_tracebacks(tag: str) -> str | None:
+    """Dump all-thread tracebacks (faulthandler) into a failure artifact and
+    to stderr, returning the artifact path (None if the write failed).  A
+    stall trip without this leaves only a timeout on record; the tracebacks
+    show WHERE the process was pinned (which backend RPC, which phase) --
+    the evidence a hang postmortem actually needs.  stderr gets a copy too
+    so supervised children surface it in their captured stderr tail even
+    when the artifact directory is unwritable."""
+    path = None
+    try:
+        d = os.environ.get(_FAILURE_DIR_ENV) or tempfile.gettempdir()
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"stall_{tag or 'bench'}_{os.getpid()}.tb")
+        with open(path, "w") as f:
+            f.write(f"stall watchdog trip ({tag}): all-thread tracebacks\n")
+            f.flush()
+            faulthandler.dump_traceback(file=f, all_threads=True)
+    except Exception:  # noqa: BLE001 -- the exit path must never raise
+        path = None
+    try:
+        faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+    except Exception:  # noqa: BLE001
+        pass
+    return path
 _lock = threading.Lock()
 _state = {"t": 0.0, "enabled": False, "stall_s": 300.0, "tag": ""}
 _started = False
@@ -85,12 +114,19 @@ def _watch() -> None:
             dt = time.monotonic() - _state["t"]
             tag = _state["tag"]
         if dt > stall_s:
+            # evidence first: all-thread tracebacks into the failure
+            # artifact (and stderr), so a hang leaves more than a timeout
+            tb_path = _dump_tracebacks(tag)
             # one machine-readable line so the rc-stamped artifact records
             # WHY the run died (the watcher's _artifact_good rejects
             # error-bearing lines, so the step is retried, not enshrined)
-            print(json.dumps({
+            line = {
                 "error": f"stall watchdog ({tag}): no progress for "
                          f"{dt:.0f}s (limit {stall_s:.0f}s); presumed hung "
-                         f"on a dead accelerator transport"}), flush=True)
+                         f"on a dead accelerator transport",
+                "failure_kind": "timeout"}
+            if tb_path:
+                line["traceback_file"] = tb_path
+            print(json.dumps(line), flush=True)
             sys.stderr.flush()
             os._exit(3)

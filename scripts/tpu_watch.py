@@ -107,6 +107,35 @@ def _artifact_good(path: str, allow_partial: bool = False) -> bool:
     return all("error" not in ln for ln in lines)
 
 
+def write_bench_snapshot(outdir: str, tag: str, ns_path: str,
+                         sm_path: str) -> str | None:
+    """BENCH-schema snapshot row (VERDICT r5 item 7): whenever a healthy
+    window banked a good north-star artifact (full-size preferred, smoke
+    otherwise), mirror it to ``{tag}_BENCH_snapshot.json`` in the driver's
+    official BENCH_r*.json shape.  The round-5 failure this closes: the
+    official capture window was dark, so ``BENCH_r05.json`` fell back to a
+    CPU oracle even though the watcher had banked a real hardware number
+    hours earlier -- the snapshot makes that number exist under a canonical
+    name regardless of when the driver's own window lands."""
+    out_path = os.path.join(outdir, f"{tag}_BENCH_snapshot.json")
+    for src in (ns_path, sm_path):
+        if not _artifact_good(src):
+            continue
+        try:
+            with open(src) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rec["snapshot_of"] = os.path.basename(src)
+        rec["snapshot_utc"] = _utc()
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+        print(f"[tpu_watch] BENCH snapshot -> {out_path} "
+              f"(from {os.path.basename(src)})", flush=True)
+        return out_path
+    return None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=300.0,
@@ -205,6 +234,10 @@ def main(argv=None) -> int:
                                env_extra=env_i,
                                allow_partial=path_i in partial_ok)
                 ran_child = True
+            # any banked north-star number becomes a canonical BENCH-schema
+            # snapshot immediately -- even if this window dies before the
+            # full sequence completes (VERDICT r5 item 7)
+            write_bench_snapshot(outdir, args.tag, ns_path, sm_path)
             if all(_artifact_good(p, p in partial_ok) for p in all_paths):
                 print("[tpu_watch] record captured", flush=True)
                 return 0

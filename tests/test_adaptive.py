@@ -129,6 +129,32 @@ def test_interpret_kernel_classes_match_streamed(blue_8k):
                           ps.get_knearests_original())
 
 
+def test_hbm_budget_demotes_class_to_streamed(blue_8k):
+    """The preflight's DEMOTION arm (ISSUE 2): a class whose launch-scale
+    pack would overflow the HBM budget routes onto the memory-bounded
+    streamed solver instead of launching (or refusing the whole solve) --
+    and the demoted solve still returns the identical exact result."""
+    from cuda_knearests_tpu.ops.adaptive import build_adaptive_plan
+    from cuda_knearests_tpu.ops.gridhash import build_grid
+
+    grid = build_grid(blue_8k)
+    free = KnnConfig(k=9, interpret=True)
+    plan_free = build_adaptive_plan(grid, free, on_kernel_platform=True)
+    assert any(c.use_pallas for c in plan_free.classes)
+
+    tight = KnnConfig(k=9, interpret=True, hbm_budget_bytes=4096)
+    plan_tight = build_adaptive_plan(grid, tight, on_kernel_platform=True)
+    assert not any(c.use_pallas for c in plan_tight.classes), (
+        [(c.qcap_pad, c.ccap, c.route) for c in plan_tight.classes])
+
+    pk = KnnProblem.prepare(blue_8k, free)
+    pd = KnnProblem.prepare(blue_8k, tight)
+    pk.solve()
+    pd.solve()
+    assert np.array_equal(pk.get_knearests_original(),
+                          pd.get_knearests_original())
+
+
 def test_mixed_pallas_and_streamed_classes(monkeypatch):
     """A class whose CANDIDATE axis overflows the VMEM budget streams while
     the background class stays on the kernel -- the per-class routing that

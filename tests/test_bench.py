@@ -208,6 +208,68 @@ def test_enable_compile_cache_env_override_wins(monkeypatch, tmp_path):
     assert jax.config.jax_compilation_cache_dir == path
 
 
+_LIGHT_ENV = dict(JAX_PLATFORMS="cpu", BENCH_NORTH_N="2000",
+                  BENCH_ORACLE_SAMPLE="500", BENCH_BRUTE_SAMPLE="300")
+# every config except the fast kd-tree row: the supervised fault tests need
+# one real row + the north star, not a multi-minute CPU sweep
+_SKIP_HEAVY = sum((["--skip", n] for n in
+                   ("grid_300k_k10", "blue_900k_k20", "batched_300k_k50",
+                    "clustered_300k_adaptive", "sharded_10m_k10")), [])
+
+
+def _rows(stdout: str):
+    return [json.loads(ln) for ln in stdout.splitlines()
+            if ln.startswith("{")]
+
+
+def test_supervised_crash_contained_and_skip_wins():
+    """ACCEPTANCE (ISSUE 2): with an injected worker SIGKILL on one row,
+    ``bench.py --all`` (CPU) completes the remaining rows with rc=0 and
+    emits a FailureRecord of kind 'crash' for the killed row.  Also pins the
+    --skip-vs-auto-quarantine interplay: the manually skipped config is
+    absent from the output entirely (visible only in argv), while the
+    crashed config is stamped with its failure record -- never silently
+    absent."""
+    env = dict(os.environ, **_LIGHT_ENV,
+               KNTPU_FAULT="abort:kdtree_cpu_20k")
+    r = subprocess.run(
+        [sys.executable, BENCH, "--all", *_SKIP_HEAVY],
+        capture_output=True, text=True, timeout=300, env=env)
+    rows = _rows(r.stdout)
+    assert r.returncode == 0, r.stdout + r.stderr
+    crashed = [row for row in rows if row.get("config") == "kdtree_cpu_20k"]
+    assert len(crashed) == 1, rows  # stamped, never silently absent
+    failure = crashed[0]["failure"]
+    assert failure["kind"] == "crash" and failure["signal"] == 9
+    assert failure["attempts"] == 1
+    assert "error" in crashed[0]
+    # the manually skipped configs never appear -- skip wins over everything
+    assert not any(row.get("config") == "grid_300k_k10" for row in rows)
+    # the remaining work (the north star) still completed
+    ns = [row for row in rows if "metric" in row]
+    assert ns and ns[-1]["recall_at_10"] >= 0.999
+    assert "failure" not in ns[-1]
+
+
+def test_supervised_transient_recovers_with_attempts():
+    """ACCEPTANCE (ISSUE 2): an injected transient transport fault on a row
+    recovers via retry/backoff and succeeds, with attempts > 1 recorded on
+    the published row."""
+    env = dict(os.environ, **_LIGHT_ENV,
+               KNTPU_FAULT="transient:kdtree_cpu_20k:1",
+               BENCH_RETRY_BASE_S="0.01")
+    r = subprocess.run(
+        [sys.executable, BENCH, "--all", *_SKIP_HEAVY],
+        capture_output=True, text=True, timeout=300, env=env)
+    rows = _rows(r.stdout)
+    assert r.returncode == 0, r.stdout + r.stderr
+    kd = [row for row in rows if row.get("config", "").startswith("kd_tree")]
+    assert len(kd) == 1, rows
+    assert "error" not in kd[0] and kd[0]["value"] > 0
+    assert kd[0]["attempts"] == 2  # recovered on the second worker
+    assert any("metric" in row for row in rows)  # north star unaffected
+
+
 def test_all_skip_quarantines_row():
     """--all --skip leaves the named configs out (worker-crash quarantine:
     one faulting row must not cost every row after it) and --skip without

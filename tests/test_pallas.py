@@ -98,6 +98,74 @@ def test_vmem_estimate_monotone_and_gate():
     assert not pallas_fits(2048, 8192, 50)
 
 
+def test_hbm_estimate_and_budget_resolution(monkeypatch):
+    """The launch-scale HBM model (ISSUE 2 preflight): monotone in every
+    axis, and the budget resolves config > env > device, with <= 0 meaning
+    unbounded."""
+    from cuda_knearests_tpu.ops.pallas_solve import (hbm_budget_bytes,
+                                                     hbm_bytes_estimate,
+                                                     hbm_fits)
+
+    assert hbm_bytes_estimate(128, 1152, 10, 64) \
+        < hbm_bytes_estimate(128, 1152, 10, 128) \
+        < hbm_bytes_estimate(128, 2304, 10, 128) \
+        < hbm_bytes_estimate(256, 2304, 10, 128)
+    assert hbm_fits(128, 1152, 10, 64, budget=None)  # unbounded: always fits
+    need = hbm_bytes_estimate(128, 1152, 10, 64)
+    assert hbm_fits(128, 1152, 10, 64, budget=need)
+    assert not hbm_fits(128, 1152, 10, 64, budget=need - 1)
+
+    import dataclasses
+
+    cfg = KnnConfig(k=10, hbm_budget_bytes=12345)
+    assert hbm_budget_bytes(cfg) == 12345
+    monkeypatch.setenv("KNTPU_HBM_BUDGET_BYTES", "777")
+    assert hbm_budget_bytes() == 777
+    assert hbm_budget_bytes(cfg) == 12345  # explicit config wins over env
+    assert hbm_budget_bytes(
+        dataclasses.replace(cfg, hbm_budget_bytes=0)) is None  # forced off
+    monkeypatch.setenv("KNTPU_HBM_BUDGET_BYTES", "0")
+    assert hbm_budget_bytes() is None
+    monkeypatch.setenv("KNTPU_HBM_BUDGET_BYTES", "junk")
+    assert hbm_budget_bytes() is None  # malformed knob must not crash
+
+
+def test_preflight_refuses_overbudget_before_grid():
+    """ACCEPTANCE (ISSUE 2): a synthetic over-budget launch is refused with
+    a structured oom-kind error BEFORE the kernel grid (or even the pack) is
+    built -- no process death, and the error carries the numbers a caller
+    needs to demote."""
+    from cuda_knearests_tpu.io import generate_uniform
+    from cuda_knearests_tpu.ops.pallas_solve import preflight_launch
+    from cuda_knearests_tpu.utils.memory import (DeviceMemoryError,
+                                                 LaunchBudgetError)
+
+    with pytest.raises(LaunchBudgetError) as ei:
+        preflight_launch(256, 1152, 10, 64, site="unit", budget=1024)
+    e = ei.value
+    assert e.kind == "oom" and e.budget == 1024 and e.requested > 1024
+    assert "unit" in str(e) and isinstance(e, DeviceMemoryError)
+
+    # the candidate-axis VMEM refusal speaks the same structured language
+    with pytest.raises(LaunchBudgetError) as ei:
+        preflight_launch(128, 1 << 20, 10, 4, site="unit", budget=None)
+    assert ei.value.kind == "oom" and ei.value.budget is not None
+
+    # end-to-end: an explicit-pallas solve against a tiny budget refuses at
+    # the pack-build gate (before any pack allocation or kernel grid),
+    # recoverably
+    pts = generate_uniform(4000, seed=3)
+    cfg = KnnConfig(k=10, backend="pallas", interpret=True, adaptive=False,
+                    hbm_budget_bytes=1024)
+    with pytest.raises(LaunchBudgetError) as ei:
+        KnnProblem.prepare(pts, cfg).solve()
+    assert ei.value.kind == "oom" and ei.value.site == "prepare_pack"
+    # same process, same data, sane budget: solves fine (no poisoned state)
+    p = KnnProblem.prepare(pts, KnnConfig(k=10, backend="pallas",
+                                          interpret=True, adaptive=False))
+    assert np.asarray(p.solve().certified).all()
+
+
 def test_blocked_kernel_matches_kpass():
     """The blocked two-stage kernel (config.kernel='blocked') returns the
     same neighbors as the kpass kernel end-to-end, including where the

@@ -17,6 +17,35 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_supervised_bench_row_end_to_end():
+    """ISSUE 2 satellite: one supervised bench row, end-to-end on CPU,
+    through the real driver (`bench.py --all`, supervision on by default):
+    rc=0 and a well-formed result row from an isolated worker process --
+    the exact capture-path invocation, minus hardware."""
+    skip = sum((["--skip", n] for n in
+                ("grid_300k_k10", "blue_900k_k20", "batched_300k_k50",
+                 "clustered_300k_adaptive", "sharded_10m_k10")), [])
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_NORTH_N="2000",
+               BENCH_ORACLE_SAMPLE="500", BENCH_BRUTE_SAMPLE="300")
+    env.pop("KNTPU_FAULT", None)  # no injected faults: the happy path
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--all", *skip],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.startswith("{")]
+    kd = [r for r in rows if r.get("config", "").startswith("kd_tree")]
+    assert len(kd) == 1, rows
+    row = kd[0]
+    # well-formed BASELINE row: measurement fields present, no failure
+    for field in ("value", "unit", "seconds", "n_points", "platform"):
+        assert field in row, (field, row)
+    assert row["value"] > 0 and "error" not in row and "failure" not in row
+    # the supervised north star landed too, well-formed
+    ns = [r for r in rows if "metric" in r]
+    assert ns and ns[-1]["recall_at_10"] >= 0.999
+
+
 def test_phase_breakdown_smoke_schema():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)  # plain single-device CPU, like the watcher

@@ -10,8 +10,13 @@ import pytest
 from cuda_knearests_tpu import KnnConfig, KnnProblem
 from cuda_knearests_tpu.ops.gridhash import build_grid
 from cuda_knearests_tpu.utils import stats
-from cuda_knearests_tpu.utils.memory import (DeviceMemoryError, from_device,
-                                             nbytes, to_device)
+from cuda_knearests_tpu.utils.memory import (DeviceMemoryError,
+                                             DeviceOOMError,
+                                             LaunchBudgetError,
+                                             TransportError,
+                                             classify_fault_text, from_device,
+                                             nbytes, to_device,
+                                             wrap_device_error)
 from cuda_knearests_tpu.utils.stopwatch import Stopwatch, timed
 
 
@@ -65,6 +70,44 @@ def test_memory_staging_roundtrip():
 def test_memory_staging_rejects_nonfinite():
     with pytest.raises(DeviceMemoryError):
         to_device(np.array([1.0, np.nan], np.float32))
+
+
+def test_fault_taxonomy_hierarchy_and_classification():
+    """TransportError is a distinct, retry-keyable subclass of the
+    DeviceMemoryError hierarchy (ISSUE 2 satellite): UNAVAILABLE /
+    dark-probe error text classifies as 'transport', allocation exhaustion
+    as 'oom', and the kind stamps ride the exception classes so retry
+    policy never string-matches messages."""
+    assert issubclass(TransportError, DeviceMemoryError)
+    assert issubclass(LaunchBudgetError, DeviceMemoryError)
+    assert DeviceMemoryError.kind == "assertion"
+    assert TransportError.kind == "transport"
+    assert LaunchBudgetError.kind == "oom"
+
+    # the dead tunnel's signature (r5_tpu_all_rows.json error rows)
+    assert classify_fault_text(
+        "XlaRuntimeError: UNAVAILABLE: failed to connect") == "transport"
+    assert classify_fault_text("socket closed mid-RPC") == "transport"
+    assert classify_fault_text(
+        "RESOURCE_EXHAUSTED: out of memory on device") == "oom"
+    # transport wins ties: UNAVAILABLE wrapping allocator noise must stay
+    # retryable
+    assert classify_fault_text(
+        "UNAVAILABLE: out of memory downstream") == "transport"
+    assert classify_fault_text("ValueError: shapes mismatch") is None
+
+    wrapped = wrap_device_error(RuntimeError("UNAVAILABLE: tunnel dark"),
+                                "device_put failed")
+    assert isinstance(wrapped, TransportError)
+    assert "device_put failed" in str(wrapped)
+    oom = wrap_device_error(RuntimeError("RESOURCE_EXHAUSTED: 8G > 4G"),
+                            "device_put failed")
+    assert isinstance(oom, DeviceOOMError) and oom.kind == "oom"
+    plain = wrap_device_error(RuntimeError("something else"), "ctx")
+    assert type(plain) is DeviceMemoryError
+
+    e = LaunchBudgetError("too big", requested=100, budget=10, site="s")
+    assert (e.requested, e.budget, e.site, e.kind) == (100, 10, "s", "oom")
 
 
 def test_stopwatch_and_timed():

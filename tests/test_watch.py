@@ -82,6 +82,54 @@ def test_cpu_only_probe_never_counts_as_accelerator(capture, monkeypatch):
     assert calls == []
 
 
+def test_healthy_window_writes_bench_snapshot(capture, monkeypatch):
+    """ISSUE 2 satellite (VERDICT r5 item 7): a healthy window that banked a
+    good north-star artifact must ALSO leave a canonical BENCH-schema
+    snapshot, so a hardware number exists even if the driver's own capture
+    window is dark."""
+    calls, tmp_path = capture
+    monkeypatch.setattr(tpu_watch, "_probe_default_backend", lambda t: "tpu")
+    assert _main(tmp_path) == 0
+    snap_path = os.path.join(str(tmp_path), "t_BENCH_snapshot.json")
+    assert os.path.exists(snap_path)
+    with open(snap_path) as f:
+        snap = json.load(f)
+    # full north star preferred over the smoke-scale artifact
+    assert snap["snapshot_of"] == "t_tpu_north_star.json"
+    assert snap["snapshot_utc"]
+    assert snap["rc"] == 0
+    assert snap["lines"] and snap["lines"][0]["platform"] == "tpu"
+
+
+def test_bench_snapshot_source_preference_and_refusal(tmp_path):
+    """Unit contract of write_bench_snapshot: full north star wins, smoke is
+    the fallback, and no good source means no snapshot file at all (a
+    CPU-fallback or error artifact must never be enshrined as THE number)."""
+    ns = str(tmp_path / "ns.json")
+    sm = str(tmp_path / "sm.json")
+    good = {"rc": 0, "lines": [{"platform": "tpu", "value": 1}]}
+    bad = {"rc": 0, "lines": [{"platform": "cpu", "value": 1}]}
+
+    # nothing good -> refused
+    assert tpu_watch.write_bench_snapshot(str(tmp_path), "x", ns, sm) is None
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           "x_BENCH_snapshot.json"))
+    # only the smoke artifact is good -> snapshot from smoke
+    with open(ns, "w") as f:
+        json.dump(bad, f)
+    with open(sm, "w") as f:
+        json.dump(good, f)
+    out = tpu_watch.write_bench_snapshot(str(tmp_path), "x", ns, sm)
+    with open(out) as f:
+        assert json.load(f)["snapshot_of"] == "sm.json"
+    # the full north star becomes good -> snapshot upgrades to it
+    with open(ns, "w") as f:
+        json.dump(good, f)
+    out = tpu_watch.write_bench_snapshot(str(tmp_path), "x", ns, sm)
+    with open(out) as f:
+        assert json.load(f)["snapshot_of"] == "ns.json"
+
+
 def test_artifact_good_rejects_cpu_fallback_and_errors(tmp_path):
     p = tmp_path / "a.json"
     # rc 0 but platform=cpu: bench's internal fallback must not be enshrined

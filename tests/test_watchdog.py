@@ -14,8 +14,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(code: str, timeout=60):
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+def _run(code: str, timeout=60, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
     return subprocess.run([sys.executable, "-c", code], capture_output=True,
                           text=True, timeout=timeout, cwd=REPO, env=env)
 
@@ -31,6 +31,34 @@ time.sleep(30)  # no heartbeat: the watchdog must kill us long before this
     assert r.returncode == 3, r.stderr
     line = json.loads(r.stdout.strip().splitlines()[-1])
     assert "stall watchdog" in line["error"]
+
+
+def test_stall_dumps_all_thread_tracebacks(tmp_path):
+    """ISSUE 2 satellite: a stall trip must leave EVIDENCE, not just a
+    timeout -- all-thread tracebacks (faulthandler) land in a failure
+    artifact named by the error line, showing where the process was pinned
+    (here: the main thread inside time.sleep)."""
+    r = _run("""
+import os, time
+os.environ["BENCH_STALL_TIMEOUT_S"] = "1"
+from cuda_knearests_tpu.utils import watchdog
+watchdog.start(tag="evidence")
+time.sleep(30)
+""", env_extra={"KNTPU_FAILURE_DIR": str(tmp_path)})
+    assert r.returncode == 3, r.stderr
+    line = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "stall watchdog" in line["error"]
+    assert line["failure_kind"] == "timeout"
+    tb = line["traceback_file"]
+    assert os.path.dirname(tb) == str(tmp_path)
+    content = open(tb).read()
+    assert "stall watchdog trip (evidence)" in content
+    # faulthandler frames: every thread listed, including the pinned main
+    # thread (the -c script is "<string>")
+    assert "most recent call first" in content
+    assert 'File "<string>"' in content
+    # stderr carries a copy (the supervised worker's stderr-tail evidence)
+    assert "Current thread" in r.stderr or "Thread" in r.stderr
 
 
 def test_heartbeat_and_disable_keep_process_alive():
