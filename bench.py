@@ -502,15 +502,30 @@ _ALL_CONFIGS = ("kdtree_cpu_20k", "grid_300k_k10", "blue_900k_k20",
                 "sharded_10m_k10")
 
 
+def _analysis_fields() -> dict:
+    """kntpu-check traceability stamp (ISSUE 3): which static-gate version
+    and accepted-findings baseline the measured tree carries, so every bench
+    row is attributable to a checked tree.  Reads one committed file -- no
+    engine runs, no device involvement."""
+    try:
+        from cuda_knearests_tpu.analysis import analysis_stamp
+
+        return analysis_stamp()
+    except Exception:  # noqa: BLE001 -- never let the stamp kill the output
+        return {}
+
+
 def _env_fields(platform: str) -> dict:
     """platform/n_devices stamp shared by every output line (one schema)."""
+    out = _analysis_fields()
     try:
         import jax
 
-        return {"platform": jax.devices()[0].platform,
-                "n_devices": len(jax.devices())}
+        out.update(platform=jax.devices()[0].platform,
+                   n_devices=len(jax.devices()))
     except Exception:  # noqa: BLE001 -- never let the stamp kill the output
-        return {"platform": platform, "n_devices": 0}
+        out.update(platform=platform, n_devices=0)
+    return out
 
 
 def main(argv=None) -> int:
@@ -625,6 +640,10 @@ def main(argv=None) -> int:
         names = [n for n in _ALL_CONFIGS
                  if not (args.skip and n in args.skip)]
         sup = Supervisor()
+        # workers stamp their own platform; the analysis stamp is a property
+        # of the parent's checked-out tree, so the parent applies it to every
+        # row (failure rows included -- they trace to a tree too)
+        a_fields = _analysis_fields()
         for name in names:
             row, failure = sup.run_job(
                 name, {"job": "bench_config", "name": name})
@@ -634,13 +653,17 @@ def main(argv=None) -> int:
                                 f"[{failure.kind}]: {failure.message}",
                        "failure": failure.to_json(),
                        "platform": platform}
+            row.update(a_fields)
             print(json.dumps(row), flush=True)
         out, failure = sup.run_job("north_star", {"job": "north_star"})
+        if failure is None:
+            out.update(a_fields)
         if failure is not None:
             line = _error_line(
                 f"supervised north-star worker failed "
                 f"[{failure.kind}]: {failure.message}")
             line["failure"] = failure.to_json()
+            line.update(a_fields)  # failure rows trace to a tree too
             print(json.dumps(line), flush=True)
             state["emitted"] = True
             return 1

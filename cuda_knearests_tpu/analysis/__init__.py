@@ -1,0 +1,48 @@
+"""kntpu-check: static contract checker + TPU-hazard lint.
+
+Two engines gate every solve route before it ever touches a chip:
+
+* :mod:`.contracts` -- abstract contract checker: traces the adaptive,
+  legacy-pack, external-query, and sharded per-chip solve routes with
+  ``jax.eval_shape``/``jax.make_jaxpr`` (zero program execution) and
+  verifies shape/dtype invariants, scatter-vs-gather agreement, the HBM
+  preflight's byte model, TPU tile alignment, and trace/recompile hygiene.
+* :mod:`.lint` + :mod:`.rules` -- AST-based TPU-hazard lint (pluggable
+  rule registry): tracer leaks, silent dtype widening, host syncs and jnp
+  construction in host loops, unmarked broad excepts.
+
+One command runs both: ``python -m cuda_knearests_tpu.analysis`` (CPU-only
+by construction; see :mod:`.cli`).  The gate is zero-findings-vs-baseline
+(:mod:`.findings`); tests/test_analysis.py keeps it tier-1.
+
+NOTE: this package deliberately does NOT import jax at import time -- the
+lint half must stay usable (and fast) in tooling contexts with no jax.
+"""
+
+from .findings import (ANALYSIS_VERSION, Finding, analysis_stamp,
+                       baseline_hash, diff_vs_baseline, load_baseline,
+                       save_baseline)
+
+__all__ = [
+    "ANALYSIS_VERSION",
+    "Finding",
+    "analysis_stamp",
+    "baseline_hash",
+    "diff_vs_baseline",
+    "load_baseline",
+    "run_contracts",
+    "run_lint",
+    "save_baseline",
+]
+
+
+def run_lint(paths=None):
+    from .lint import lint_paths
+
+    return lint_paths(paths)
+
+
+def run_contracts(fault=None):
+    from .contracts import run_contracts as _rc
+
+    return _rc(fault=fault)

@@ -1,0 +1,160 @@
+"""``python -m cuda_knearests_tpu.analysis`` -- the one-command gate.
+
+Runs both engines (abstract contract checker + TPU-hazard lint), compares
+against the committed baseline, and exits non-zero on any new finding.
+The whole run is chip-free: main() pins JAX_PLATFORMS=cpu (env + jax
+config, before any backend initializes) and the contract engine refuses
+any other backend.  The pin lives in main(), never at import time, so
+programmatic importers (bench stamping) keep their environment untouched.
+
+Exit codes: 0 clean; 1 contract violation(s); 2 new lint finding(s);
+3 both.  ``--write-baseline`` re-blesses the current findings (a reviewed
+action, never automatic).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .contracts import FAULTS
+from .findings import (ANALYSIS_VERSION, Finding, analysis_stamp,
+                       baseline_hash, diff_vs_baseline, load_baseline,
+                       save_baseline)
+
+
+def _pin_cpu_backend() -> None:
+    """Pin the gate to the cpu backend: the check must run identically on a
+    TPU host and a CPU-only CI runner, and tracing must never acquire an
+    accelerator a colocated worker owns.  The pin OVERWRITES any inherited
+    JAX_PLATFORMS (a bench session's `=tpu` export must not turn the gate's
+    own process into a chip user), and it is called from main() only -- NOT
+    at import time: programmatic importers (bench.py stamping artifact rows)
+    must never have their process environment mutated, since supervised
+    bench workers inherit it verbatim and would silently bench on cpu."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # jax was already imported by the package __init__, so the env var alone
+    # is too late -- re-apply at jax.config level (backend init is lazy, so
+    # this lands in time as long as no engine has run yet)
+    from ..utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+
+def _run(engine: str, paths: Optional[List[str]],
+         fault: Optional[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    if engine in ("lint", "all"):
+        from .lint import lint_paths
+
+        findings.extend(lint_paths(paths))
+    if engine in ("contracts", "all") and paths is None:
+        # an explicit --paths run is a lint-scope override; contracts have
+        # no path scope, so they only join full runs
+        from .contracts import run_contracts
+
+        findings.extend(run_contracts(fault=fault))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cuda_knearests_tpu.analysis",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--engine", choices=("contracts", "lint", "all"),
+                    default="all", help="which engine(s) to run")
+    ap.add_argument("--paths", nargs="+", default=None, metavar="PATH",
+                    help="lint these files/dirs instead of the default "
+                         "scope (skips the contract engine; every rule "
+                         "applies regardless of its path scope -- the "
+                         "fixture-corpus mode)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: the committed "
+                         "analysis/baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-bless the current findings as the baseline "
+                         "and exit 0 (review the diff before committing)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as one JSON object on stdout")
+    ap.add_argument("--fault", choices=FAULTS, default=None,
+                    help="seed one deliberate contract violation (self-"
+                         "test; also via KNTPU_ANALYSIS_FAULT)")
+    args = ap.parse_args(argv)
+    if args.engine == "contracts" and args.paths:
+        # --paths is a lint-scope override; combining it with the contract
+        # engine would run ZERO checks and report a false 'clean'
+        ap.error("--paths scopes the lint engine only; it cannot be "
+                 "combined with --engine contracts (contracts always run "
+                 "over the full route matrix)")
+    if args.paths:
+        # a typo'd or wrong-cwd path must not become a permanently-green
+        # zero-checks run (the same false-clean class as the guards below)
+        missing = [p for p in args.paths if not os.path.exists(p)]
+        if missing:
+            ap.error(f"--paths entries do not exist: {missing}")
+        from .lint import _iter_py_files
+
+        if not _iter_py_files(args.paths):
+            ap.error(f"--paths matched no .py files: {args.paths}")
+    contracts_run = args.engine in ("contracts", "all") and args.paths is None
+    if args.fault and not contracts_run:
+        # a seeded self-test whose fault is never injected would report a
+        # false 'detector fired / tree clean'
+        ap.error("--fault seeds the contract engine, which this invocation "
+                 "does not run (drop --paths / use --engine contracts|all)")
+    if os.environ.get("KNTPU_ANALYSIS_FAULT") and not contracts_run:
+        print("warning: KNTPU_ANALYSIS_FAULT is set but the contract engine "
+              "is not running in this invocation; no fault was seeded",
+              file=sys.stderr)
+
+    _pin_cpu_backend()
+    findings = _run(args.engine, args.paths, args.fault)
+
+    if args.write_baseline:
+        path = save_baseline(findings, args.baseline)
+        print(f"baseline written: {path} "
+              f"({len([f for f in findings if f.severity != 'info'])} "
+              f"accepted findings)")
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    new, stale = diff_vs_baseline(findings, baseline)
+    contract_fail = any(f.path.startswith("route:") for f in new)
+    lint_fail = any(not f.path.startswith("route:") for f in new)
+
+    if args.as_json:
+        print(json.dumps({
+            **analysis_stamp(),
+            "engine": args.engine,
+            "findings": [f.to_json() for f in findings],
+            "new": [f.fingerprint for f in new],
+            "stale_baseline": stale,
+            "ok": not (contract_fail or lint_fail),
+        }, indent=2))
+    else:
+        for f in findings:
+            marker = "NEW " if f in new else ("      " if f.severity == "info"
+                                              else "base  ")
+            print(f"{marker}{f.render()}")
+        if stale:
+            print(f"note: {len(stale)} baseline fingerprint(s) no longer "
+                  f"observed -- tighten the baseline with --write-baseline")
+        n_info = sum(1 for f in findings if f.severity == "info")
+        print(f"kntpu-check v{ANALYSIS_VERSION} "
+              f"(baseline {baseline_hash(args.baseline)}): "
+              f"{len(new)} new finding(s), "
+              f"{len(findings) - n_info} gating total, {n_info} info")
+    if contract_fail and lint_fail:
+        return 3
+    if contract_fail:
+        return 1
+    if lint_fail:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

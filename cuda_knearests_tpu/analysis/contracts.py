@@ -1,0 +1,673 @@
+"""Engine 1: abstract contract checker over every solve route.
+
+"Memory Safe Computations with XLA" (PAPERS.md) observes that resource
+contracts of an XLA program are decidable from the abstract program alone;
+PR 2's HBM preflight exploited that for one launch site.  This module
+generalizes it: every solve route -- the adaptive class solve, the legacy
+pack solve, the external-query launch, and the sharded per-chip solve --
+is traced with ``jax.eval_shape`` / ``jax.make_jaxpr`` against plans the
+real planners build, across a representative :class:`KnnConfig` matrix,
+and machine-checkable contracts are verified with **zero program
+execution**: no kernel is compiled, no solver runs, and the whole check
+passes on a CPU-only host (``JAX_PLATFORMS=cpu``).  The only device
+interaction is staging small constant planning tables onto the host CPU
+backend.
+
+Checked contracts (each a rule id findings report under; full rationale in
+DESIGN.md section 10):
+
+* ``route-shape``     -- every route's abstract outputs are exactly the
+  engine result contract: (n, k) i32 neighbors, (n, k) f32 distances,
+  (n,) bool certificates (+ scalar i32 uncertified count where the route
+  computes it).  A route that fails to trace at all reports here too --
+  that is how a corrupted scatter row map is detected.
+* ``epilogue-agree``  -- the scatter and gather epilogues of the same
+  (route, config) produce identical abstract outputs, and
+  ``resolve_epilogue('auto')`` resolves as documented.
+* ``hbm-model``       -- ``hbm_bytes_estimate`` dominates the abstract
+  byte count of the launch it models (pack blocks + kernel outputs), and
+  ``hbm_fits`` / ``preflight_launch`` agree with the model exactly
+  (fits at the modeled bytes, refuses below them).
+* ``vmem-tile``       -- every kernel-routed capacity obeys the TPU
+  (8, 128) layout floor on the axes the kernel controls (lane axes
+  multiples of 128, sublane axes multiples of 8) or appears in
+  :data:`CONTRACT_WAIVERS` with a reason.
+* ``trace-dtype``     -- no f64/i64 value appears anywhere in a route's
+  jaxpr (silent x64 promotion would double every buffer).
+* ``recompile-key``   -- tracing a route twice against the same plan
+  yields an identical jaxpr (no concrete data baked into the trace), and
+  the census of abstract signatures across data seeds is reported
+  (info-level) so signature-vs-data variance -- the recompile-storm
+  precursor -- is visible per route.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .findings import Finding
+
+# Contract waivers: (rule, subject-key-prefix) -> reason.  The waiver
+# mechanism for engine 1 -- the analog of the lint's `# kntpu-ok` markers,
+# kept in one dict so DESIGN.md section 10 can enumerate it.
+CONTRACT_WAIVERS: Dict[Tuple[str, str], str] = {
+    ("vmem-tile", "k-sublane"): (
+        "k is a sublane (second-minor) axis of the kernel's (1, k, Q) "
+        "output blocks and a lane axis of the row-major (Q, k) blocks; "
+        "Mosaic pads partial tiles itself and vmem_bytes_estimate/"
+        "hbm_bytes_estimate model the padded width (k_pad), so unaligned "
+        "k costs padding, never correctness -- see pallas_guide.md "
+        "'Tiling Constraints'"),
+}
+
+_FAULT_ENV = "KNTPU_ANALYSIS_FAULT"
+FAULTS = ("scatter-map", "hbm-model", "tile-misalign")
+
+_N_POINTS = 400
+_SEEDS = (7, 19)  # two data seeds: census compares their abstract signatures
+
+
+def _fault() -> Optional[str]:
+    return os.environ.get(_FAULT_ENV) or None
+
+
+@dataclasses.dataclass
+class _Checker:
+    fault: Optional[str] = None
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+
+    def fail(self, rule: str, route: str, message: str, hint: str = "",
+             subject: str = "") -> None:
+        self.findings.append(Finding(
+            rule=rule, severity="error", path=f"route:{route}", line=0,
+            message=message, hint=hint, subject=subject or message))
+
+    def info(self, rule: str, route: str, message: str,
+             subject: str = "") -> None:
+        self.findings.append(Finding(
+            rule=rule, severity="info", path=f"route:{route}", line=0,
+            message=message, subject=subject or message))
+
+    def waive(self, rule: str, key: str, route: str, message: str) -> bool:
+        """True (and records an info line) when (rule, key) is waived."""
+        for (r, prefix), reason in CONTRACT_WAIVERS.items():
+            if r == rule and key.startswith(prefix):
+                self.info(rule, route,
+                          f"waived [{key}]: {message} -- {reason}",
+                          subject=f"waived:{key}")
+                return True
+        return False
+
+
+def _points(seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return (1.0 + rng.random((_N_POINTS, 3)) * 998.0).astype(np.float32)
+
+
+def _host_grid(points: np.ndarray, density: float):
+    """Host-side twin of gridhash.build_grid: numpy counting sort, then the
+    tables staged as constants -- no jitted build program runs."""
+    import jax.numpy as jnp
+
+    from ..config import DOMAIN_SIZE, grid_dim_for
+    from ..ops.gridhash import GridHash
+
+    n = points.shape[0]
+    dim = grid_dim_for(n, density)
+    coords = np.clip((points * (dim / DOMAIN_SIZE)).astype(np.int32),
+                     0, dim - 1)
+    cids = coords[:, 0] + dim * (coords[:, 1] + dim * coords[:, 2])
+    order = np.argsort(cids, kind="stable").astype(np.int32)
+    counts = np.bincount(cids, minlength=dim ** 3).astype(np.int32)
+    starts = (np.cumsum(counts) - counts).astype(np.int32)
+    grid = GridHash(points=jnp.asarray(points[order]),
+                    permutation=jnp.asarray(order),
+                    cell_starts=jnp.asarray(starts),
+                    cell_counts=jnp.asarray(counts),
+                    dim=int(dim), domain=float(DOMAIN_SIZE))
+    return grid, counts
+
+
+def _abstract(x):
+    import jax
+
+    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+
+def _nbytes_tree(tree) -> int:
+    import jax
+
+    return sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+def _sig(tree, *statics) -> Tuple:
+    """Recompile key of a traced call: every leaf's (shape, dtype) plus the
+    static arguments -- what jit would key its cache on."""
+    import jax
+
+    leaves = tuple((tuple(l.shape), str(np.dtype(l.dtype)))
+                   for l in jax.tree_util.tree_leaves(tree))
+    return leaves + tuple(statics)
+
+
+def _expect_result(ck: _Checker, route: str, cfg_label: str, out,
+                   n: int, k: int, with_count: bool) -> None:
+    """The route-shape contract: exact output arity/shape/dtype."""
+    want = [((n, k), "int32"), ((n, k), "float32"), ((n,), "bool")]
+    if with_count:
+        want.append(((), "int32"))
+    got = [(tuple(o.shape), str(np.dtype(o.dtype))) for o in out]
+    if got != want:
+        ck.fail("route-shape", route,
+                f"[{cfg_label}] abstract outputs {got} != contract {want}",
+                hint="the route's epilogue or certificate changed shape/"
+                     "dtype; fix the route or update the contract "
+                     "deliberately",
+                subject=f"{route}:shape")
+
+
+def _check_dtypes(ck: _Checker, route: str, cfg_label: str, jaxpr) -> None:
+    """trace-dtype: no 64-bit value anywhere in the traced program."""
+    wide = set()
+
+    def scan(jx):
+        for v in list(jx.invars) + list(jx.outvars) + list(jx.constvars):
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is not None and np.dtype(dt).itemsize == 8:
+                wide.add(str(np.dtype(dt)))
+        for eqn in jx.eqns:
+            for v in list(eqn.invars) + list(eqn.outvars):
+                dt = getattr(getattr(v, "aval", None), "dtype", None)
+                if dt is not None and np.dtype(dt).itemsize == 8:
+                    wide.add(str(np.dtype(dt)))
+            for sub in eqn.params.values():
+                cj = getattr(sub, "jaxpr", None)
+                if cj is not None:
+                    scan(cj)
+
+    scan(jaxpr.jaxpr)
+    if wide:
+        ck.fail("trace-dtype", route,
+                f"[{cfg_label}] 64-bit dtypes {sorted(wide)} appear in the "
+                f"traced program: silent x64 promotion doubles every buffer",
+                hint="pin the widening input to f32/i32 before the jit "
+                     "boundary (the engine's device dtype contract)",
+                subject=f"{route}:dtype")
+
+
+def _check_hbm_model(ck: _Checker, route: str, cfg_label: str, *, qcap: int,
+                     ccap: int, k: int, s_total: int, row_out: bool,
+                     launch_abstract_bytes: int) -> None:
+    """hbm-model: the preflight's byte model dominates the abstract bytes
+    of the launch it gates, and the fit/refuse predicates agree with it."""
+    from ..ops.pallas_solve import hbm_bytes_estimate, hbm_fits, \
+        preflight_launch
+    from ..utils.memory import LaunchBudgetError
+
+    est = hbm_bytes_estimate(qcap, ccap, k, s_total, row_out=row_out)
+    seeded = ck.fault == "hbm-model"
+    if seeded:
+        est = est // 4  # seeded fault: model claims 4x less than it must
+    subj = f"{route}:hbm:{row_out}"
+    if est < launch_abstract_bytes:
+        ck.fail("hbm-model", route,
+                f"[{cfg_label}] hbm_bytes_estimate({qcap}, {ccap}, k={k}, "
+                f"S={s_total}, row_out={row_out}) = {est} is BELOW the "
+                f"abstract launch footprint {launch_abstract_bytes} bytes: "
+                f"the preflight would bless launches that do not fit",
+                hint="the model must be a slight overestimate of every "
+                     "buffer the launch allocates (pack blocks + outputs)",
+                subject=subj)
+    if not hbm_fits(qcap, ccap, k, s_total, row_out=row_out, budget=est):
+        ck.fail("hbm-model", route,
+                f"[{cfg_label}] hbm_fits refuses a budget equal to its own "
+                f"model ({est} bytes): fit predicate and model disagree",
+                subject=subj + ":fits")
+    tight = max(1, (est if not seeded else est * 4) // 2)
+    try:
+        preflight_launch(qcap, ccap, k, s_total, row_out=row_out,
+                         site="analysis", budget=tight)
+        refused = False
+    except LaunchBudgetError:
+        refused = True
+    if not refused:
+        ck.fail("hbm-model", route,
+                f"[{cfg_label}] preflight_launch accepted a {tight}-byte "
+                f"budget for a launch modeled at {est} bytes: the refusal "
+                f"arm is dead",
+                subject=subj + ":preflight")
+
+
+def _check_tiles(ck: _Checker, route: str, cfg_label: str, *, qcap: int,
+                 ccap: int, k: int) -> None:
+    """vmem-tile: lane axes %128, sublane axes %8, or an explicit waiver."""
+    misalign = 4 if ck.fault == "tile-misalign" else 0
+    checks = [
+        ("q-lane", qcap + misalign, 128,
+         "query slot axis rides the 128-wide lane dimension"),
+        ("c-lane", ccap + misalign, 128,
+         "candidate slot axis rides the 128-wide lane dimension"),
+        ("k-sublane", k, 8,
+         "k axis is the sublane dimension of the (1, k, Q) output block"),
+    ]
+    for key, value, mult, why in checks:
+        if value % mult == 0:
+            continue
+        msg = (f"[{cfg_label}] {key}={value} is not a multiple of {mult} "
+               f"({why})")
+        if ck.waive("vmem-tile", key, route, msg):
+            continue
+        ck.fail("vmem-tile", route, msg,
+                hint="round the capacity up at plan time (_round_up / "
+                     "_pack_inputs), or add a reasoned entry to "
+                     "analysis.contracts.CONTRACT_WAIVERS",
+                subject=f"{route}:tile:{key}")
+
+
+# -- per-route checkers -------------------------------------------------------
+
+def _legacy_fixture(points: np.ndarray, k: int, supercell: int):
+    """(grid, plan, abstract pack) for the legacy (non-adaptive) pack route,
+    with no jitted program executed."""
+    import jax
+
+    from ..config import KnnConfig
+    from ..ops.pallas_solve import build_pack
+    from ..ops.solve import build_plan
+
+    cfg = KnnConfig(k=k, supercell=supercell, adaptive=False,
+                    backend="pallas", interpret=True)
+    grid, counts = _host_grid(points, cfg.density)
+    plan = build_plan(grid, cfg, cell_counts_host=counts)
+    pack = jax.eval_shape(build_pack, grid.points, grid.cell_starts,
+                          grid.cell_counts, plan)
+    return cfg, grid, plan, pack
+
+
+def _check_legacy(ck: _Checker, points: np.ndarray, k: int,
+                  supercell: int) -> None:
+    import jax
+
+    from ..ops.pallas_solve import (_pallas_topk, _solve_packed,
+                                    _topk_rows_or_transpose, launch_row_out)
+
+    route = "legacy-pack"
+    label = f"k={k},s={supercell}"
+    cfg, grid, plan, pack = _legacy_fixture(points, k, supercell)
+    n = grid.n_points
+    pts = _abstract(grid.points)
+    outs = {}
+    for ep in ("gather", "scatter"):
+        fn = functools.partial(_solve_packed, k=k, exclude_self=True,
+                               domain=grid.domain, interpret=False,
+                               kernel="kpass", epilogue=ep)
+        try:
+            outs[ep] = jax.eval_shape(fn, pack, pts)
+        except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+            ck.fail("route-shape", route,
+                    f"[{label},ep={ep}] abstract trace failed: "
+                    f"{type(e).__name__}: {e}",
+                    subject=f"{route}:trace:{ep}")
+            continue
+        _expect_result(ck, route, f"{label},ep={ep}", outs[ep], n, k,
+                       with_count=True)
+    if len(outs) == 2 and _sig(outs["gather"]) != _sig(outs["scatter"]):
+        ck.fail("epilogue-agree", route,
+                f"[{label}] scatter and gather epilogues disagree "
+                f"abstractly: {_sig(outs['scatter'])} vs "
+                f"{_sig(outs['gather'])}",
+                hint="both must produce byte-identical results; a layout "
+                     "divergence here means one of them is wrong",
+                subject=f"{route}:epilogue")
+
+    # HBM model vs the abstract bytes of the actual launch, both layouts
+    s_total = pack.s_total
+    blocks = (pack.qx, pack.qy, pack.qz, pack.cx, pack.cy, pack.cz,
+              pack.qid3, pack.cid3)
+    for row_out in (False, True):
+        if row_out and not launch_row_out(pack.qcap, pack.ccap, k,
+                                          "kpass", "scatter"):
+            continue
+        try:
+            if row_out:
+                launch = jax.eval_shape(functools.partial(
+                    _topk_rows_or_transpose, qcap=pack.qcap, ccap=pack.ccap,
+                    k=k, exclude_self=True, interpret=False,
+                    kernel="kpass"), *blocks, q_ok=_abstract(pack.q_ok))
+            else:
+                launch = jax.eval_shape(functools.partial(
+                    _pallas_topk, qcap=pack.qcap, ccap=pack.ccap, k=k,
+                    exclude_self=True, interpret=False), *blocks)
+        except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+            ck.fail("route-shape", route,
+                    f"[{label},row_out={row_out}] launch trace failed: "
+                    f"{type(e).__name__}: {e}",
+                    subject=f"{route}:launch:{row_out}")
+            continue
+        _check_hbm_model(
+            ck, route, f"{label},row_out={row_out}", qcap=pack.qcap,
+            ccap=pack.ccap, k=k, s_total=s_total, row_out=row_out,
+            launch_abstract_bytes=_nbytes_tree(blocks) + _nbytes_tree(launch))
+    _check_tiles(ck, route, label, qcap=pack.qcap, ccap=pack.ccap, k=k)
+
+    # recompile-key: same plan, fresh trace -> identical jaxpr; and the
+    # jaxpr must be value-free (dtype sweep rides the same trace)
+    fn = functools.partial(_solve_packed, k=k, exclude_self=True,
+                           domain=grid.domain, interpret=False,
+                           kernel="kpass", epilogue="gather")
+    try:
+        j1 = jax.make_jaxpr(fn)(pack, pts)
+        j2 = jax.make_jaxpr(fn)(pack, pts)
+    except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+        ck.fail("recompile-key", route,
+                f"[{label}] jaxpr trace failed: {type(e).__name__}: {e}",
+                subject=f"{route}:jaxpr")
+        return
+    if str(j1) != str(j2):
+        ck.fail("recompile-key", route,
+                f"[{label}] two traces of the same abstract inputs yield "
+                f"different jaxprs: the trace depends on something outside "
+                f"its arguments (concrete data or global state) -- every "
+                f"solve would recompile",
+                subject=f"{route}:jaxpr")
+    _check_dtypes(ck, route, label, j1)
+
+
+def _adaptive_fixture(points: np.ndarray, k: int, supercell: int):
+    from ..config import KnnConfig
+    from ..ops.adaptive import build_adaptive_plan
+
+    cfg = KnnConfig(k=k, supercell=supercell, interpret=True)
+    grid, counts = _host_grid(points, cfg.density)
+    plan = build_adaptive_plan(grid, cfg, cell_counts_host=counts,
+                               on_kernel_platform=True, abstract=True)
+    return cfg, grid, plan
+
+
+def _corrupt_scatter_map(plan):
+    """Seeded fault: truncate one class's forward row map -- the shape
+    mismatch a drifted prepare would produce (ClassPlan.tgt rule)."""
+    import jax
+
+    classes = list(plan.classes)
+    cp = classes[0]
+    bad = jax.ShapeDtypeStruct((max(int(cp.tgt.shape[0]) - 8, 1),),
+                               cp.tgt.dtype)
+    classes[0] = dataclasses.replace(cp, tgt=bad)
+    return dataclasses.replace(plan, classes=tuple(classes))
+
+
+def _check_adaptive(ck: _Checker, points: np.ndarray, k: int,
+                    supercell: int) -> None:
+    import jax
+
+    from ..ops.adaptive import _solve_adaptive
+
+    route = "adaptive"
+    label = f"k={k},s={supercell}"
+    cfg, grid, plan = _adaptive_fixture(points, k, supercell)
+    if ck.fault == "scatter-map":
+        plan = _corrupt_scatter_map(plan)
+    n = grid.n_points
+    pts = _abstract(grid.points)
+    starts = _abstract(grid.cell_starts)
+    counts = _abstract(grid.cell_counts)
+    outs = {}
+    for ep in ("gather", "scatter"):
+        fn = functools.partial(_solve_adaptive, k=k, exclude_self=True,
+                               domain=grid.domain, interpret=False,
+                               tile=cfg.stream_tile, kernel="kpass",
+                               epilogue=ep)
+        try:
+            outs[ep] = jax.eval_shape(fn, pts, starts, counts, plan)
+        except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+            ck.fail("route-shape", route,
+                    f"[{label},ep={ep}] abstract trace failed: "
+                    f"{type(e).__name__}: {e}",
+                    hint="a scatter/gather map or class layout no longer "
+                         "matches its plan -- the drift this contract "
+                         "exists to catch before a chip does",
+                    subject=f"{route}:trace:{ep}")
+            continue
+        _expect_result(ck, route, f"{label},ep={ep}", outs[ep], n, k,
+                       with_count=True)
+    if len(outs) == 2 and _sig(outs["gather"]) != _sig(outs["scatter"]):
+        ck.fail("epilogue-agree", route,
+                f"[{label}] scatter and gather epilogues disagree abstractly",
+                subject=f"{route}:epilogue")
+
+    from ..config import resolve_kernel
+    from ..ops.pallas_solve import launch_row_out
+
+    for ci, cp in enumerate(plan.classes):
+        if cp.route != "pallas":
+            continue
+        row_out = launch_row_out(cp.qcap_pad, cp.ccap, k,
+                                 resolve_kernel("kpass", k, cp.ccap),
+                                 "scatter")
+        blocks = (cp.pk.qx, cp.pk.qy, cp.pk.qz, cp.pk.cx, cp.pk.cy,
+                  cp.pk.cz, cp.pk.qid3, cp.pk.cid3)
+        out_elems = cp.n_sc * k * cp.qcap_pad
+        _check_hbm_model(
+            ck, route, f"{label},class={ci}", qcap=cp.qcap_pad, ccap=cp.ccap,
+            k=k, s_total=cp.n_sc, row_out=row_out,
+            launch_abstract_bytes=_nbytes_tree(blocks) + 2 * 4 * out_elems)
+        _check_tiles(ck, route, f"{label},class={ci}", qcap=cp.qcap_pad,
+                     ccap=cp.ccap, k=k)
+
+
+def _query_fixture(grid, plan, supercell: int, m: int = 96):
+    """Host-side twin of ops.query.bucket_queries (no eager device ops)."""
+    from ..ops.solve import _round_up
+
+    rng = np.random.default_rng(23)
+    queries = (1.0 + rng.random((m, 3)) * 998.0).astype(np.float32)
+    dim, domain = grid.dim, grid.domain
+    s_total = plan.n_chunks * plan.batch
+    coords = np.clip((queries * (dim / domain)).astype(np.int32), 0, dim - 1)
+    n_sc = -(-dim // supercell)
+    sc = coords // supercell
+    sid = sc[:, 0] + n_sc * (sc[:, 1] + n_sc * sc[:, 2])
+    order = np.argsort(sid, kind="stable").astype(np.int32)
+    sc_counts = np.bincount(sid, minlength=s_total).astype(np.int32)
+    q2cap = _round_up(int(sc_counts.max()), 128)
+    starts = np.concatenate([[0], np.cumsum(sc_counts)[:-1]]).astype(np.int32)
+    sid_sorted = sid[order]
+    inv_flat = (sid_sorted * q2cap
+                + (np.arange(m) - starts[sid_sorted])).astype(np.int32)
+    return queries, sc_counts, starts, q2cap, inv_flat, \
+        sid_sorted.astype(np.int32)
+
+
+def _check_query(ck: _Checker, points: np.ndarray, k: int,
+                 supercell: int) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.query import _query_packed
+
+    route = "external-query"
+    label = f"k={k},s={supercell}"
+    cfg, grid, plan, pack = _legacy_fixture(points, k, supercell)
+    queries, sc_counts, starts, q2cap, inv_flat, inv_sc = _query_fixture(
+        grid, plan, supercell)
+    m = queries.shape[0]
+    args = (jax.ShapeDtypeStruct((m, 3), jnp.float32),
+            _abstract(starts), _abstract(sc_counts), _abstract(inv_flat),
+            _abstract(inv_sc), pack, plan)
+    outs = {}
+    for ep in ("gather", "scatter"):
+        fn = functools.partial(_query_packed, q2cap=q2cap, k=k,
+                               exclude_hint=False, domain=grid.domain,
+                               interpret=False, epilogue=ep)
+        try:
+            outs[ep] = jax.eval_shape(fn, *args)
+        except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+            ck.fail("route-shape", route,
+                    f"[{label},ep={ep}] abstract trace failed: "
+                    f"{type(e).__name__}: {e}",
+                    subject=f"{route}:trace:{ep}")
+            continue
+        _expect_result(ck, route, f"{label},ep={ep}", outs[ep], m, k,
+                       with_count=False)
+    if len(outs) == 2 and _sig(outs["gather"]) != _sig(outs["scatter"]):
+        ck.fail("epilogue-agree", route,
+                f"[{label}] scatter and gather epilogues disagree abstractly",
+                subject=f"{route}:epilogue")
+    _check_tiles(ck, route, label, qcap=q2cap, ccap=pack.ccap, k=k)
+
+
+def _check_sharded(ck: _Checker, points: np.ndarray, k: int,
+                   supercell: int) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import DOMAIN_SIZE, KnnConfig
+    from ..parallel.sharded import (ShardMeta, _chip_ready_state, _chip_solve,
+                                    _measured_halo_depth, _partition_host,
+                                    _plan_chip, _slab_bounds)
+
+    route = "sharded-chip"
+    label = f"k={k},s={supercell}"
+    cfg = KnnConfig(k=k, supercell=supercell, interpret=True)
+    grid, counts = _host_grid(points, cfg.density)
+    dim, ndev = grid.dim, 2
+    _, _, zcap = _slab_bounds(dim, supercell, ndev)
+    radius = _measured_halo_depth(points, dim, zcap, cfg)
+    radius = min(radius, zcap)
+    _, _, _, pcap, hcap = _partition_host(points, dim, zcap, radius, ndev,
+                                          DOMAIN_SIZE)
+    meta = ShardMeta(ndev=ndev, dim=dim, zcap=zcap, radius=radius,
+                     pcap=pcap, hcap=hcap, domain=DOMAIN_SIZE)
+    # per-chip local cell counts from the global histogram (host-only)
+    counts3 = counts.reshape(dim, dim, dim)
+    counts_all = np.zeros((ndev, zcap * dim * dim), np.int32)
+    for d in range(ndev):
+        lo, hi = d * zcap, min((d + 1) * zcap, dim)
+        if hi > lo:
+            sl = counts3[lo:hi].reshape(-1)
+            counts_all[d, : sl.size] = sl
+    chip = _plan_chip(counts_all, 0, meta, cfg, on_kernel_platform=True)
+
+    A = dim * dim
+    ncell = zcap * A
+    f32, i32 = jnp.float32, jnp.int32
+    sd = jax.ShapeDtypeStruct
+    args = (sd((pcap, 3), f32), sd((pcap,), i32), sd((ncell,), i32),
+            sd((hcap, 3), f32), sd((hcap,), i32), sd((radius * A,), i32),
+            sd((hcap, 3), f32), sd((hcap,), i32), sd((radius * A,), i32))
+    try:
+        state = jax.eval_shape(functools.partial(
+            _chip_ready_state, hcap=hcap, k=k), *args,
+            classes=chip.classes)
+    except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+        ck.fail("route-shape", route,
+                f"[{label}] ready-state trace failed: "
+                f"{type(e).__name__}: {e}",
+                subject=f"{route}:ready")
+        return
+    outs = {}
+    for ep in ("gather", "scatter"):
+        fn = functools.partial(_chip_solve, k=k, exclude_self=True,
+                               domain=DOMAIN_SIZE, interpret=False,
+                               tile=cfg.stream_tile, kernel="kpass",
+                               epilogue=ep)
+        try:
+            outs[ep] = jax.eval_shape(fn, *state)
+        except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+            ck.fail("route-shape", route,
+                    f"[{label},ep={ep}] abstract trace failed: "
+                    f"{type(e).__name__}: {e}",
+                    subject=f"{route}:trace:{ep}")
+            continue
+        _expect_result(ck, route, f"{label},ep={ep}", outs[ep], pcap, k,
+                       with_count=False)
+    if len(outs) == 2 and _sig(outs["gather"]) != _sig(outs["scatter"]):
+        ck.fail("epilogue-agree", route,
+                f"[{label}] scatter and gather epilogues disagree abstractly",
+                subject=f"{route}:epilogue")
+    for ci, cp in enumerate(chip.classes):
+        if cp.route == "pallas":
+            _check_tiles(ck, route, f"{label},class={ci}", qcap=cp.qcap_pad,
+                         ccap=cp.ccap, k=k)
+
+
+def _check_resolution(ck: _Checker) -> None:
+    """epilogue-agree's static half: 'auto' resolves exactly as documented
+    (kernel platforms scatter, hosts gather) -- the single-source rule
+    every route reads through resolved_epilogue()."""
+    from ..config import resolve_epilogue
+
+    if resolve_epilogue("auto", True) != "scatter" \
+            or resolve_epilogue("auto", False) != "gather":
+        ck.fail("epilogue-agree", "config",
+                "resolve_epilogue('auto') no longer maps kernel->scatter, "
+                "host->gather: the documented routing contract broke",
+                subject="config:auto")
+
+
+def _census(ck: _Checker, k: int, supercell: int) -> None:
+    """recompile-key census: does a route's abstract signature depend on
+    data *values* (same shapes, different seed)?  For this engine the
+    answer is yes by design -- capacities are measured from occupancy --
+    so the census reports (info) rather than gates; the report is what
+    makes a future recompile storm visible in CI diffs."""
+    sigs = []
+    for seed in _SEEDS:
+        pts = _points(seed)
+        cfg, grid, plan, pack = _legacy_fixture(pts, k, supercell)
+        sigs.append(_sig(pack, plan.qcap, plan.ccap))
+    route = "legacy-pack"
+    if sigs[0] != sigs[1]:
+        ck.info("recompile-key", route,
+                f"[k={k},s={supercell}] abstract signature varies with data "
+                f"values (occupancy-measured capacities): repeated prepares "
+                f"over shifting data recompile -- expected for this engine, "
+                f"reported so growth shows up in CI diffs",
+                subject=f"{route}:census")
+    else:
+        ck.info("recompile-key", route,
+                f"[k={k},s={supercell}] abstract signature stable across "
+                f"data seeds",
+                subject=f"{route}:census")
+
+
+def run_contracts(fault: Optional[str] = None) -> List[Finding]:
+    """Run every contract over the config matrix.  ``fault`` (or the
+    KNTPU_ANALYSIS_FAULT env knob) seeds one deliberate violation --
+    the self-test hook proving each detector actually fires."""
+    import jax
+
+    fault = fault if fault is not None else _fault()
+    if fault is not None and fault not in FAULTS:
+        raise ValueError(f"unknown analysis fault {fault!r}: "
+                         f"expected one of {FAULTS}")
+    ck = _Checker(fault=fault)
+    if jax.default_backend() != "cpu":
+        # the whole point is a chip-free gate; a non-cpu backend means a
+        # programmatic caller's process already initialized an accelerator
+        # backend (the CLI pins cpu itself).  Reported under its own rule:
+        # this is an environment/usage condition, not a tree contract
+        # violation
+        ck.fail("env-backend", "env",
+                f"contracts must run on the cpu backend "
+                f"(got {jax.default_backend()!r}); set JAX_PLATFORMS=cpu "
+                f"before jax initializes (the CLI does this itself)",
+                subject="env:backend")
+        return ck.findings
+    pts = _points(_SEEDS[0])
+    for k in (8, 50):
+        for supercell in (2, 3):
+            _check_legacy(ck, pts, k, supercell)
+            _check_adaptive(ck, pts, k, supercell)
+            _check_query(ck, pts, k, supercell)
+            _check_sharded(ck, pts, k, supercell)
+    _check_resolution(ck)
+    _census(ck, 8, 3)
+    return ck.findings

@@ -1,0 +1,167 @@
+"""Typed findings + the committed zero-findings-vs-baseline gate.
+
+Both analysis engines (contracts.py, lint.py) emit the same record so one
+gate, one renderer, and one baseline mechanism serve both.  A finding's
+*fingerprint* is deliberately line-number-free (rule id + file + a hash of
+the stripped source line / contract subject): unrelated edits that shift
+line numbers must not churn the committed baseline, or every PR would
+re-bless it and the gate would decay into noise.
+
+The baseline file (``analysis/baseline.json``, committed) lists the
+fingerprints of accepted pre-existing findings; the gate fails on any
+finding NOT in the baseline.  The shipped tree carries an *empty* baseline
+-- every intentional pattern is waived at the site with a reasoned marker
+(``# kntpu-ok: <rule> -- why`` / ``# noqa: BLE001 -- why``) instead of
+being silently absorbed, so the baseline only ever grows under explicit
+``--write-baseline`` review.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Iterable, List, Optional, Tuple
+
+# Version of the analysis subsystem: bump on any rule/contract change so
+# bench artifacts (which stamp it, see bench.py) are traceable to the
+# exact gate a tree passed.
+ANALYSIS_VERSION = "1.0.0"
+
+_BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "baseline.json")
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analysis finding, shared by both engines.
+
+    rule: stable rule/contract id (e.g. 'broad-except', 'hbm-model').
+    severity: 'error' | 'warning' | 'info' (info never gates).
+    path: repo-relative file for lint findings; a route label
+          (e.g. 'route:adaptive') for contract findings.
+    line: 1-based line for lint findings, 0 for contracts.
+    message: what is wrong, concretely.
+    hint: how to fix or waive it.
+    subject: the stripped source line (lint) or contract subject key
+             (contracts) -- the stable half of the fingerprint.
+    """
+
+    rule: str
+    severity: str
+    path: str
+    line: int
+    message: str
+    hint: str = ""
+    subject: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha256(self.subject.encode()).hexdigest()[:12]
+        return f"{self.rule}:{self.path}:{h}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        out = f"{loc}: [{self.rule}] {self.severity}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def gating(findings: Iterable[Finding]) -> List[Finding]:
+    """The findings that participate in the zero-vs-baseline gate ('info'
+    is telemetry, never a failure)."""
+    return [f for f in findings if f.severity != "info"]
+
+
+def indexed_fingerprints(findings: Iterable[Finding]
+                         ) -> List[Tuple[Finding, str]]:
+    """(finding, occurrence-indexed fingerprint) pairs for the gate.
+
+    The base fingerprint is line-free (stable under edits above the site),
+    which makes IDENTICAL source lines in one file collide -- blessing one
+    `except Exception:` must not silently accept every future duplicate.
+    Duplicates get `#1`, `#2`, ... suffixes in (line-)order, so a baseline
+    accepts exactly the COUNT it blessed: adding one more identical hazard
+    produces an unaccepted `#n` and the gate fires."""
+    seen: dict = {}
+    out = []
+    for f in sorted(gating(findings), key=lambda f: (f.path, f.line, f.rule)):
+        base = f.fingerprint
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        out.append((f, base if n == 0 else f"{base}#{n}"))
+    return out
+
+
+def load_baseline(path: Optional[str] = None) -> dict:
+    path = path or _BASELINE_PATH
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        # a missing baseline means 'no accepted findings', not an error --
+        # the gate is simply at its strictest
+        return {"version": ANALYSIS_VERSION, "fingerprints": []}
+    if not isinstance(data.get("fingerprints"), list):
+        raise ValueError(f"malformed baseline {path}: 'fingerprints' must "
+                         f"be a list")
+    return data
+
+
+def save_baseline(findings: Iterable[Finding],
+                  path: Optional[str] = None) -> str:
+    path = path or _BASELINE_PATH
+    data = {
+        "version": ANALYSIS_VERSION,
+        "fingerprints": sorted(fp for _, fp in
+                               indexed_fingerprints(findings)),
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def analysis_stamp() -> dict:
+    """The traceability stamp bench artifacts carry (see bench.py): which
+    gate version and which accepted-findings set the measured tree was
+    checked against.  Lives HERE, not in cli.py, so stamping a bench row
+    never imports the CLI (whose env pin must stay out of a bench parent's
+    environment -- supervised workers inherit it verbatim).  Cheap: reads
+    one file, runs nothing."""
+    return {"analysis_version": ANALYSIS_VERSION,
+            "analysis_baseline": baseline_hash()}
+
+
+def baseline_hash(path: Optional[str] = None) -> str:
+    """Short content hash of the committed baseline -- stamped into bench
+    artifacts so a measured row is traceable to the exact accepted-findings
+    set of the tree it ran on."""
+    path = path or _BASELINE_PATH
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()[:12]
+    except FileNotFoundError:
+        return "none"
+
+
+def diff_vs_baseline(findings: Iterable[Finding],
+                     baseline: Optional[dict] = None
+                     ) -> Tuple[List[Finding], List[str]]:
+    """(new findings not in the baseline, stale baseline fingerprints no
+    longer observed).  The gate fails on the first list; the second is
+    reported so a baseline that has drifted clean can be re-tightened."""
+    baseline = baseline if baseline is not None else load_baseline()
+    accepted = set(baseline.get("fingerprints", []))
+    pairs = indexed_fingerprints(findings)
+    new = [f for f, fp in pairs if fp not in accepted]
+    seen = {fp for _, fp in pairs}
+    stale = sorted(fp for fp in accepted if fp not in seen)
+    return new, stale

@@ -1,0 +1,354 @@
+"""TPU-hazard lint rules (engine 2's pluggable registry).
+
+Each rule is a pure function over one parsed file (:class:`FileContext`)
+yielding :class:`~.findings.Finding` records.  Registration is by
+decorator, so a new hazard class is one function + one decorator -- no
+driver changes (the registry is what makes the engine pluggable).
+
+Waivers are *in-source and reasoned*, never positional: a line carrying
+``# kntpu-ok: <rule-id> -- <why>`` is exempt from exactly that rule, and
+broad-except keeps the repo's pre-existing ``# noqa: BLE001 -- <why>``
+convention (utils/memory.py, utils/watchdog.py).  A waiver without the
+rule id does not count -- the marker is the audit trail.
+
+What the rules know about this codebase's tracing discipline:
+
+* "Inside jit" means lexically inside a function decorated ``@jax.jit``
+  or ``@functools.partial(jax.jit, ...)``.  Helpers that are only
+  *called* from jitted code (e.g. ops/solve.pack_cells) are invisible to
+  static analysis -- the jit-scoped rules are sound on decorated
+  functions and silent elsewhere, never guessing.
+* Statement loops (``for``/``while``) outside jit run per-iteration on
+  the host; the same loop inside jit is unrolled once at trace time, so
+  per-iteration hazards (device allocation, host sync) only apply
+  outside.  Comprehensions are ignored: the codebase uses 3-element
+  generator expressions for per-axis gathers inside traced helpers.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+# -- waiver markers -----------------------------------------------------------
+
+# both marker forms REQUIRE a non-empty rationale after `--`: an unreasoned
+# marker is not a waiver, it is a finding (the reason is the audit trail)
+_WAIVER_RE = re.compile(r"#\s*kntpu-ok:\s*([a-z0-9-]+)\s*--\s*\S")
+_BLE_RE = re.compile(r"#\s*noqa:\s*BLE001\s*--\s*\S")
+
+
+@dataclasses.dataclass
+class FileContext:
+    """One parsed source file plus the derived indexes rules share."""
+
+    path: str            # repo-relative path (what findings report)
+    tree: ast.Module
+    lines: List[str]     # raw source lines (1-based access via line())
+    jit_spans: List[Tuple[int, int]]   # (start, end) lines of jitted defs
+    waivers: Dict[int, Set[str]]       # line -> waived rule ids
+    ble_lines: Set[int]                # lines carrying `# noqa: BLE001`
+
+    def line(self, n: int) -> str:
+        return self.lines[n - 1] if 0 < n <= len(self.lines) else ""
+
+    def in_jit(self, node: ast.AST) -> bool:
+        ln = getattr(node, "lineno", 0)
+        return any(a <= ln <= b for a, b in self.jit_spans)
+
+    def waived(self, rule: str, node: ast.AST) -> bool:
+        ln = getattr(node, "lineno", 0)
+        return rule in self.waivers.get(ln, set())
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """`jax.jit` / bare `jit` as an expression."""
+    return ((isinstance(node, ast.Attribute) and node.attr == "jit")
+            or (isinstance(node, ast.Name) and node.id == "jit"))
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if _is_jax_jit(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        # functools.partial(jax.jit, ...) and jax.jit(fn, ...) forms
+        if _is_jax_jit(dec.func):
+            return True
+        f = dec.func
+        if (isinstance(f, ast.Attribute) and f.attr == "partial"
+                and dec.args and _is_jax_jit(dec.args[0])):
+            return True
+    return False
+
+
+def build_context(path: str, source: str) -> FileContext:
+    tree = ast.parse(source, filename=path)
+    lines = source.splitlines()
+    jit_spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                jit_spans.append((node.lineno, node.end_lineno or node.lineno))
+    waivers: Dict[int, Set[str]] = {}
+    ble_lines: Set[int] = set()
+    for i, text in enumerate(lines, start=1):
+        for m in _WAIVER_RE.finditer(text):
+            waivers.setdefault(i, set()).add(m.group(1))
+        if _BLE_RE.search(text):
+            ble_lines.add(i)
+    return FileContext(path=path, tree=tree, lines=lines, jit_spans=jit_spans,
+                       waivers=waivers, ble_lines=ble_lines)
+
+
+# -- registry -----------------------------------------------------------------
+
+RuleFn = Callable[[FileContext], Iterator[Finding]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    severity: str
+    summary: str
+    check: RuleFn
+    # path substrings the rule applies to (None = everywhere in scope);
+    # measurement scripts legitimately sync/allocate in loops, so the
+    # hot-loop rules scope to the engine package
+    path_filter: Optional[Tuple[str, ...]] = None
+
+    def applies_to(self, path: str) -> bool:
+        if self.path_filter is None:
+            return True
+        return any(s in path for s in self.path_filter)
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, severity: str, summary: str,
+         path_filter: Optional[Tuple[str, ...]] = None):
+    def deco(fn: RuleFn) -> RuleFn:
+        if rule_id in _REGISTRY:
+            raise ValueError(f"duplicate lint rule id {rule_id!r}")
+        _REGISTRY[rule_id] = Rule(rule_id=rule_id, severity=severity,
+                                  summary=summary, check=fn,
+                                  path_filter=path_filter)
+        return fn
+    return deco
+
+
+def all_rules() -> List[Rule]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def _mk(ctx: FileContext, r_id: str, severity: str, node: ast.AST,
+        message: str, hint: str) -> Finding:
+    ln = getattr(node, "lineno", 0)
+    return Finding(rule=r_id, severity=severity, path=ctx.path, line=ln,
+                   message=message, hint=hint,
+                   subject=ctx.line(ln).strip())
+
+
+def _dotted(node: ast.AST) -> str:
+    """'np.float64'-style dotted name for an Attribute/Name chain ('' if
+    the expression is not a plain chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _loops_outside_jit(ctx: FileContext) -> Iterator[ast.AST]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.For, ast.While)) and not ctx.in_jit(node):
+            yield node
+
+
+def _calls_in_loop(loop: ast.AST) -> Iterator[ast.Call]:
+    """Calls executed per iteration: the loop body/orelse, excluding nested
+    function definitions (defining a closure per iteration is cheap; the
+    hazard is *calling* per iteration)."""
+    stack = list(getattr(loop, "body", [])) + list(getattr(loop, "orelse", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# -- rules --------------------------------------------------------------------
+
+@rule("tracer-leak", "error",
+      "host-forcing call (np.*/float()/int()/bool()) inside jitted code")
+def _r_tracer_leak(ctx: FileContext) -> Iterator[Finding]:
+    """Inside a jit-decorated function, ``np.*`` calls and the Python
+    scalar builtins force a concrete value out of a tracer: at best a
+    TracerConversionError at trace time, at worst a silent constant baked
+    into one compile (the recompile-storm seed).  Static args are host
+    Python there too, but this codebase's convention is to resolve them
+    BEFORE the jit boundary (config.resolved_* / effective_*), so any
+    np/int/float/bool call inside a jitted def is suspect."""
+    np_exempt = {"np.dtype", "np.float32", "np.int32", "np.bool_"}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and ctx.in_jit(node)):
+            continue
+        if ctx.waived("tracer-leak", node):
+            continue
+        name = _dotted(node.func)
+        if name.startswith("np.") and name not in np_exempt:
+            yield _mk(ctx, "tracer-leak", "error", node,
+                      f"{name}() inside a jitted function operates on host "
+                      f"values, not tracers",
+                      "use the jnp twin, or hoist the host computation "
+                      "outside the jit boundary")
+        elif name in ("float", "int", "bool") and node.args:
+            # len()/shape arithmetic is trace-static and fine; a direct
+            # cast of a jnp expression is the leak
+            arg = ast.dump(node.args[0])
+            if "jnp" in arg or "lax" in arg:
+                yield _mk(ctx, "tracer-leak", "error", node,
+                          f"{name}() applied to a traced jnp expression "
+                          f"forces a device sync (or a trace error)",
+                          "keep the value on-device, or read it back "
+                          "explicitly with jax.device_get outside the jit")
+
+
+@rule("wide-dtype", "warning",
+      "np.float64/np.int64 widening without an intent marker",
+      path_filter=("cuda_knearests_tpu/ops/", "cuda_knearests_tpu/parallel/",
+                   "cuda_knearests_tpu/utils/", "cuda_knearests_tpu/api.py"))
+def _r_wide_dtype(ctx: FileContext) -> Iterator[Finding]:
+    """f64/i64 on the host is silent 2x width -- fine when chosen (margin
+    certificates accumulate in f64 deliberately; cell linearizations need
+    i64 headroom), a wasteful accident otherwise, and a trace-time
+    surprise when such an array is staged to a device that only computes
+    f32/i32.  Every widening must carry a reasoned waiver so the intent
+    is auditable (the utils/stats.py certificate math is the canonical
+    intentional case)."""
+    wide = {"np.float64", "np.int64"}
+    for node in ast.walk(ctx.tree):
+        name = ""
+        if isinstance(node, ast.Attribute):
+            name = _dotted(node)
+        if name in wide and not ctx.waived("wide-dtype", node):
+            yield _mk(ctx, "wide-dtype", "warning", node,
+                      f"{name} widens beyond the engine's f32/i32 device "
+                      f"dtypes",
+                      "downcast if the width is accidental, or mark the "
+                      "line `# kntpu-ok: wide-dtype -- <why>` if the host-"
+                      "side precision/headroom is intentional")
+
+
+def _maybe_device_arg(call: ast.Call) -> bool:
+    """Heuristic for np.asarray/np.array in a loop: a bare name/attribute
+    argument may be a device array (the implicit-sync hazard); literals and
+    nested host calls are not, and an explicit jax.device_get inside the
+    argument already makes the sync visible (and is flagged itself)."""
+    if not call.args:
+        return False
+    arg = call.args[0]
+    if "device_get" in ast.dump(arg):
+        return False  # explicit readback: the device_get finding covers it
+    return isinstance(arg, (ast.Name, ast.Attribute, ast.Subscript))
+
+
+@rule("host-sync-loop", "warning",
+      "host sync (device_get/block_until_ready/np.asarray) in a host loop",
+      path_filter=("cuda_knearests_tpu/",))
+def _r_host_sync_loop(ctx: FileContext) -> Iterator[Finding]:
+    """A device readback inside a per-class/per-chip/per-supercell host
+    loop serializes the loop on device round trips (each eager readback
+    is a full round trip on remote-tunnel backends -- the api.py fallback
+    dispatch was restructured around exactly this).  Loops that MUST read
+    back per iteration (bounded per-class launch loops) carry a reasoned
+    waiver."""
+    sync_calls = {"jax.device_get", "np.asarray", "np.array"}
+    for loop in _loops_outside_jit(ctx):
+        for call in _calls_in_loop(loop):
+            if ctx.waived("host-sync-loop", call):
+                continue
+            name = _dotted(call.func)
+            is_block = (isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "block_until_ready")
+            if is_block:
+                yield _mk(ctx, "host-sync-loop", "warning", call,
+                          "block_until_ready() inside a host loop "
+                          "serializes the loop on device completion",
+                          "batch the work into one program, or waive with "
+                          "`# kntpu-ok: host-sync-loop -- <why>`")
+            elif name in sync_calls:
+                if name != "jax.device_get" and not _maybe_device_arg(call):
+                    continue
+                yield _mk(ctx, "host-sync-loop", "warning", call,
+                          f"{name}() inside a host loop is a device "
+                          f"round trip per iteration when its argument "
+                          f"lives on device",
+                          "hoist the readback out of the loop (one batched "
+                          "device_get), or waive with "
+                          "`# kntpu-ok: host-sync-loop -- <why>`")
+
+
+@rule("broad-except", "error",
+      "broad `except Exception` without a `# noqa: BLE001` rationale")
+def _r_broad_except(ctx: FileContext) -> Iterator[Finding]:
+    """The failure taxonomy (utils/memory.py) exists so fault policy keys
+    on typed kinds, not swallowed strings; an unmarked broad except hides
+    faults from it.  The marker convention is the repo's existing one:
+    `except Exception:  # noqa: BLE001 -- <why swallowing is safe>`."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        name = _dotted(node.type) if node.type is not None else ""
+        broad = node.type is None or name in ("Exception", "BaseException")
+        if not broad:
+            continue
+        if node.lineno in ctx.ble_lines or ctx.waived("broad-except", node):
+            continue
+        # catching broadly to RE-RAISE (wrapped/classified) is the taxonomy
+        # pattern itself (utils/memory.wrap_device_error), not a swallow
+        if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+            continue
+        what = "bare except:" if node.type is None else f"except {name}:"
+        yield _mk(ctx, "broad-except", "error", node,
+                  f"{what} without a taxonomy marker swallows faults the "
+                  f"supervisor's retry/quarantine policy keys on",
+                  "narrow to the exception types the site can actually "
+                  "handle, or append `# noqa: BLE001 -- <why swallowing "
+                  "is safe>` (utils/watchdog.py convention)")
+
+
+@rule("jnp-in-loop", "warning",
+      "jnp array construction inside a host loop",
+      path_filter=("cuda_knearests_tpu/",))
+def _r_jnp_in_loop(ctx: FileContext) -> Iterator[Finding]:
+    """Each jnp constructor call outside jit allocates a device buffer and
+    dispatches a transfer -- per host-loop iteration that is a dispatch
+    storm (and on remote tunnels, a round trip each).  Prepare-time loops
+    bounded by max_classes carry reasoned waivers; steady-state paths
+    must batch."""
+    ctors = {"array", "asarray", "zeros", "ones", "full", "empty", "arange",
+             "eye", "linspace", "zeros_like", "ones_like", "full_like"}
+    for loop in _loops_outside_jit(ctx):
+        for call in _calls_in_loop(loop):
+            if ctx.waived("jnp-in-loop", call):
+                continue
+            name = _dotted(call.func)
+            mod, _, attr = name.rpartition(".")
+            if mod in ("jnp", "jax.numpy") and attr in ctors:
+                yield _mk(ctx, "jnp-in-loop", "warning", call,
+                          f"{name}() inside a host loop allocates + "
+                          f"transfers one device buffer per iteration",
+                          "build one batched array outside the loop, or "
+                          "waive a bounded prepare-time loop with "
+                          "`# kntpu-ok: jnp-in-loop -- <why>`")

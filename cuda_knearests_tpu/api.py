@@ -353,7 +353,7 @@ def save_problem(problem: KnnProblem, path: str) -> None:
         permutation=from_device(g.permutation),
         cell_starts=from_device(g.cell_starts),
         cell_counts=from_device(g.cell_counts),
-        dim=np.int64(g.dim), domain=np.float64(g.domain),
+        dim=np.int64(g.dim), domain=np.float64(g.domain),  # kntpu-ok: wide-dtype -- on-disk checkpoint schema, never staged to a device
         config_json=np.bytes_(
             __import__("json").dumps(
                 {k: v for k, v in cfg.items() if v is not None}).encode()),

@@ -295,8 +295,17 @@ class AdaptivePlan:
 
 def build_adaptive_plan(grid: GridHash, cfg: KnnConfig,
                         cell_counts_host: np.ndarray | None = None,
-                        on_kernel_platform: bool | None = None) -> AdaptivePlan:
-    """Host planning + one device pass to invert the slot partition."""
+                        on_kernel_platform: bool | None = None,
+                        abstract: bool = False) -> AdaptivePlan:
+    """Host planning + one device pass to invert the slot partition.
+
+    ``abstract=True`` swaps the two jitted prepare programs (the kernel-input
+    prepack and the slot-partition inversion) for ``jax.eval_shape`` of the
+    same functions: the returned plan carries ShapeDtypeStruct leaves for
+    ``pk``/``tgt``/``inv_row``/``inv_box`` and nothing device-side ever runs
+    -- the static contract checker (analysis/contracts.py) traces the solve
+    routes against exactly the plan the real prepare would build, with zero
+    program execution."""
     dim, s, k = grid.dim, cfg.supercell, cfg.k
     counts = (np.asarray(cell_counts_host) if cell_counts_host is not None
               else np.asarray(jax.device_get(grid.cell_counts)))
@@ -320,6 +329,13 @@ def build_adaptive_plan(grid: GridHash, cfg: KnnConfig,
     specs = build_class_specs(own_n, pts_cum, radii_all, cfg,
                               on_kernel_platform)
 
+    # one indirection swaps real prepare execution for abstract tracing --
+    # the planning logic (specs, caps, routes) is shared either way.  The
+    # static args ride a partial: eval_shape abstracts every direct argument
+    # (an int would reach the jit as a tracer and fail the static hash)
+    def run(f, *arrays, **static):
+        g = functools.partial(f, **static)
+        return jax.eval_shape(g, *arrays) if abstract else g(*arrays)
     w = grid.domain / dim
     classes = []
     class_of = np.full((sc.shape[0],), -1, np.int32)
@@ -332,19 +348,22 @@ def build_adaptive_plan(grid: GridHash, cfg: KnnConfig,
         cand = _box_cell_ids(sc_c, -spec.radius, spec.radius, s, dim)
         lo = ((sc_c * s - spec.radius) * w).astype(np.float32)
         hi = ((sc_c * s + s + spec.radius) * w).astype(np.float32)
+        # prepare-time staging, bounded by cfg.max_classes (<= 4) iterations
         cp = ClassPlan(
-            own=jnp.asarray(own), cand=jnp.asarray(cand),
-            lo=jnp.asarray(lo), hi=jnp.asarray(hi),
+            own=jnp.asarray(own), cand=jnp.asarray(cand),    # kntpu-ok: jnp-in-loop -- prepare-time, <= max_classes tables
+            lo=jnp.asarray(lo), hi=jnp.asarray(hi),          # kntpu-ok: jnp-in-loop -- prepare-time, <= max_classes tables
             radius=spec.radius, qcap=spec.qcap, qcap_pad=spec.qcap_pad,
             ccap=spec.ccap, route=spec.route)
         if spec.route == "pallas":
-            cp = dataclasses.replace(cp, pk=_prepack_kernel_inputs(
-                grid.points, grid.cell_starts, grid.cell_counts,
-                cp.own, cp.cand, cp.qcap_pad, cp.ccap))
+            cp = dataclasses.replace(cp, pk=run(
+                _prepack_kernel_inputs, grid.points, grid.cell_starts,
+                grid.cell_counts, cp.own, cp.cand,
+                qcap=cp.qcap_pad, ccap=cp.ccap))
         classes.append(cp)
 
-    inv_row, inv_box, tgts = _invert_partition(
-        tuple(classes), grid.cell_starts, grid.cell_counts, grid.n_points)
+    inv_row, inv_box, tgts = run(
+        _invert_partition, tuple(classes), grid.cell_starts,
+        grid.cell_counts, n=grid.n_points)
     classes = [dataclasses.replace(cp, tgt=t)
                for cp, t in zip(classes, tgts)]
     return AdaptivePlan(classes=tuple(classes), inv_row=inv_row,
@@ -884,7 +903,10 @@ def launch_class_query(points, starts, counts, cp: ClassPlan,
     rows_sorted = rows_sel[order]
     rcounts = np.bincount(rows_sorted, minlength=cp.n_sc).astype(np.int32)
     rstarts = np.concatenate([[0], np.cumsum(rcounts)[:-1]]).astype(np.int32)
-    rank = np.arange(order.size, dtype=np.int64) - rstarts[rows_sorted]
+    # i64 so the rows*q2cap+rank flat index is computed at full width and
+    # range-checked (_query_class refuses > i32) BEFORE the i32 cast -- a
+    # narrow intermediate would wrap first and skip the guard
+    rank = np.arange(order.size, dtype=np.int64) - rstarts[rows_sorted]  # kntpu-ok: wide-dtype -- pre-guard index headroom (see above)
     max_q = int(rcounts.max())
     # kernel lanes need 128-multiples; the other routes take any pow2
     # (bounds recompiles across query sets)
@@ -932,8 +954,10 @@ def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
     s = cfg.supercell
     n_sc = -(-grid.dim // s)
     scc = coords // s
-    sid = (scc[:, 0].astype(np.int64) + n_sc * (scc[:, 1].astype(np.int64)
-           + n_sc * scc[:, 2].astype(np.int64)))
+    # i64 linearization headroom: n_sc^3 passes i32 at dim/supercell ~1290,
+    # inside the 10M+-point roadmap scale -- host-only, indexes host arrays
+    sid = (scc[:, 0].astype(np.int64) + n_sc * (scc[:, 1].astype(np.int64)   # kntpu-ok: wide-dtype -- supercell-id headroom (see above)
+           + n_sc * scc[:, 2].astype(np.int64)))                             # kntpu-ok: wide-dtype -- supercell-id headroom (see above)
     cls_of = np.asarray(jax.device_get(plan.class_of_sc))
     row_of = np.asarray(jax.device_get(plan.row_of_sc))
     qcls, qrow = cls_of[sid], row_of[sid]
@@ -950,9 +974,11 @@ def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
             grid.points, grid.cell_starts, grid.cell_counts, cp,
             queries[sel], qrow[sel], k, cfg, grid.domain)
         sel_sorted = sel[order]
-        out_i[sel_sorted] = np.asarray(jax.device_get(r_i))
-        out_d[sel_sorted] = np.asarray(jax.device_get(r_d))
-        cert[sel_sorted] = np.asarray(jax.device_get(r_c))
+        # per-class readback is inherent here: each class is its own launch
+        # and the loop is bounded by cfg.max_classes (<= 4), not supercells
+        out_i[sel_sorted] = np.asarray(jax.device_get(r_i))  # kntpu-ok: host-sync-loop -- one readback per class launch, <= max_classes
+        out_d[sel_sorted] = np.asarray(jax.device_get(r_d))  # kntpu-ok: host-sync-loop -- one readback per class launch, <= max_classes
+        cert[sel_sorted] = np.asarray(jax.device_get(r_c))   # kntpu-ok: host-sync-loop -- one readback per class launch, <= max_classes
 
     # Exact resolve: classless queries (empty supercells) have no grid route,
     # so they are always brute-forced; uncertified class rows go through the

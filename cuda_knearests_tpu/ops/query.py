@@ -50,8 +50,10 @@ def bucket_queries(queries: np.ndarray, grid: GridHash, supercell: int,
     order = np.argsort(sid, kind="stable").astype(np.int32)
     sc_counts = np.bincount(sid, minlength=s_total).astype(np.int32)
     q2cap = _round_up(int(sc_counts.max()) if sc_counts.size else 1, 128)
-    starts = np.concatenate([[0], np.cumsum(sc_counts)[:-1]]).astype(np.int64)
-    sid_sorted = sid[order].astype(np.int64)
+    # i64 so sid*q2cap+rank is computed at full width before the final i32
+    # cast (same pre-guard headroom rationale as adaptive.launch_class_query)
+    starts = np.concatenate([[0], np.cumsum(sc_counts)[:-1]]).astype(np.int64)  # kntpu-ok: wide-dtype -- pre-cast index headroom (see above)
+    sid_sorted = sid[order].astype(np.int64)                                    # kntpu-ok: wide-dtype -- pre-cast index headroom (see above)
     inv_flat = (sid_sorted * q2cap
                 + (np.arange(order.size) - starts[sid_sorted])).astype(np.int32)
     return (order, sc_counts, starts.astype(np.int32), q2cap, inv_flat,

@@ -70,7 +70,9 @@ def ring_lower_bounds_sq(nmax: int, cell_width: float) -> np.ndarray:
     reference uses (knearests.cu:278-279).  Non-decreasing in r by construction,
     which is what makes "kth_best < bound(r)" a valid stopping rule.
     """
-    r = np.arange(nmax, dtype=np.float64)
+    # f64 on purpose: the bound must stay conservative, so the arithmetic
+    # runs at full host precision and rounds to f32 exactly once at the end
+    r = np.arange(nmax, dtype=np.float64)  # kntpu-ok: wide-dtype -- single terminal round-off (see above)
     d = np.maximum(r - 1.0, 0.0) * cell_width
     return (d * d).astype(np.float32)
 
@@ -108,7 +110,10 @@ def summed_area_table(counts3: np.ndarray) -> np.ndarray:
     build once, query many boxes via box_sums(..., sat=...).  Accepts
     non-cubic windows (the sharded per-chip planner's z-slab case)."""
     dz, dy, dx = counts3.shape
-    sat = np.zeros((dz + 1, dy + 1, dx + 1), dtype=np.int64)
+    # i64 on purpose (and in the docstring contract): prefix sums reach the
+    # total point count, which exceeds i32 at the >2B-point scale the
+    # sharded roadmap targets -- host-only, never staged to a device
+    sat = np.zeros((dz + 1, dy + 1, dx + 1), dtype=np.int64)  # kntpu-ok: wide-dtype -- population prefix sums (see above)
     sat[1:, 1:, 1:] = counts3.cumsum(0).cumsum(1).cumsum(2)
     return sat
 
@@ -151,8 +156,10 @@ def ring_occupancy(counts3: np.ndarray, sc_coords: np.ndarray, supercell: int,
     """
     dim = counts3.shape[0]
     num_sc = sc_coords.shape[0]
-    pts = np.empty((num_sc, rmax + 1), np.int64)
-    cells = np.empty((num_sc, rmax + 1), np.int64)
+    # i64 per the documented contract: cumulative point populations (see
+    # summed_area_table -- same >i32 headroom rationale, host-only)
+    pts = np.empty((num_sc, rmax + 1), np.int64)    # kntpu-ok: wide-dtype -- population sums (see above)
+    cells = np.empty((num_sc, rmax + 1), np.int64)  # kntpu-ok: wide-dtype -- population sums (see above)
     base_lo = sc_coords * supercell
     base_hi = base_lo + supercell
     sat = summed_area_table(counts3)  # one build for all rmax+1 box queries

@@ -52,9 +52,10 @@ def _load() -> Optional[ctypes.CDLL]:
                     ctypes.c_void_p, ctypes.c_int32,
                     ctypes.POINTER(ctypes.c_int32),
                     ctypes.POINTER(ctypes.c_float)]
-        except Exception:
-            # stale/wrong-arch .so or no toolchain: fall back to numpy brute
-            # (cached so a failing `make` isn't re-spawned per oracle)
+        except Exception:  # noqa: BLE001 -- any load failure (no toolchain,
+            # stale/wrong-arch .so, missing symbol) downgrades to the numpy
+            # brute fallback: same semantics, slower -- never an error.  The
+            # False is cached so a failing `make` isn't re-spawned per oracle.
             _lib = False
             return None
         _lib = lib

@@ -97,7 +97,9 @@ def _measured_halo_depth(points: np.ndarray, dim: int, zcap: int,
     s = cfg.supercell
     rmax = min(zcap, int(min(dim, max(6, 2 * default_ring_radius(
         cfg.k, cfg.density)))))
-    coords = np.clip((points * (dim / DOMAIN_SIZE)).astype(np.int64),
+    # i64 coords so the dim^2 linearization product below cannot wrap (i32
+    # passes at dim ~1290, inside the roadmap's scale) -- host-only
+    coords = np.clip((points * (dim / DOMAIN_SIZE)).astype(np.int64),  # kntpu-ok: wide-dtype -- linearization headroom (see above)
                      0, dim - 1)
     lin = coords[:, 0] + dim * coords[:, 1] + dim * dim * coords[:, 2]
     counts3 = np.bincount(lin, minlength=dim ** 3).reshape(dim, dim, dim)
@@ -116,11 +118,16 @@ def _partition_host(points: np.ndarray, dim: int, zcap: int, radius: int,
     bucket_ids (ndev, pcap) i32 original index -1-pad, n_local (ndev,),
     pcap, hcap)."""
     n = points.shape[0]
-    cz = np.clip((points[:, 2] * (dim / domain)).astype(np.int64), 0, dim - 1)
-    chip = np.minimum(cz // zcap, ndev - 1).astype(np.int64)
+    # i32 on purpose (kntpu-check wide-dtype audit): single-axis z-cell and
+    # chip indices stay far below i32 -- the i64 width the first version
+    # carried here was accidental, unlike the linearization products above
+    cz = np.clip((points[:, 2] * (dim / domain)).astype(np.int32), 0, dim - 1)
+    chip = np.minimum(cz // zcap, ndev - 1).astype(np.int32)
     order = np.argsort(chip, kind="stable")
     chip_sorted = chip[order]
-    counts = np.bincount(chip_sorted, minlength=ndev).astype(np.int64)
+    # counts/starts stay i64: per-chip populations cumsum to n, which the
+    # roadmap's >2B-point ambition puts past i32 -- host-only bookkeeping
+    counts = np.bincount(chip_sorted, minlength=ndev).astype(np.int64)  # kntpu-ok: wide-dtype -- population sums (see above)
     starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
     pcap = _round_up(int(counts.max()) if n else 1, 8)
 
@@ -239,8 +246,9 @@ def _window_occupancy(win3: np.ndarray, sc: np.ndarray, s: int, R: int,
     sat = summed_area_table(win3)
     z_valid_lo = max(0, R - zc0)
     z_valid_hi = min(zwin, dim + R - zc0)
-    pts = np.empty((sc.shape[0], rmax + 1), np.int64)
-    cells = np.empty((sc.shape[0], rmax + 1), np.int64)
+    # i64 population sums, same contract as rings.ring_occupancy (host-only)
+    pts = np.empty((sc.shape[0], rmax + 1), np.int64)    # kntpu-ok: wide-dtype -- population sums (see above)
+    cells = np.empty((sc.shape[0], rmax + 1), np.int64)  # kntpu-ok: wide-dtype -- population sums (see above)
     for r in range(rmax + 1):
         lo = base_lo - r
         hi = base_hi + r
@@ -259,8 +267,12 @@ def _window_box_cells(sc: np.ndarray, lo_off: int, hi_off: int, s: int,
     -1 where outside the grid (x/y) or outside the global z range (z).
     Window linearization: x + dim*y + dim^2*zw with zw = local z + R."""
     side = s + hi_off - lo_off
-    offs = np.arange(lo_off, s + hi_off, dtype=np.int64)
-    ax = sc[:, :, None].astype(np.int64) * s + offs[None, None, :]
+    # i64 intermediates so the dim^2 window linearization below cannot wrap
+    # before its terminal i32 cast (the output cell ids are i32 by contract,
+    # which bounds dim^2*zwin < 2^31 -- the headroom covers the arithmetic,
+    # not the result)
+    offs = np.arange(lo_off, s + hi_off, dtype=np.int64)             # kntpu-ok: wide-dtype -- linearization headroom (see above)
+    ax = sc[:, :, None].astype(np.int64) * s + offs[None, None, :]   # kntpu-ok: wide-dtype -- linearization headroom (see above)
     x, y, z = ax[:, 0], ax[:, 1], ax[:, 2] + R       # z into window coords
     okx = (x >= 0) & (x < dim)
     oky = (y >= 0) & (y < dim)
@@ -300,10 +312,12 @@ def _plan_chip(counts_all: np.ndarray, d: int, meta: ShardMeta,
     dim, zcap, R, s = meta.dim, meta.zcap, meta.radius, cfg.supercell
     A = dim * dim
     mk3 = lambda c: c.reshape(zcap, dim, dim)
-    zeros = np.zeros((R, dim, dim), np.int64)
+    # i64 cell counts: the window feeds summed_area_table, whose prefix
+    # sums reach the total population (see rings.summed_area_table)
+    zeros = np.zeros((R, dim, dim), np.int64)                                # kntpu-ok: wide-dtype -- population sums (see above)
     lo3 = (mk3(counts_all[d - 1])[-R:] if d > 0 else zeros)
     hi3 = (mk3(counts_all[d + 1])[:R] if d + 1 < meta.ndev else zeros)
-    win3 = np.concatenate([lo3, mk3(counts_all[d]).astype(np.int64), hi3])
+    win3 = np.concatenate([lo3, mk3(counts_all[d]).astype(np.int64), hi3])   # kntpu-ok: wide-dtype -- population sums (see above)
 
     n_sc_xy = -(-dim // s)
     layers = zcap // s
@@ -343,8 +357,8 @@ def _plan_chip(counts_all: np.ndarray, d: int, meta: ShardMeta,
         lo = ((gsc * s - spec.radius) * w).astype(np.float32)
         hi = ((gsc * s + s + spec.radius) * w).astype(np.float32)
         classes.append(ClassPlan(
-            own=jnp.asarray(own), cand=jnp.asarray(cand),
-            lo=jnp.asarray(lo), hi=jnp.asarray(hi),
+            own=jnp.asarray(own), cand=jnp.asarray(cand),  # kntpu-ok: jnp-in-loop -- prepare-time, <= max_classes tables per chip
+            lo=jnp.asarray(lo), hi=jnp.asarray(hi),        # kntpu-ok: jnp-in-loop -- prepare-time, <= max_classes tables per chip
             radius=spec.radius, qcap=spec.qcap, qcap_pad=spec.qcap_pad,
             ccap=spec.ccap, route=spec.route))
     return ChipPlan(classes=tuple(classes), class_of=class_of, row_of=row_of)
@@ -500,8 +514,8 @@ def save_sharded(problem: "ShardedKnnProblem", path: str) -> None:
     np.savez_compressed(
         path,
         points=problem._points_host,
-        dim=np.int64(problem.meta.dim),
-        n_devices=np.int64(problem.meta.ndev),
+        dim=np.int64(problem.meta.dim),        # kntpu-ok: wide-dtype -- on-disk checkpoint schema (api.save_problem parity)
+        n_devices=np.int64(problem.meta.ndev),  # kntpu-ok: wide-dtype -- on-disk checkpoint schema (api.save_problem parity)
         config_json=np.bytes_(json.dumps(
             {k: v for k, v in cfg.items() if v is not None}).encode()))
 
@@ -795,7 +809,9 @@ class ShardedKnnProblem:
         if m == 0:
             return (np.empty((0, k), np.int32), np.empty((0, k), np.float32))
         dim, s = meta.dim, cfg.supercell
-        coords = np.clip((queries * (dim / meta.domain)).astype(np.int64),
+        # i64 coords: the per-chip scidx linearization below multiplies by
+        # n_sc_xy^2 (same wrap-before-cast headroom as _measured_halo_depth)
+        coords = np.clip((queries * (dim / meta.domain)).astype(np.int64),  # kntpu-ok: wide-dtype -- linearization headroom (see above)
                          0, dim - 1)
         owner = np.minimum(coords[:, 2] // meta.zcap, meta.ndev - 1)
         n_sc_xy = -(-dim // s)
@@ -831,9 +847,11 @@ class ShardedKnnProblem:
                     ext_pts, ext_starts, ext_counts, cp, queries[sel],
                     qrow[qcls == ci], k, cfg, meta.domain, ids_map=ext_ids)
                 sel_sorted = sel[order]
-                out_i[sel_sorted] = np.asarray(jax.device_get(r_i))
-                out_d[sel_sorted] = np.asarray(jax.device_get(r_d))
-                cert[sel_sorted] = np.asarray(jax.device_get(r_c))
+                # one readback per class launch, bounded by max_classes per
+                # chip -- same inherent-per-launch shape as query_adaptive
+                out_i[sel_sorted] = np.asarray(jax.device_get(r_i))  # kntpu-ok: host-sync-loop -- per-class launch readback
+                out_d[sel_sorted] = np.asarray(jax.device_get(r_d))  # kntpu-ok: host-sync-loop -- per-class launch readback
+                cert[sel_sorted] = np.asarray(jax.device_get(r_c))   # kntpu-ok: host-sync-loop -- per-class launch readback
 
         if not cert.all():
             bad = np.nonzero(~cert)[0].astype(np.int32)
@@ -893,7 +911,9 @@ class ShardedKnnProblem:
         chips = []
         for d in self.local_chips():
             inp = self._chip_inputs(d)
-            counts = np.asarray(jax.device_get(inp["counts"]))
+            # diagnostics path: per-chip readbacks are the product here,
+            # and the loop is bounded by the (small) local chip count
+            counts = np.asarray(jax.device_get(inp["counts"]))  # kntpu-ok: host-sync-loop -- per-chip diagnostics readback
             plan = self.chip_plans[d]
             row = {
                 "chip": d,
@@ -914,23 +934,23 @@ class ShardedKnnProblem:
             out = (self._device_out_cache or {}).get(d)
             if out is not None and d in self._ready_cache:
                 (spts, *_rest, lo_rows, hi_rows) = self._ready_cache[d]
-                sids = np.asarray(jax.device_get(inp["sids"]))
+                sids = np.asarray(jax.device_get(inp["sids"]))  # kntpu-ok: host-sync-loop -- per-chip diagnostics readback
                 real = sids >= 0
                 kth = None
                 if self._solved_cache is not None:
-                    kth = np.asarray(
+                    kth = np.asarray(                       # kntpu-ok: host-sync-loop -- _solved_cache is host numpy, no device round trip
                         self._solved_cache[1])[sids[real], -1]
                 else:
-                    cert = np.asarray(jax.device_get(out[2]))[real]
+                    cert = np.asarray(jax.device_get(out[2]))[real]  # kntpu-ok: host-sync-loop -- per-chip diagnostics readback
                     if cert.all():
-                        kth = np.asarray(jax.device_get(out[1]))[real, -1]
+                        kth = np.asarray(jax.device_get(out[1]))[real, -1]  # kntpu-ok: host-sync-loop -- per-chip diagnostics readback
                     else:
                         row["margin_pending_fallback"] = int((~cert).sum())
                 if kth is not None:
                     msq = _margin_sq_np(
-                        np.asarray(jax.device_get(spts))[real],
-                        np.asarray(jax.device_get(lo_rows))[real],
-                        np.asarray(jax.device_get(hi_rows))[real],
+                        np.asarray(jax.device_get(spts))[real],     # kntpu-ok: host-sync-loop -- per-chip diagnostics readback
+                        np.asarray(jax.device_get(lo_rows))[real],  # kntpu-ok: host-sync-loop -- per-chip diagnostics readback
+                        np.asarray(jax.device_get(hi_rows))[real],  # kntpu-ok: host-sync-loop -- per-chip diagnostics readback
                         meta.domain)
                     row["margin"] = margin_summary(kth, msq)
             chips.append(row)
@@ -1004,8 +1024,10 @@ class ShardedKnnProblem:
         for d in sorted(outs):
             if outs[d] is None:
                 continue
-            sids = np.asarray(jax.device_get(self._chip_inputs(d)["sids"]))
-            o_i, o_d, o_c = (np.asarray(jax.device_get(x)) for x in outs[d])
+            # assembly IS one readback per chip slab; the loop is bounded by
+            # ndev and each iteration moves O(n/ndev * k) result bytes
+            sids = np.asarray(jax.device_get(self._chip_inputs(d)["sids"]))   # kntpu-ok: host-sync-loop -- per-chip assembly readback
+            o_i, o_d, o_c = (np.asarray(jax.device_get(x)) for x in outs[d])  # kntpu-ok: host-sync-loop -- per-chip assembly readback
             rows = sids >= 0
             neighbors[sids[rows]] = o_i[rows]
             d2[sids[rows]] = o_d[rows]

@@ -25,7 +25,9 @@ def device_properties() -> List[Dict[str, Any]]:
         stats = {}
         try:
             stats = d.memory_stats() or {}
-        except Exception:  # not all backends expose memory stats
+        except Exception:  # noqa: BLE001 -- not all backends expose memory
+            # stats (and some raise rather than return None); the dump just
+            # omits the memory fields, it must never fail a diagnostics call
             pass
         if "bytes_limit" in stats:
             entry["memory_limit_bytes"] = stats["bytes_limit"]

@@ -31,7 +31,9 @@ def trace(log_dir: str, host_tracer_level: int = 2) -> Iterator[None]:
     try:  # tracer options moved modules across jax versions; both optional
         options = jax.profiler.ProfileOptions()
         options.host_tracer_level = host_tracer_level
-    except Exception:
+    except Exception:  # noqa: BLE001 -- ProfileOptions is version-dependent
+        # sugar: on any shape of absence/rejection the trace below still
+        # captures, just without the host tracer level tweak
         pass
     if options is not None:
         ctx = jax.profiler.trace(log_dir, profiler_options=options)

@@ -61,8 +61,14 @@ def margin_summary(kth_sq: np.ndarray, margin_sq: np.ndarray
     margin (box unconstrained on every axis by the domain boundary) can
     never decertify -> ratio 0.
     """
-    kth = np.asarray(kth_sq, np.float64)
-    msq = np.asarray(margin_sq, np.float64)
+    # Intentional host-side f64: the ratio sqrt(kth/msq) compares two f32
+    # squared distances whose quotient approaches 1.0 exactly where the
+    # diagnostic matters most (near-decertification); computing it in f32
+    # can flip ratio >= 1.0 across the decertified boundary for margins
+    # within ~1 ulp of the kth distance.  Host-only telemetry, never staged
+    # to a device (pinned by tests/test_analysis.py::test_margin_summary_f64).
+    kth = np.asarray(kth_sq, np.float64)    # kntpu-ok: wide-dtype -- f64 certificate telemetry (see above)
+    msq = np.asarray(margin_sq, np.float64)  # kntpu-ok: wide-dtype -- f64 certificate telemetry (see above)
     with np.errstate(divide="ignore", invalid="ignore"):
         ratio = np.sqrt(kth / msq)
     ratio = np.where(np.isinf(msq), 0.0, ratio)     # unconstrained: safe

@@ -57,7 +57,7 @@ def _dump_tracebacks(tag: str) -> str | None:
         path = None
     try:
         faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 -- the exit path must never raise
         pass
     return path
 _lock = threading.Lock()

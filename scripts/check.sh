@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# One-shot static gate: ruff + mypy (when installed) + kntpu-check (always).
+#
+#   scripts/check.sh            # run everything available
+#   scripts/check.sh --strict   # additionally FAIL if ruff/mypy are missing
+#
+# kntpu-check (the committed gate, needs only the runtime deps) runs the
+# abstract contract checker over every solve route plus the TPU-hazard lint,
+# entirely on CPU -- see DESIGN.md section 10.  ruff/mypy are configured in
+# pyproject.toml but are optional tooling: the pinned CI image does not ship
+# them, so their absence is a skip (a note, not a failure) unless --strict.
+set -u
+cd "$(dirname "$0")/.."
+
+strict=0
+[ "${1:-}" = "--strict" ] && strict=1
+
+rc=0
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check =="
+    ruff check cuda_knearests_tpu scripts bench.py || rc=1
+else
+    echo "== ruff: not installed, skipping (configured in pyproject.toml) =="
+    [ "$strict" = 1 ] && rc=1
+fi
+
+if command -v mypy >/dev/null 2>&1; then
+    echo "== mypy =="
+    mypy cuda_knearests_tpu || rc=1
+else
+    echo "== mypy: not installed, skipping (configured in pyproject.toml) =="
+    [ "$strict" = 1 ] && rc=1
+fi
+
+echo "== kntpu-check (contracts + TPU-hazard lint, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.analysis || rc=1
+
+exit $rc

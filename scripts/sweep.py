@@ -46,5 +46,5 @@ for method, sc, batch in itertools.product(["diff", "dot"], [4, 6, 8], [64, 256]
         print(f"method={method} sc={sc} batch={batch}: solve={s*1e3:8.1f} ms "
               f"qps={n/s:10.0f} prep={prep_s*1e3:6.0f} ms {caps} "
               f"cert={float(np.asarray(res.certified).mean()):.4f}")
-    except Exception as e:  # noqa: BLE001
+    except Exception as e:  # noqa: BLE001 -- sweep rows report failures inline and keep sweeping
         print(f"method={method} sc={sc} batch={batch}: FAILED {type(e).__name__}: {e}")

@@ -82,5 +82,5 @@ for name, fn in [("top_k", via_topk), ("kpass", via_kpass),
         else:
             match = float((np.sort(np.asarray(out[1]), -1) == np.sort(ref_i, -1)).mean())
         print(f"{name:14s}: {min(times)*1e3:8.2f} ms  id-match={match:.6f}")
-    except Exception as e:  # noqa: BLE001
+    except Exception as e:  # noqa: BLE001 -- bench rows report failures inline and keep measuring
         print(f"{name:14s}: FAILED {type(e).__name__}: {str(e)[:200]}")

@@ -1,0 +1,8 @@
+"""Lint fixture: broad-except must fire without the marker (never run)."""
+
+
+def swallow(fn):
+    try:
+        return fn()
+    except Exception:  # line 7: no taxonomy marker, no re-raise
+        return None

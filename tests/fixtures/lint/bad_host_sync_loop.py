@@ -1,0 +1,11 @@
+"""Lint fixture: host-sync-loop must fire in the host loop (never run)."""
+import jax
+import numpy as np
+
+
+def drain(chunks, out):
+    for i, c in enumerate(chunks):
+        out[i] = np.asarray(jax.device_get(c))  # line 8: device_get per iter
+        c.block_until_ready()  # line 9: sync per iteration
+        host = np.asarray(c)  # line 10: implicit sync on a device array
+    return out

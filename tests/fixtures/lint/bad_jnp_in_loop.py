@@ -1,0 +1,9 @@
+"""Lint fixture: jnp-in-loop must fire in the host loop (never run)."""
+import jax.numpy as jnp
+
+
+def rebuild(tables):
+    staged = []
+    for t in tables:
+        staged.append(jnp.asarray(t))  # line 8: device alloc per iteration
+    return staged

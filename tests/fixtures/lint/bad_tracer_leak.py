@@ -1,0 +1,20 @@
+"""Lint fixture: tracer-leak must fire inside the jitted body (never run)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def leaky(x):
+    return np.sum(x)  # line 11: np.* on a tracer
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def leaky_cast(x, k):
+    return float(jnp.max(x)) + k  # line 16: float() forces a traced value
+
+
+def host_side_is_fine(x):
+    return np.sum(x)  # not jitted: silent

@@ -1,0 +1,8 @@
+"""Lint fixture: wide-dtype must fire on unmarked f64/i64 (never run)."""
+import numpy as np
+
+
+def widen(x):
+    acc = np.asarray(x, np.float64)  # line 6: unmarked f64 widening
+    idx = np.arange(8, dtype=np.int64)  # line 7: unmarked i64 widening
+    return acc, idx

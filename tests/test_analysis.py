@@ -1,0 +1,404 @@
+"""Tier-1 gate for the kntpu-check analysis subsystem (ISSUE 3).
+
+Three layers, mirroring the subsystem:
+
+* the lint engine against a fixture corpus (every rule fires exactly where
+  a known-bad snippet plants it, stays quiet on waived twins) and against
+  the shipped tree (zero findings vs the committed baseline);
+* the contract engine against the shipped tree (clean) and against every
+  seeded fault (each detector demonstrably fires);
+* the CLI's exit-code contract, including the acceptance criterion that
+  ``python -m cuda_knearests_tpu.analysis`` exits non-zero on a seeded
+  contract violation and a seeded lint hazard, zero on the shipped tree.
+
+Also pins the satellite audits: margin_summary's f64 certificate math and
+the sharded partition's i32 downcast.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+def _lint(path):
+    from cuda_knearests_tpu.analysis.lint import lint_paths
+
+    return lint_paths([os.path.join(FIXTURES, path)])
+
+
+# -- lint engine: fixture corpus ----------------------------------------------
+
+@pytest.mark.parametrize("fixture,rule,lines", [
+    ("bad_tracer_leak.py", "tracer-leak", {11, 16}),
+    ("bad_wide_dtype.py", "wide-dtype", {6, 7}),
+    ("bad_host_sync_loop.py", "host-sync-loop", {8, 9, 10}),
+    ("bad_broad_except.py", "broad-except", {7}),
+    ("bad_jnp_in_loop.py", "jnp-in-loop", {8}),
+])
+def test_rule_fires_exactly_where_planted(fixture, rule, lines):
+    findings = _lint(fixture)
+    assert {f.rule for f in findings} == {rule}, findings
+    assert {f.line for f in findings} == lines, findings
+
+
+def test_waivers_silence_every_rule():
+    assert _lint("clean_waived.py") == []
+
+
+def test_unreasoned_waiver_does_not_silence(tmp_path):
+    """A marker without a `-- <why>` rationale is not a waiver: the reason
+    IS the audit trail the markers exist to carry."""
+    from cuda_knearests_tpu.analysis.lint import lint_paths
+
+    bad = tmp_path / "unreasoned.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "x = np.float64(1.0)  # kntpu-ok: wide-dtype\n"
+        "try:\n"
+        "    pass\n"
+        "except Exception:  # noqa: BLE001\n"
+        "    pass\n")
+    rules = {f.rule for f in lint_paths([str(bad)])}
+    assert rules == {"wide-dtype", "broad-except"}
+
+
+def test_duplicate_hazards_gate_by_count(tmp_path):
+    """Line-free fingerprints collide for identical source lines; the
+    occurrence index makes the baseline accept exactly the blessed COUNT,
+    so one more identical hazard still fires the gate."""
+    from cuda_knearests_tpu.analysis.findings import (diff_vs_baseline,
+                                                      save_baseline)
+    from cuda_knearests_tpu.analysis.lint import lint_paths
+
+    dup = "try:\n    pass\nexcept Exception:\n    pass\n"
+    f = tmp_path / "dups.py"
+    f.write_text(dup * 2)
+    two = lint_paths([str(f)])
+    assert len(two) == 2
+    base = tmp_path / "b.json"
+    save_baseline(two, str(base))
+    from cuda_knearests_tpu.analysis.findings import load_baseline
+
+    bl = load_baseline(str(base))
+    assert len(bl["fingerprints"]) == 2  # both occurrences, distinct
+    new, _ = diff_vs_baseline(two, bl)
+    assert new == []
+    f.write_text(dup * 3)  # one MORE identical hazard
+    new, _ = diff_vs_baseline(lint_paths([str(f)]), bl)
+    assert len(new) == 1
+
+
+def test_findings_are_typed_records():
+    f = _lint("bad_broad_except.py")[0]
+    assert f.rule == "broad-except" and f.severity == "error"
+    assert f.path.endswith("bad_broad_except.py") and f.line == 7
+    assert f.hint and f.fingerprint.startswith("broad-except:")
+    # fingerprints are line-free: an edit above the site must not churn them
+    assert ":7" not in f.fingerprint.rsplit(":", 1)[-1]
+
+
+def test_rule_registry_is_pluggable_and_complete():
+    from cuda_knearests_tpu.analysis.rules import all_rules
+
+    ids = {r.rule_id for r in all_rules()}
+    assert {"tracer-leak", "wide-dtype", "host-sync-loop", "broad-except",
+            "jnp-in-loop"} <= ids
+
+
+# -- lint engine: the shipped tree is clean -----------------------------------
+
+def test_lint_clean_on_shipped_tree():
+    from cuda_knearests_tpu.analysis import diff_vs_baseline, run_lint
+
+    new, _stale = diff_vs_baseline(run_lint())
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+# -- contract engine ----------------------------------------------------------
+
+def test_contracts_clean_on_shipped_tree():
+    from cuda_knearests_tpu.analysis import run_contracts
+
+    bad = [f for f in run_contracts() if f.severity == "error"]
+    assert bad == [], "\n".join(f.render() for f in bad)
+
+
+def test_contracts_report_waiver_and_census():
+    from cuda_knearests_tpu.analysis import run_contracts
+
+    info = [f for f in run_contracts() if f.severity == "info"]
+    # the k-sublane waiver must actually exercise (k=50 configs) and the
+    # recompile census must report -- silence would mean dead checks
+    assert any(f.rule == "vmem-tile" and "waived" in f.message for f in info)
+    assert any(f.rule == "recompile-key" for f in info)
+
+
+@pytest.mark.parametrize("fault,rule", [
+    ("scatter-map", "route-shape"),
+    ("hbm-model", "hbm-model"),
+    ("tile-misalign", "vmem-tile"),
+])
+def test_seeded_fault_is_detected(fault, rule):
+    from cuda_knearests_tpu.analysis import run_contracts
+
+    bad = [f for f in run_contracts(fault=fault) if f.severity == "error"]
+    assert any(f.rule == rule for f in bad), bad
+
+
+def test_unknown_fault_refused():
+    from cuda_knearests_tpu.analysis import run_contracts
+
+    with pytest.raises(ValueError, match="unknown analysis fault"):
+        run_contracts(fault="nonsense")
+
+
+# -- CLI: the acceptance-criterion exit codes ---------------------------------
+
+def _cli(*args, env=None):
+    e = dict(os.environ, JAX_PLATFORMS="cpu")
+    e.update(env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "cuda_knearests_tpu.analysis", *args],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=e)
+
+
+def test_cli_zero_on_shipped_tree():
+    r = _cli()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 new finding(s)" in r.stdout
+
+
+def test_cli_nonzero_on_seeded_contract_violation():
+    r = _cli("--engine", "contracts",
+             env={"KNTPU_ANALYSIS_FAULT": "scatter-map"})
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "route-shape" in r.stdout
+
+
+def test_cli_nonzero_on_seeded_lint_hazard(tmp_path):
+    bad = tmp_path / "hazard.py"
+    bad.write_text("try:\n    x = 1\nexcept Exception:\n    pass\n")
+    r = _cli("--paths", str(bad))
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "broad-except" in r.stdout
+
+
+def test_cli_json_mode(tmp_path):
+    import json
+
+    bad = tmp_path / "hazard.py"
+    bad.write_text("import numpy as np\nx = np.float64(1.0)\n")
+    r = _cli("--paths", str(bad), "--json")
+    assert r.returncode == 2
+    doc = json.loads(r.stdout)
+    assert doc["ok"] is False and doc["analysis_version"]
+    assert doc["findings"][0]["rule"] == "wide-dtype"
+
+
+def test_cli_write_baseline_roundtrip(tmp_path):
+    bad = tmp_path / "hazard.py"
+    bad.write_text("try:\n    x = 1\nexcept Exception:\n    pass\n")
+    base = tmp_path / "baseline.json"
+    r = _cli("--paths", str(bad), "--baseline", str(base),
+             "--write-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    # the blessed finding no longer gates...
+    r = _cli("--paths", str(bad), "--baseline", str(base))
+    assert r.returncode == 0, r.stdout + r.stderr
+    # ...but a fresh hazard still does (zero-vs-baseline, not zero-checks)
+    bad.write_text("try:\n    x = 1\nexcept Exception:\n    pass\n"
+                   "import numpy as np\ny = np.int64(2)\n")
+    r = _cli("--paths", str(bad), "--baseline", str(base))
+    assert r.returncode == 2, r.stdout + r.stderr
+
+
+# -- traceability stamp (bench artifact wiring) -------------------------------
+
+def test_analysis_stamp_fields():
+    from cuda_knearests_tpu.analysis import ANALYSIS_VERSION, analysis_stamp
+
+    stamp = analysis_stamp()
+    assert stamp["analysis_version"] == ANALYSIS_VERSION
+    assert len(stamp["analysis_baseline"]) == 12
+
+
+def test_analysis_stamp_does_not_mutate_environment(monkeypatch):
+    """The stamp is called by bench.py parents whose environment supervised
+    workers inherit verbatim: if stamping pinned JAX_PLATFORMS=cpu, every
+    TPU bench row would silently run on CPU with rc 0."""
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    from cuda_knearests_tpu.analysis import analysis_stamp
+
+    analysis_stamp()
+    assert "JAX_PLATFORMS" not in os.environ
+
+
+def test_cli_refuses_contracts_with_paths(tmp_path):
+    f = tmp_path / "x.py"
+    f.write_text("x = 1\n")
+    r = _cli("--engine", "contracts", "--paths", str(f))
+    # argparse usage error, NOT a silent zero-checks 'clean' pass
+    assert r.returncode == 2 and "cannot be combined" in r.stderr
+
+
+def test_cli_refuses_unseedable_fault(tmp_path):
+    """--fault with an invocation that skips the contract engine would be a
+    self-test that seeds nothing and reports clean."""
+    f = tmp_path / "x.py"
+    f.write_text("x = 1\n")
+    r = _cli("--paths", str(f), "--fault", "hbm-model")
+    assert r.returncode == 2 and "does not run" in r.stderr
+    # env-var form warns instead (external wrappers may export it broadly)
+    r = _cli("--engine", "lint", env={"KNTPU_ANALYSIS_FAULT": "hbm-model"})
+    assert "no fault was seeded" in r.stderr
+
+
+def test_cli_pins_cpu_over_inherited_accelerator_pin():
+    """An inherited JAX_PLATFORMS=tpu export must not make the gate try to
+    acquire a chip (or fail as if the tree were at fault): the CLI
+    overwrites the pin in its own process."""
+    r = _cli("--engine", "lint", env={"JAX_PLATFORMS": "cpu,tpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_bench_rows_carry_analysis_stamp():
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    fields = bench._env_fields("cpu")
+    assert "analysis_version" in fields and "analysis_baseline" in fields
+
+
+# -- satellite audits ---------------------------------------------------------
+
+def test_margin_summary_f64_certificate_math():
+    """Pins the intentional f64 in utils/stats.py:64-65: the decertified
+    boundary (ratio >= 1) must be decided at full host precision, and the
+    documented edge cases must hold exactly."""
+    from cuda_knearests_tpu.utils.stats import margin_summary
+
+    kth = np.array([4.0, 9.0, 16.0, 16.0, 1.0], np.float32)
+    msq = np.array([16.0, 16.0, 16.0, np.inf, 0.0], np.float32)
+    out = margin_summary(kth, msq)
+    assert out["n"] == 5
+    # ratios: 0.5, 0.75, 1.0 (at bound), 0.0 (unconstrained), inf (0 margin)
+    assert out["decertified"] == 2
+    assert out["p50"] == pytest.approx(0.75)
+    # a margin within one f32 ulp BELOW the kth distance must decertify:
+    # f64 keeps the quotient > 1 where f32 arithmetic could collapse it to
+    # exactly 1.0's neighborhood unpredictably
+    kth1 = np.array([np.float32(1.0) + np.float32(1.2e-7)], np.float32)
+    msq1 = np.array([1.0], np.float32)
+    assert margin_summary(kth1, msq1)["decertified"] == 1
+    assert margin_summary(msq1, kth1)["decertified"] == 0
+
+
+def test_partition_host_i32_downcast_matches_i64_reference():
+    """Pins the sharded.py audit downcast: chip bucketing computed in i32
+    matches an independent i64 reference on the same points."""
+    from cuda_knearests_tpu.parallel.sharded import _partition_host
+
+    rng = np.random.default_rng(3)
+    pts = (rng.random((2000, 3)) * 1000.0).astype(np.float32)
+    dim, zcap, radius, ndev, domain = 9, 5, 2, 2, 1000.0
+    _, bucket_ids, n_local, _, _ = _partition_host(
+        pts, dim, zcap, radius, ndev, domain)
+    cz = np.clip((pts[:, 2].astype(np.float64) * (dim / domain))
+                 .astype(np.int64), 0, dim - 1)
+    chip_ref = np.minimum(cz // zcap, ndev - 1)
+    ref_counts = np.bincount(chip_ref, minlength=ndev)
+    assert np.array_equal(n_local, ref_counts.astype(np.int32))
+    for d in range(ndev):
+        got = np.sort(bucket_ids[d][: n_local[d]])
+        want = np.sort(np.nonzero(chip_ref == d)[0].astype(np.int32))
+        assert np.array_equal(got, want)
+
+
+def test_cli_refuses_empty_paths(tmp_path):
+    r = _cli("--paths", str(tmp_path / "typo_dir"))
+    assert r.returncode == 2 and "do not exist" in r.stderr
+    empty = tmp_path / "no_py"
+    empty.mkdir()
+    r = _cli("--paths", str(empty))
+    assert r.returncode == 2 and "matched no .py files" in r.stderr
+
+
+def test_host_grid_twin_matches_build_grid():
+    """The contract engine plans against _host_grid's numpy twin of
+    gridhash.build_grid; drift between them would make the gate trace a
+    fiction while staying green -- pin table-for-table equality."""
+    import jax
+
+    from cuda_knearests_tpu.analysis.contracts import _host_grid
+    from cuda_knearests_tpu.config import DEFAULT_CELL_DENSITY
+    from cuda_knearests_tpu.ops.gridhash import build_grid
+
+    rng = np.random.default_rng(5)
+    pts = (1.0 + rng.random((500, 3)) * 998.0).astype(np.float32)
+    twin, counts = _host_grid(pts, DEFAULT_CELL_DENSITY)
+    real = build_grid(pts)
+    assert twin.dim == real.dim and twin.domain == real.domain
+    for name in ("points", "permutation", "cell_starts", "cell_counts"):
+        a = np.asarray(jax.device_get(getattr(twin, name)))
+        b = np.asarray(jax.device_get(getattr(real, name)))
+        assert np.array_equal(a, b), name
+    assert np.array_equal(counts, np.asarray(
+        jax.device_get(real.cell_counts)))
+
+
+def test_query_fixture_twin_matches_bucket_queries():
+    """Same parity pin for the external-query route's host bucketing twin
+    (_query_fixture vs ops.query.bucket_queries)."""
+    from cuda_knearests_tpu.analysis.contracts import (_legacy_fixture,
+                                                       _points,
+                                                       _query_fixture)
+    from cuda_knearests_tpu.ops.query import bucket_queries
+
+    _cfg, grid, plan, _pack = _legacy_fixture(_points(7), 8, 3)
+    queries, sc_counts, starts, q2cap, inv_flat, inv_sc = _query_fixture(
+        grid, plan, 3)
+    order, r_counts, r_starts, r_q2cap, r_inv, r_sid = bucket_queries(
+        queries, grid, 3, plan.n_chunks * plan.batch)
+    assert q2cap == r_q2cap
+    assert np.array_equal(sc_counts, r_counts)
+    assert np.array_equal(starts, r_starts)
+    assert np.array_equal(inv_flat, r_inv)
+    assert np.array_equal(inv_sc, r_sid)
+
+
+def test_adaptive_abstract_plan_matches_concrete_shapes():
+    """The abstract=True prepare (what the contract engine traces against)
+    must mirror the real prepare exactly: same classes, same caps, same
+    routes, same pk/tgt shapes -- drift here would make the gate check a
+    fiction."""
+    import jax
+
+    from cuda_knearests_tpu.analysis.contracts import _host_grid
+    from cuda_knearests_tpu.config import KnnConfig
+    from cuda_knearests_tpu.ops.adaptive import build_adaptive_plan
+
+    rng = np.random.default_rng(11)
+    pts = (1.0 + rng.random((300, 3)) * 998.0).astype(np.float32)
+    cfg = KnnConfig(k=8, interpret=True)
+    grid, counts = _host_grid(pts, cfg.density)
+    real = build_adaptive_plan(grid, cfg, cell_counts_host=counts,
+                               on_kernel_platform=True)
+    abst = build_adaptive_plan(grid, cfg, cell_counts_host=counts,
+                               on_kernel_platform=True, abstract=True)
+    assert len(real.classes) == len(abst.classes)
+    for rc, ac in zip(real.classes, abst.classes):
+        assert (rc.route, rc.qcap, rc.qcap_pad, rc.ccap, rc.radius) == \
+            (ac.route, ac.qcap, ac.qcap_pad, ac.ccap, ac.radius)
+        r_leaves = jax.tree_util.tree_leaves((rc.pk, rc.tgt))
+        a_leaves = jax.tree_util.tree_leaves((ac.pk, ac.tgt))
+        assert [(l.shape, np.dtype(l.dtype)) for l in r_leaves] == \
+            [(l.shape, np.dtype(l.dtype)) for l in a_leaves]
+    assert real.inv_row.shape == abst.inv_row.shape
