@@ -515,9 +515,23 @@ def _analysis_fields() -> dict:
         return {}
 
 
+def _fuzz_fields() -> dict:
+    """Fuzz-corpus traceability stamp (ISSUE 4): how many banked adversarial
+    regression cases (tests/corpus/*.npz) the measured tree replays, so a
+    bench row is attributable to a fuzz-covered tree.  One listdir -- no
+    engine runs, no device involvement."""
+    try:
+        from cuda_knearests_tpu.fuzz import corpus_size
+
+        return {"fuzz_corpus_size": corpus_size()}
+    except Exception:  # noqa: BLE001 -- never let the stamp kill the output
+        return {}
+
+
 def _env_fields(platform: str) -> dict:
     """platform/n_devices stamp shared by every output line (one schema)."""
     out = _analysis_fields()
+    out.update(_fuzz_fields())
     try:
         import jax
 

@@ -328,6 +328,40 @@ def _r_broad_except(ctx: FileContext) -> Iterator[Finding]:
                   "is safe>` (utils/watchdog.py convention)")
 
 
+@rule("bare-valueerror", "error",
+      "bare ValueError raise on an input-validation path (use the typed "
+      "input-contract taxonomy)",
+      path_filter=("cuda_knearests_tpu/io.py", "cuda_knearests_tpu/api.py",
+                   "cuda_knearests_tpu/parallel/"))
+def _r_bare_valueerror(ctx: FileContext) -> Iterator[Finding]:
+    """The input front door (io.validate_or_raise) exists so that illegal
+    input is refused with the TYPED taxonomy (utils/memory.py
+    InputContractError subclasses, kind='invalid-input') that the CLI's
+    rc-5 path, the supervisor's FailureRecord, and classify_fault_text all
+    key on.  A bare ``raise ValueError(...)`` on these paths silently
+    opts the refusal out of all three.  Raises that are genuinely not
+    input validation (internal invariants, runtime topology contracts)
+    carry a reasoned ``# kntpu-ok: bare-valueerror -- <why>`` waiver."""
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Raise) and node.exc is not None):
+            continue
+        exc = node.exc
+        if isinstance(exc, ast.Call):
+            exc = exc.func
+        if _dotted(exc) != "ValueError":
+            continue
+        if ctx.waived("bare-valueerror", node):
+            continue
+        yield _mk(ctx, "bare-valueerror", "error", node,
+                  "bare ValueError on an input-validation path bypasses "
+                  "the typed input-contract taxonomy (no kind stamp, no "
+                  "rc-5 mapping, no 'invalid-input' classification)",
+                  "raise the matching utils.memory InputContractError "
+                  "subclass (InvalidShapeError/NonFiniteInputError/"
+                  "InvalidKError/...), or waive a non-input raise with "
+                  "`# kntpu-ok: bare-valueerror -- <why>`")
+
+
 @rule("jnp-in-loop", "warning",
       "jnp array construction inside a host loop",
       path_filter=("cuda_knearests_tpu/",))

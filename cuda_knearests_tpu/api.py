@@ -33,7 +33,7 @@ from .ops.gridhash import GridHash, build_grid, unpermute_neighbors
 from .ops.solve import (KnnResult, SolvePlan, brute_force_by_index, build_plan,
                         solve)
 from .utils import stats as _stats
-from .utils.memory import from_device
+from .utils.memory import InvalidKError, from_device
 
 
 def radius_mask_from_knn(ids: np.ndarray, d2: np.ndarray, radius: float,
@@ -94,15 +94,22 @@ class KnnProblem:
         Like kn_prepare (knearests.cu:235-344), input points must satisfy the
         [0, domain]^3 contract (io.normalize_points enforces it) -- but where
         the reference silently clamps out-of-range points into boundary cells
-        (knearests.cu:26-28), this fails fast with a fix pointer.
+        (knearests.cu:26-28), this fails fast with a fix pointer: the
+        io.validate_or_raise front door raises the typed input taxonomy
+        (kind 'invalid-input').  n = 0 and k > n are legal degraded modes
+        (empty results / -1-inf-padded rows), not errors.
         """
-        from .io import validate_points
+        from .io import validate_or_raise
 
         config = config or KnnConfig()
-        points = validate_points(points) if validate else np.asarray(
-            points, np.float32)
+        points = (validate_or_raise(points, k=config.k) if validate
+                  else np.asarray(points, np.float32))
         grid = build_grid(points, dim=dim, density=config.density)
         problem = cls(grid=grid, config=config)
+        if grid.n_points == 0:
+            # empty cloud: nothing to plan -- solve()/query() short-circuit
+            # to empty / all-invalid results (degraded mode, DESIGN.md s11)
+            return problem
         # one planning pass: adaptive problems use the aplan for both solve()
         # and query(); the legacy plan/pack exist only for non-adaptive
         # configs; the oracle backend plans nothing (the kd-tree IS the
@@ -142,6 +149,15 @@ class KnnProblem:
         /root/reference/test_knearests.cu:194-214) promoted to a first-class
         engine, and the fastest exact CPU route (measured 3-5x the grid's
         dense route on the 900k north star, DESIGN.md section 5)."""
+        if self.grid.n_points == 0:
+            # degraded mode: an empty cloud solves to empty, fully-certified
+            # results (there is nothing a neighbor table could miss)
+            k = self.config.k
+            self.result = KnnResult(
+                neighbors=np.empty((0, k), np.int32),
+                dists_sq=np.empty((0, k), np.float32),
+                certified=np.empty((0,), bool))
+            return self.result
         if self.config.backend == "oracle":
             ids, d2 = self._oracle.knn_all_points(self.config.k) \
                 if self.config.exclude_self else self._oracle.knn(
@@ -206,11 +222,19 @@ class KnnProblem:
         Returns ((m, k) neighbor ids in original indexing, ascending by
         distance; (m, k) squared distances).
         """
-        k = self.config.k if k is None else int(k)
+        from .io import validate_or_raise
+
+        k = self.config.k if k is None else k
+        queries = validate_or_raise(queries, k=k, what="queries")
+        k = int(k)
         if k > self.config.k:
-            raise ValueError(
+            raise InvalidKError(
                 f"k={k} exceeds the prepared k={self.config.k}; re-prepare "
                 f"with a larger config.k (it sizes the candidate dilation)")
+        if self.grid.n_points == 0:
+            # degraded mode: no stored points -> every row is all -1/inf
+            return (np.full((queries.shape[0], k), -1, np.int32),
+                    np.full((queries.shape[0], k), np.inf, np.float32))
         if self.config.backend == "oracle":
             # sorted-index results from the tree over sorted storage ->
             # original ids via the permutation (the query contract)
@@ -263,7 +287,7 @@ class KnnProblem:
         """
         cap = self.config.k if max_neighbors is None else int(max_neighbors)
         if cap > self.config.k:
-            raise ValueError(
+            raise InvalidKError(
                 f"max_neighbors={cap} exceeds the prepared k={self.config.k}")
         ids, d2 = self.query(queries, k=cap)
         return radius_mask_from_knn(ids, d2, radius, cap)

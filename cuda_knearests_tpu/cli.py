@@ -108,10 +108,26 @@ def main(argv=None) -> int:
 
     print_device_properties()
 
-    if os.path.exists(args.points):
-        points = normalize_points(load_xyz(args.points))
-    else:
-        points = get_dataset(args.points)
+    # Classified refusal containment, one machine-readable shape for both
+    # exits: rc 5 for input-contract violations (io.validate_or_raise and
+    # friends -- bad file, NaN coordinates, illegal k; deterministic,
+    # caller must fix the input) and rc 4 for classified device errors
+    # (preflight refusals, transport death -- the PR 2 path).
+    from .utils.memory import InputContractError
+
+    def _refuse(e, summary: dict, rc: int) -> int:
+        summary.update(error=str(e), failure_kind=e.kind)
+        print(json.dumps(summary), flush=True)
+        print(f"REFUSED [{e.kind}]: {e}", file=sys.stderr, flush=True)
+        return rc
+
+    try:
+        if os.path.exists(args.points):
+            points = normalize_points(load_xyz(args.points))
+        else:
+            points = get_dataset(args.points)
+    except InputContractError as e:
+        return _refuse(e, {"k": args.k, "platform": platform}, 5)
     n = points.shape[0]
     print(f"loaded {n} points -> [0,1000]^3")
 
@@ -164,11 +180,13 @@ def main(argv=None) -> int:
             problem.print_stats()
             neighbors = problem.get_knearests_original()
             perm = problem.get_permutation()
+    except InputContractError as e:
+        # before DeviceMemoryError: NonFiniteInputError is both taxonomies,
+        # and the input-contract reading (rc 5, caller must fix the input)
+        # is the actionable one
+        return _refuse(e, summary, 5)
     except DeviceMemoryError as e:
-        summary.update(error=str(e), failure_kind=e.kind)
-        print(json.dumps(summary), flush=True)
-        print(f"REFUSED [{e.kind}]: {e}", file=sys.stderr, flush=True)
-        return 4
+        return _refuse(e, summary, 4)
 
     # device work done; the remaining phases (oracle, tie analysis) are
     # local CPU and may legitimately exceed the stall limit at k=50

@@ -1,0 +1,52 @@
+"""Adversarial-input fuzzing subsystem: the differential campaign that
+attacks the engine's exactness promise with hostile inputs.
+
+The reference is only correct "assuming the ring budget sufficed" on
+uniformly random points (its README calls it a toy for uniform data); this
+framework promises exact answers with per-query certificates on ANY legal
+input.  This package is what holds that promise to account:
+
+* :mod:`generators` -- a zoo of adversarial point distributions
+  (all-coincident, duplicate-heavy lattices, collinear/coplanar, power-law
+  clusters, grid-plane-aligned, denormal/huge magnitudes, zero-extent axes,
+  degenerate sizes, extreme aspect ratios), each tagged with the hazard it
+  targets.  Cases are regenerable from a (generator, seed, n, k) spec.
+* :mod:`routes` -- uniform runners for all four solve routes (adaptive,
+  legacy pack, external query, sharded per-chip) plus the seeded-fault
+  injector (``KNTPU_FUZZ_FAULT``) that proves the harness detects breakage.
+* :mod:`compare` -- tie-aware differential comparison against the
+  kd-tree/brute oracle: equal-distance neighbor sets, not index equality.
+* :mod:`minimize` -- a delta-debugging auto-minimizer that shrinks any
+  failing case to a minimal point set.
+* :mod:`campaign` -- the driver (``python -m cuda_knearests_tpu.fuzz``):
+  runs every case through every route, banks minimized failures into the
+  replayed regression corpus ``tests/corpus/*.npz``, and writes a campaign
+  manifest.  Under case isolation each case runs in a PR-2 supervisor
+  worker, so a worker crash banks the case and the campaign continues.
+
+See DESIGN.md section 11 for the input contract, degraded-mode semantics,
+and the corpus replay policy.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: Where minimized failing cases are banked and replayed from (tier-1:
+#: tests/test_fuzz.py replays every entry).
+CORPUS_DIR = os.path.join(_REPO_ROOT, "tests", "corpus")
+
+
+def corpus_size(corpus_dir: str | None = None) -> int:
+    """Number of banked regression cases (``tests/corpus/*.npz``).  Cheap --
+    one listdir, no jax import -- so bench rows can stamp it."""
+    d = corpus_dir or CORPUS_DIR
+    if not os.path.isdir(d):
+        return 0
+    return sum(1 for f in os.listdir(d) if f.endswith(".npz"))
+
+
+__all__ = ["CORPUS_DIR", "corpus_size"]

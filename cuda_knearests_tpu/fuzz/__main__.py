@@ -1,0 +1,106 @@
+"""CLI for the differential fuzz campaign.
+
+    python -m cuda_knearests_tpu.fuzz --cases 256 --seed 0
+    KNTPU_FUZZ_CASES=512 scripts/check.sh        # the CI smoke's deep knob
+
+Exit codes: 0 = campaign clean (zero unwaived route-vs-oracle failures),
+1 = failures found (each minimized and banked into the corpus),
+2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _parse_budget(text):
+    if text is None:
+        return None
+    t = str(text).strip().lower()
+    if t.endswith("s"):
+        t = t[:-1]
+    return float(t)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cuda_knearests_tpu.fuzz",
+        description="Adversarial differential fuzz campaign: every "
+                    "generator-zoo case through all four solve routes "
+                    "against the exact oracle (see DESIGN.md section 11).")
+    ap.add_argument("--cases", type=int,
+                    default=int(os.environ.get("KNTPU_FUZZ_CASES", "64")),
+                    help="campaign size (default: $KNTPU_FUZZ_CASES or 64)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--routes", default=None,
+                    help="comma-separated subset of "
+                         "adaptive,legacy,query,sharded (default: all)")
+    ap.add_argument("--budget", default=None, metavar="SECONDS",
+                    help="wall-time bound, e.g. 60 or 60s; the seeded case "
+                         "list truncates, never fails, on expiry")
+    ap.add_argument("--bank-dir", default=None,
+                    help="where failing repros are banked "
+                         "(default: tests/corpus)")
+    ap.add_argument("--isolation", choices=("auto", "case", "none"),
+                    default="auto",
+                    help="'case' = one supervisor worker per case (crash "
+                         "containment), 'none' = in-process, 'auto' = "
+                         "'case' off-CPU (default)")
+    ap.add_argument("--devices", type=int, default=2,
+                    help="mesh size for the sharded route (and the emulated "
+                         "host device count when no accelerator is "
+                         "attached); default 2")
+    ap.add_argument("--no-minimize", action="store_true",
+                    help="bank failing cases unminimized")
+    ap.add_argument("--manifest", default=None,
+                    help="also write the campaign manifest JSON here")
+    args = ap.parse_args(argv)
+    if args.cases < 0:
+        ap.error("--cases must be >= 0")
+    try:
+        budget = _parse_budget(args.budget)
+    except ValueError:
+        ap.error(f"--budget {args.budget!r} is not a number of seconds")
+
+    # Emulated mesh BEFORE any jax import: the sharded route needs > 1
+    # device to exercise its halo exchange on CPU-only hosts (same
+    # mechanism as tests/conftest.py).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{max(1, args.devices)}").strip()
+
+    from .campaign import run_campaign
+    from .routes import ROUTE_NAMES
+
+    routes = tuple(r.strip() for r in args.routes.split(",")) \
+        if args.routes else ROUTE_NAMES
+    unknown = [r for r in routes if r not in ROUTE_NAMES]
+    if unknown:
+        ap.error(f"unknown route(s) {unknown}: expected {ROUTE_NAMES}")
+
+    kwargs = {} if args.bank_dir is None else {"bank_dir": args.bank_dir}
+    manifest = run_campaign(
+        n_cases=args.cases, seed=args.seed, routes=routes, budget_s=budget,
+        isolation=args.isolation, n_devices=max(1, args.devices),
+        minimize=not args.no_minimize, **kwargs)
+    if args.manifest:
+        os.makedirs(os.path.dirname(os.path.abspath(args.manifest)),
+                    exist_ok=True)
+        with open(args.manifest, "w") as f:
+            json.dump(manifest, f, indent=2)
+    print(json.dumps(manifest))
+    if not manifest["ok"]:
+        n = len(manifest["failures"])
+        print(f"FUZZ CAMPAIGN FAILED: {n} unwaived failure(s); minimized "
+              f"repros banked (see manifest 'failures')", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,319 @@
+"""The differential fuzz campaign: every case through every route, against
+the exact oracle, with failures minimized and banked.
+
+One case = one adversarial point set (regenerable from its CaseSpec).  For
+each requested route the campaign runs the solve, applies the tie-aware
+comparison (:mod:`compare`), and on ANY disagreement -- mismatch, missing
+route, exception, or (under case isolation) a worker death -- records a
+:class:`CaseFailure`, delta-debugs the point set down to a minimal repro
+(:mod:`minimize`), and banks it into the replayed regression corpus
+(``tests/corpus/*.npz``, replayed by tests/test_fuzz.py).
+
+Isolation (the PR-2 supervisor, runtime/supervisor.py):
+
+  * ``'case'`` -- each case runs in a fresh worker child (job 'fuzz_case');
+    a hard crash (SIGKILL, wedge, OOM) costs exactly that case: the parent
+    banks the case from its regenerable spec with the supervisor's typed
+    failure kind and the campaign continues.
+  * ``'none'`` -- in-process with per-route exception containment (Python
+    exceptions only); the right choice on CPU where the failure modes the
+    supervisor exists for (libtpu SIGKILLs, Mosaic aborts) cannot occur.
+  * ``'auto'`` -- 'case' on accelerator platforms, 'none' on CPU.
+
+A failure matching :data:`WAIVERS` is recorded in the manifest with its
+reason but does not fail the campaign -- the acceptance bar is zero
+UNEXPLAINED route-vs-oracle disagreements.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import traceback
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import CORPUS_DIR, corpus_size
+from .compare import check_route_result
+from .generators import CaseSpec, draw_cases, generate_case, hazard_of
+from .minimize import ddmin_points
+from .routes import ROUTE_NAMES, oracle_reference, route_excludes_self, \
+    run_route
+from ..utils.memory import InputContractError, classify_fault_text
+
+# (generator, route) -> reason.  '*' wildcards either slot.  A waived
+# failure is recorded in the manifest but does not fail the campaign.
+# EMPTY after this round's fixes: every disagreement the campaign found in
+# development was fixed and banked (the n=0 adaptive/legacy plan crash --
+# see tests/corpus/), none waived.
+WAIVERS: Dict[Tuple[str, str], str] = {}
+
+
+@dataclasses.dataclass
+class CaseFailure:
+    """One route's failure on one case, manifest- and corpus-ready."""
+
+    case_id: str
+    generator: str
+    hazard: str
+    route: str
+    kind: str        # 'mismatch' | 'missing-route' | supervisor taxonomy
+    reason: str
+    original_n: int
+    minimized_n: Optional[int] = None
+    banked: Optional[str] = None
+    waived: Optional[str] = None  # waiver reason, when one applied
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _waiver_for(generator: str, route: str) -> Optional[str]:
+    for key in ((generator, route), (generator, "*"), ("*", route),
+                ("*", "*")):
+        if key in WAIVERS:
+            return WAIVERS[key]
+    return None
+
+
+def _route_failure(points: np.ndarray, k: int, route: str,
+                   n_devices: int,
+                   ref: Optional[Tuple[np.ndarray, np.ndarray]] = None
+                   ) -> Optional[Tuple[str, str]]:
+    """(kind, reason) when ``route`` disagrees with the oracle on
+    ``points``, None when it is exact.  Exceptions are contained and
+    classified -- a legal input must never raise, so any raise IS the
+    failure.  ``ref`` is a precomputed oracle answer for this exact
+    (points, exclusion) pair (run_case shares one across routes); omit it
+    and the oracle runs here."""
+    try:
+        res = run_route(route, points, k, n_devices=n_devices)
+    except InputContractError as e:
+        # the campaign only generates LEGAL input, so a front-door refusal
+        # here is an engine bug (an overzealous contract), not a bad case
+        return ("invalid-input",
+                f"legal input refused: {type(e).__name__}: {e}")
+    except Exception as e:  # noqa: BLE001 -- containment IS the job: every
+        # raise on legal input is banked as a typed campaign failure
+        kind = classify_fault_text(f"{type(e).__name__}: {e}") or "crash"
+        tail = traceback.format_exc(limit=3).strip().splitlines()[-1]
+        return (kind, f"route raised {type(e).__name__}: {e} ({tail})")
+    if res is None:
+        return ("missing-route", "route produced no result")
+    ids, d2 = res
+    if ref is None:
+        ref = oracle_reference(points, k, route_excludes_self(route))
+    _ref_ids, ref_d2 = ref
+    mismatch = check_route_result(points, points, ids, d2, ref_d2, k)
+    if mismatch is not None:
+        return ("mismatch", mismatch.render())
+    return None
+
+
+def bank_case(bank_dir: str, spec: CaseSpec, route: str, kind: str,
+              reason: str, points: np.ndarray) -> str:
+    """Write one failing case to the corpus: everything a replay needs
+    (points + k + route) plus the forensics (spec, hazard, kind, reason)."""
+    os.makedirs(bank_dir, exist_ok=True)
+    path = os.path.join(bank_dir, f"{spec.case_id()}-{route}.npz")
+    np.savez_compressed(
+        path,
+        points=np.asarray(points, np.float32),
+        k=np.int32(spec.k),
+        route=np.bytes_(route.encode()),
+        kind=np.bytes_(kind.encode()),
+        reason=np.bytes_(reason[:2000].encode()),
+        hazard=np.bytes_(hazard_of(spec.generator).encode()),
+        spec_json=np.bytes_(json.dumps(spec.to_json()).encode()))
+    return path
+
+
+def load_banked(path: str) -> dict:
+    """Inverse of bank_case: {'points', 'k', 'route', 'kind', 'reason',
+    'hazard', 'spec'} from one corpus entry."""
+    with np.load(path) as z:
+        return {
+            "points": np.asarray(z["points"], np.float32),
+            "k": int(z["k"]),
+            "route": bytes(z["route"]).decode(),
+            "kind": bytes(z["kind"]).decode(),
+            "reason": bytes(z["reason"]).decode(),
+            "hazard": bytes(z["hazard"]).decode(),
+            "spec": CaseSpec.from_json(json.loads(bytes(z["spec_json"]))),
+        }
+
+
+def _safe_bank_dir(bank_dir: Optional[str]) -> Optional[str]:
+    """Protect the real corpus from synthetic repros: under a seeded
+    KNTPU_FUZZ_FAULT the failures are injected, pin no engine bug, and
+    must never land in tests/corpus (where tier-1 would replay them as
+    no-op pins forever).  Faulted runs bank to a scratch directory
+    instead -- still banked, so the self-test's 'minimized, banked repro'
+    criterion holds."""
+    from .routes import parse_fault
+
+    if bank_dir is None or parse_fault() is None:
+        return bank_dir
+    if os.path.abspath(bank_dir) != os.path.abspath(CORPUS_DIR):
+        return bank_dir  # explicit scratch dir (tests): caller's choice
+    import tempfile
+
+    return tempfile.mkdtemp(prefix="kntpu-fuzz-faulted-")
+
+
+def run_case(spec: CaseSpec, routes: Sequence[str] = ROUTE_NAMES,
+             bank_dir: Optional[str] = None, minimize: bool = True,
+             n_devices: int = 2, max_probes: int = 48) -> List[CaseFailure]:
+    """Run one case through every route in-process; minimize and bank each
+    unwaived failure.  Returns the (possibly empty) failure list."""
+    points = generate_case(spec)
+    bank_dir = _safe_bank_dir(bank_dir)
+    failures: List[CaseFailure] = []
+    refs = {}  # exclusion flavor -> oracle answer, shared across routes
+    for route in routes:
+        excl = route_excludes_self(route)
+        if excl not in refs:
+            refs[excl] = oracle_reference(points, spec.k, excl)
+        got = _route_failure(points, spec.k, route, n_devices,
+                             ref=refs[excl])
+        if got is None:
+            continue
+        kind, reason = got
+        failure = CaseFailure(
+            case_id=spec.case_id(), generator=spec.generator,
+            hazard=hazard_of(spec.generator), route=route, kind=kind,
+            reason=reason, original_n=points.shape[0],
+            waived=_waiver_for(spec.generator, route))
+        repro = points
+        if minimize and points.shape[0] > 1 and not failure.waived:
+            # preserve the failure KIND while shrinking: a different
+            # failure on a subset is a different bug and must not hijack
+            # this repro
+            def _still_fails(sub):
+                sub_got = _route_failure(sub, spec.k, route, n_devices)
+                return sub_got is not None and sub_got[0] == kind
+            repro, _probes = ddmin_points(points, _still_fails,
+                                          max_probes=max_probes)
+        failure.minimized_n = int(repro.shape[0])
+        # a WAIVED failure is expected to keep reproducing -- banking it
+        # into the replayed corpus would turn the waiver into a permanent
+        # tier-1 failure; it lives in the manifest instead
+        if bank_dir is not None and not failure.waived:
+            failure.banked = bank_case(bank_dir, spec, route, kind, reason,
+                                       repro)
+        failures.append(failure)
+    return failures
+
+
+def run_case_job(job: dict) -> dict:
+    """Supervisor-worker entry (runtime/worker.py job 'fuzz_case'): run one
+    case in this (isolated) process and frame the failure list back."""
+    spec = CaseSpec.from_json(job["spec"])
+    failures = run_case(
+        spec, routes=tuple(job.get("routes") or ROUTE_NAMES),
+        bank_dir=job.get("bank_dir"), minimize=bool(job.get("minimize", True)),
+        n_devices=int(job.get("n_devices", 2)))
+    return {"case": spec.case_id(),
+            "failures": [f.to_json() for f in failures]}
+
+
+def _resolve_isolation(isolation: str) -> str:
+    if isolation not in ("auto", "case", "none"):
+        raise ValueError(f"unknown isolation {isolation!r}: expected "
+                         f"'auto', 'case' or 'none'")
+    if isolation != "auto":
+        return isolation
+    import jax
+
+    return "none" if jax.devices()[0].platform == "cpu" else "case"
+
+
+def run_campaign(n_cases: int = 64, seed: int = 0,
+                 routes: Sequence[str] = ROUTE_NAMES,
+                 bank_dir: str = CORPUS_DIR,
+                 budget_s: Optional[float] = None,
+                 isolation: str = "auto", n_devices: int = 2,
+                 minimize: bool = True,
+                 log: Optional[Callable[[str], None]] = print) -> dict:
+    """Run the full differential campaign; returns the manifest dict
+    (``manifest['ok']`` is the rc-0 condition: zero unwaived failures).
+
+    ``budget_s`` bounds wall time: the seeded case LIST is deterministic,
+    and an expiring budget truncates the tail (recorded in the manifest as
+    ``truncated_after``) rather than failing."""
+    log = log or (lambda s: None)
+    t0 = time.monotonic()
+    mode = _resolve_isolation(isolation)
+    cases = draw_cases(n_cases, seed)
+    supervisor = None
+    if mode == "case":
+        from ..runtime.supervisor import Supervisor
+
+        supervisor = Supervisor()
+    failures: List[CaseFailure] = []
+    completed = 0
+    truncated_after: Optional[int] = None
+    for i, spec in enumerate(cases):
+        if budget_s is not None and time.monotonic() - t0 > budget_s:
+            truncated_after = i
+            log(f"[{i}/{len(cases)}] budget {budget_s:.0f}s exhausted; "
+                f"remaining cases truncated (case list is seeded -- rerun "
+                f"with a larger budget to cover them)")
+            break
+        case_failures = _run_one(spec, routes, bank_dir, minimize,
+                                 n_devices, supervisor)
+        failures.extend(case_failures)
+        completed += 1
+        tag = "ok" if not case_failures else \
+            "FAIL " + ",".join(f"{f.route}:{f.kind}" for f in case_failures)
+        log(f"[{i + 1}/{len(cases)}] {spec.case_id()} "
+            f"[{spec.generator}] {tag}")
+    unwaived = [f for f in failures if not f.waived]
+    manifest = {
+        "ok": not unwaived,
+        "requested_cases": n_cases,
+        "completed_cases": completed,
+        "truncated_after": truncated_after,
+        "seed": seed,
+        "routes": list(routes),
+        "isolation": mode,
+        "elapsed_s": round(time.monotonic() - t0, 3),
+        "failures": [f.to_json() for f in unwaived],
+        "waived": [f.to_json() for f in failures if f.waived],
+        "waivers": {f"{g}/{r}": why for (g, r), why in WAIVERS.items()},
+        "corpus_size": corpus_size(bank_dir),
+    }
+    return manifest
+
+
+def _run_one(spec: CaseSpec, routes: Sequence[str], bank_dir: str,
+             minimize: bool, n_devices: int,
+             supervisor) -> List[CaseFailure]:
+    if supervisor is None:
+        return run_case(spec, routes=routes, bank_dir=bank_dir,
+                        minimize=minimize, n_devices=n_devices)
+    job = {"job": "fuzz_case", "spec": spec.to_json(),
+           "routes": list(routes), "bank_dir": bank_dir,
+           "minimize": minimize, "n_devices": n_devices}
+    row, record = supervisor.run_job(spec.case_id(), job)
+    if record is None:
+        return [CaseFailure(**f) for f in row.get("failures", [])]
+    # the worker died (crash/timeout/oom/...): bank the case itself -- it
+    # is regenerable from the spec, and point generation is pure numpy, so
+    # reconstructing it in the parent is safe even though solving it was
+    # not.  No in-parent minimization: shrinking a process-killing case
+    # must itself run isolated, and one banked full case per crash is the
+    # containment contract.
+    failure = CaseFailure(
+        case_id=spec.case_id(), generator=spec.generator,
+        hazard=hazard_of(spec.generator), route="*", kind=record.kind,
+        reason=f"worker died: {record.message}", original_n=spec.n,
+        minimized_n=spec.n, waived=_waiver_for(spec.generator, "*"))
+    safe_dir = _safe_bank_dir(bank_dir)
+    if safe_dir is not None and not failure.waived:
+        failure.banked = bank_case(safe_dir, spec, "all-routes", record.kind,
+                                   failure.reason, generate_case(spec))
+    return [failure]

@@ -1,0 +1,137 @@
+"""Uniform runners for the four solve routes, plus the seeded-fault injector.
+
+Every runner takes an in-domain point set and returns ``(ids, d2)`` -- (m, k)
+neighbor ids in ORIGINAL point indexing (rows in input order, -1 beyond the
+available neighbors) and (m, k) squared distances ascending (inf beyond) --
+so the campaign compares all four routes through one code path:
+
+  * ``adaptive``  -- the capacity-class single-chip solve (api.KnnProblem,
+                     backend 'auto', adaptive planner).
+  * ``legacy``    -- the legacy pack solve (adaptive=False: SolvePlan +
+                     prepare_pack, the pre-adaptive route).
+  * ``query``     -- the external-query surface (no self-exclusion: the
+                     stored points re-presented as arbitrary queries).
+  * ``sharded``   -- the multi-chip z-slab solve (parallel.sharded) over an
+                     emulated (or real) mesh.
+
+Seeded faults (``KNTPU_FUZZ_FAULT=<kind>[:<route>]``, default route
+'adaptive') corrupt a route's output AFTER the solve so the campaign's
+detectors can be proven live without touching engine code:
+
+  * ``drop-neighbor``  -- erase row 0's last valid neighbor (a silently
+                          incomplete row).
+  * ``perturb-d2``     -- inflate row 0's last valid distance (a wrong
+                          reported distance).
+  * ``skip-route``     -- the route silently produces no result (the
+                          campaign must notice a missing route, not just a
+                          wrong one).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+ROUTE_NAMES = ("adaptive", "legacy", "query", "sharded")
+
+FAULT_KINDS = ("drop-neighbor", "perturb-d2", "skip-route")
+
+_FAULT_ENV = "KNTPU_FUZZ_FAULT"
+
+
+def route_excludes_self(route: str) -> bool:
+    """Self-solve routes exclude the query point by storage index; the
+    external-query surface does not (its queries are independent of the
+    stored set) -- the oracle reference must match."""
+    return route != "query"
+
+
+def parse_fault(spec: Optional[str] = None) -> Optional[Tuple[str, str]]:
+    """(kind, target_route) from a ``KNTPU_FUZZ_FAULT`` value, or None."""
+    spec = os.environ.get(_FAULT_ENV, "") if spec is None else spec
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    kind, _, route = spec.partition(":")
+    if kind not in FAULT_KINDS:
+        raise ValueError(f"unknown {_FAULT_ENV} kind {kind!r}: expected one "
+                         f"of {FAULT_KINDS}")
+    return kind, (route or "adaptive")
+
+
+def _apply_fault(route: str, ids: np.ndarray, d2: np.ndarray):
+    """Corrupt (ids, d2) per the env-seeded fault; returns None for
+    skip-route (the 'route silently vanished' shape)."""
+    fault = parse_fault()
+    if fault is None or fault[1] != route:
+        return ids, d2
+    kind = fault[0]
+    if kind == "skip-route":
+        return None
+    ids = np.array(ids, copy=True)
+    d2 = np.array(d2, copy=True)
+    valid = ids >= 0
+    if not valid.any():
+        return ids, d2  # nothing to corrupt (empty case): fault is a no-op
+    row = int(np.nonzero(valid.any(axis=1))[0][0])
+    col = int(np.nonzero(valid[row])[0][-1])
+    if kind == "drop-neighbor":
+        ids[row, col] = -1  # d2 stays finite: a self-inconsistent row
+    elif kind == "perturb-d2":
+        d2[row, col] = d2[row, col] * 1.01 + 1.0
+    return ids, d2
+
+
+def _self_solve(points: np.ndarray, k: int, adaptive: bool):
+    from ..api import KnnProblem
+    from ..config import KnnConfig
+
+    p = KnnProblem.prepare(points, KnnConfig(k=k, adaptive=adaptive))
+    p.solve()
+    ids = p.get_knearests_original()
+    d2 = np.empty_like(p.get_dists_sq())
+    d2[p.get_permutation()] = p.get_dists_sq()
+    return ids, d2
+
+
+def run_route(route: str, points: np.ndarray, k: int,
+              n_devices: int = 2):
+    """Run one route; returns (ids, d2) in original indexing/order, or None
+    when a seeded skip-route fault suppressed the result."""
+    if route == "adaptive":
+        ids, d2 = _self_solve(points, k, adaptive=True)
+    elif route == "legacy":
+        ids, d2 = _self_solve(points, k, adaptive=False)
+    elif route == "query":
+        from ..api import KnnProblem
+        from ..config import KnnConfig
+
+        p = KnnProblem.prepare(points, KnnConfig(k=k))
+        ids, d2 = p.query(points)
+    elif route == "sharded":
+        import jax
+
+        from ..config import KnnConfig
+        from ..parallel.sharded import ShardedKnnProblem
+
+        ndev = max(1, min(n_devices, len(jax.devices())))
+        sp = ShardedKnnProblem.prepare(points, n_devices=ndev,
+                                       config=KnnConfig(k=k))
+        ids, d2, _cert = sp.solve()
+    else:
+        raise ValueError(f"unknown route {route!r}: expected one of "
+                         f"{ROUTE_NAMES}")
+    return _apply_fault(route, np.asarray(ids), np.asarray(d2))
+
+
+def oracle_reference(points: np.ndarray, k: int, exclude_self: bool):
+    """The exact reference answer (kd-tree when the native oracle built,
+    numpy brute otherwise -- same semantics): ((m, k) ids, (m, k) d2)."""
+    from ..oracle import KdTreeOracle
+
+    oracle = KdTreeOracle(points)
+    if exclude_self:
+        return oracle.knn_all_points(k)
+    return oracle.knn(points, k)

@@ -15,11 +15,14 @@ Reference parity (component C11/C15 in SURVEY.md):
 from __future__ import annotations
 
 import os
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
 from .config import DOMAIN_SIZE
+from .utils.memory import (CorruptInputError, DegenerateExtentError,
+                           DomainBoundsError, InvalidKError,
+                           InvalidShapeError, NonFiniteInputError)
 
 
 def load_xyz(path: str) -> np.ndarray:
@@ -35,7 +38,8 @@ def load_xyz(path: str) -> np.ndarray:
         data = np.loadtxt(f, dtype=np.float32)
     data = np.atleast_2d(data)[:, :3].astype(np.float32)
     if data.shape[0] != n:
-        raise ValueError(f"{path}: header says {n} points, found {data.shape[0]}")
+        raise CorruptInputError(
+            f"{path}: header says {n} points, found {data.shape[0]}")
     return np.ascontiguousarray(data)
 
 
@@ -54,6 +58,11 @@ def bbox(points: np.ndarray, pad_fraction: float = 0.001) -> Tuple[np.ndarray, n
     0.1% of the largest side so normalized points land strictly inside the domain.
     """
     points = np.asarray(points)
+    if points.size == 0:
+        raise DegenerateExtentError(
+            "cannot take a bounding box of an empty point set (input "
+            "contract: normalization needs at least one point; an empty "
+            "set is legal input to prepare/solve, which skip normalization)")
     lo = points.min(axis=0).astype(np.float64)
     hi = points.max(axis=0).astype(np.float64)
     pad = float((hi - lo).max()) * pad_fraction
@@ -79,28 +88,70 @@ def normalize_points(points: np.ndarray, domain: float = DOMAIN_SIZE) -> np.ndar
     return np.ascontiguousarray(out.astype(np.float32))
 
 
-def validate_points(points: np.ndarray,
-                    domain: float = DOMAIN_SIZE) -> np.ndarray:
-    """Enforce the engine's input contract: (n, 3) finite f32 in [0, domain]^3.
+def validate_or_raise(points: np.ndarray, k: Optional[int] = None,
+                      domain: float = DOMAIN_SIZE,
+                      what: str = "points") -> np.ndarray:
+    """THE input front door: every solve route funnels its inputs through
+    here (KnnProblem.prepare, the external-query surface, the sharded
+    prepare/query, and the CLI), so "what inputs are legal, and what happens
+    to the rest" is one tested contract rather than scattered checks.
 
-    The reference silently clamps out-of-range points into boundary cells
-    (/root/reference/knearests.cu:26-28), which quietly corrupts results; this
-    framework fails fast with a fix pointer instead.
+    Legal input (DESIGN.md section 11 has the full table):
+      * ``points``: a (n, 3) array of finite float coordinates inside
+        ``[0, domain]^3`` (the reference's own contract, knearests.cu:21);
+        n = 0 is legal (empty results downstream).
+      * ``k`` (when given): a positive integer.  ``k > n`` is legal degraded
+        mode -- result rows pad -1/inf beyond the available neighbors, with
+        certificates intact -- so it is deliberately NOT rejected here.
+
+    Raises the typed taxonomy (utils/memory.py; every class subclasses
+    ValueError, kind='invalid-input'): InvalidShapeError /
+    NonFiniteInputError / DomainBoundsError / InvalidKError.  Returns the
+    validated (n, 3) contiguous float32 array.
+
+    Where the reference silently clamps out-of-range points into boundary
+    cells (knearests.cu:26-28) -- quietly corrupting results -- this fails
+    fast with a fix pointer.
     """
-    points = np.asarray(points, np.float32)
+    if k is not None:
+        # bool is an int subclass; k=True sizing a kernel is never intended
+        if isinstance(k, bool) or not isinstance(k, (int, np.integer)):
+            raise InvalidKError(
+                f"k must be a positive integer, got {k!r} (input contract)")
+        if k < 1:
+            raise InvalidKError(
+                f"k must be >= 1, got {k} (input contract; note k > n is "
+                f"legal: rows pad -1/inf beyond the available neighbors)")
+    try:
+        points = np.asarray(points, np.float32)
+    except (TypeError, ValueError) as e:
+        raise InvalidShapeError(
+            f"{what} are not a numeric array: {e} (input contract: "
+            f"(n, 3) finite float coordinates)") from e
     if points.ndim != 2 or points.shape[1] != 3:
-        raise ValueError(f"points must be (n, 3), got {points.shape}")
+        raise InvalidShapeError(
+            f"{what} must be (n, 3), got {points.shape} (input contract)")
     if points.size:
         if not np.isfinite(points).all():
-            raise ValueError("points contain NaN/inf; clean the input first")
+            bad = int((~np.isfinite(points)).sum())
+            raise NonFiniteInputError(
+                f"{what} contain {bad} NaN/inf coordinate(s); clean the "
+                f"input first (input contract: finite f32)")
         lo, hi = float(points.min()), float(points.max())
         if lo < 0.0 or hi > domain:
-            raise ValueError(
-                f"points span [{lo:.3g}, {hi:.3g}] but the engine domain "
+            raise DomainBoundsError(
+                f"{what} span [{lo:.3g}, {hi:.3g}] but the engine domain "
                 f"contract is [0, {domain:g}]^3 -- run io.normalize_points "
                 f"first (the reference hard-codes the same contract, "
                 f"knearests.cu:21)")
-    return points
+    return np.ascontiguousarray(points)
+
+
+def validate_points(points: np.ndarray,
+                    domain: float = DOMAIN_SIZE) -> np.ndarray:
+    """Back-compat alias for the points half of :func:`validate_or_raise`
+    (the historical name; new code should call the front door directly)."""
+    return validate_or_raise(points, domain=domain)
 
 
 def generate_uniform(n: int, seed: int = 0, domain: float = DOMAIN_SIZE) -> np.ndarray:

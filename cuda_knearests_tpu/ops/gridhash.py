@@ -133,6 +133,10 @@ def unpermute_neighbors(grid: GridHash, neighbors_sorted: jax.Array,
     ``neighbors[perm[i]*K+j] = perm[knearests[i*K+j]]``).  Same contract here;
     `fill` (< 0) marks not-found slots (the reference uses UINT_MAX).
     """
+    if grid.n_points == 0:
+        # empty problem (degraded mode): nothing to translate, and a take
+        # from the empty permutation would not broadcast
+        return neighbors_sorted
     valid = neighbors_sorted >= 0
     mapped = jnp.where(valid,
                        jnp.take(grid.permutation,

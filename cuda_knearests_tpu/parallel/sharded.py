@@ -48,6 +48,7 @@ if _shard_map is None:  # pragma: no cover - depends on installed jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..config import DOMAIN_SIZE, KnnConfig, default_ring_radius
+from ..utils.memory import InvalidConfigError, InvalidKError
 from ..ops.adaptive import (ClassPlan, _class_flat, _prepack_kernel_inputs,
                             _rows2d, build_class_specs, select_radii)
 from ..ops.gridhash import cell_coords
@@ -582,18 +583,18 @@ class ShardedKnnProblem:
                 mesh: Optional[Mesh] = None,
                 dim: Optional[int] = None) -> "ShardedKnnProblem":
         from ..config import grid_dim_for
-        from ..io import validate_points
+        from ..io import validate_or_raise
 
         config = config or KnnConfig()
         if config.backend == "oracle":
-            raise ValueError(
+            raise InvalidConfigError(
                 "backend='oracle' is a single-chip host engine; the sharded "
                 "path runs grid engines only ('auto'/'pallas'/'xla')")
         if mesh is None:
             n_devices = n_devices or len(jax.devices())
             mesh = jax.make_mesh((n_devices,), ("z",))
         ndev = mesh.devices.size
-        points = validate_points(points)
+        points = validate_or_raise(points, k=config.k)
         n = points.shape[0]
         if dim is None:
             dim = grid_dim_for(n, config.density)
@@ -608,7 +609,7 @@ class ShardedKnnProblem:
         if config.ring_radius is not None:
             radius = max(1, int(config.ring_radius))
             if zcap < radius:
-                raise ValueError(
+                raise InvalidConfigError(
                     f"slab thickness {zcap} cells < halo depth {radius}: "
                     f"halo would span multiple chips. Use fewer devices, a "
                     f"larger supercell, or a smaller ring radius "
@@ -666,7 +667,7 @@ class ShardedKnnProblem:
                 mine = ("" if ok else
                         f"; this process owns mesh positions {got}, expected "
                         f"{list(range(expect0, expect0 + nloc))}")
-                raise ValueError(
+                raise ValueError(  # kntpu-ok: bare-valueerror -- mesh-topology/runtime contract, not point-input validation
                     f"multi-host mesh is not process-major on process(es) "
                     f"{bad}{mine}; build the mesh with "
                     f"parallel.distributed.z_mesh()")
@@ -722,7 +723,8 @@ class ShardedKnnProblem:
         query-heavy workloads can release it between batches with
         :meth:`drop_ready`."""
         if not self.chip_plans[d].classes:
-            raise ValueError(f"chip {d} has an empty class schedule")
+            raise ValueError(  # kntpu-ok: bare-valueerror -- internal invariant (callers skip empty slabs), not input validation
+                f"chip {d} has an empty class schedule")
         if d not in self._ready_cache:
             inp = self._chip_inputs(d)
             self._ready_cache[d] = _chip_ready_state(
@@ -793,10 +795,14 @@ class ShardedKnnProblem:
         """
         from ..ops.adaptive import launch_class_query
 
+        from ..io import validate_or_raise
+
         cfg, meta = self.config, self.meta
-        k = cfg.k if k is None else int(k)
+        k = cfg.k if k is None else k
+        queries = validate_or_raise(queries, k=k, what="queries")
+        k = int(k)
         if k > cfg.k:
-            raise ValueError(
+            raise InvalidKError(
                 f"k={k} exceeds the prepared k={cfg.k} (it sized the "
                 f"candidate dilation)")
         chips = self.local_chips()
@@ -874,7 +880,7 @@ class ShardedKnnProblem:
 
         cap = self.config.k if max_neighbors is None else int(max_neighbors)
         if cap > self.config.k:
-            raise ValueError(
+            raise InvalidKError(
                 f"max_neighbors={cap} exceeds the prepared k={self.config.k}")
         ids, d2 = self.query(queries, k=cap)
         return radius_mask_from_knn(ids, d2, radius, cap)

@@ -49,7 +49,12 @@ from ..utils.platform import _env_number, backoff_schedule
 
 # The complete failure taxonomy.  Every FailureRecord.kind is one of these;
 # retry policy and artifact consumers key on them, never on message text.
-FAILURE_KINDS = ("crash", "timeout", "oom", "transport", "assertion")
+# 'invalid-input' is a typed input-contract refusal (utils/memory.py
+# InputContractError hierarchy): deterministic caller error -- never
+# retried, and the quarantine entry records the refusal, not a device
+# fault.
+FAILURE_KINDS = ("crash", "timeout", "oom", "transport", "assertion",
+                 "invalid-input")
 
 # Frame marker for the worker->parent result protocol.  A prefix (not bare
 # JSON) so library chatter that happens to print a '{' line can never be

@@ -6,6 +6,9 @@ device job and reports through the one-line framed JSON protocol
 
   {"job": "bench_config", "name": "<BASELINE config>"}  -> bench.bench_config
   {"job": "north_star"}                                 -> bench.bench_north_star
+  {"job": "fuzz_case", "spec": {...}, ...}  -> fuzz.campaign.run_case_job
+                            (one differential fuzz case, isolated so a
+                            hostile input's crash costs only that case)
   {"job": "selftest"}    -> a trivial well-formed row, no device work (the
                             fast vehicle for the fault-injection tests)
 
@@ -115,6 +118,13 @@ def _run_job(job: dict) -> dict:
     platform = jax.devices()[0].platform
     if platform == "cpu" and not os.environ.get("BENCH_STALL_FORCE"):
         watchdog.disable()  # local CPU work cannot hang on the transport
+
+    if job.get("job") == "fuzz_case":
+        from ..fuzz.campaign import run_case_job
+
+        row = run_case_job(job)
+        row.setdefault("platform", platform)
+        return row
 
     import bench
 

@@ -70,6 +70,71 @@ class LaunchBudgetError(DeviceMemoryError):
         self.site = site
 
 
+# -- input-contract taxonomy --------------------------------------------------
+#
+# The typed refusal hierarchy for ILLEGAL INPUT (as opposed to device faults
+# above): every class subclasses ValueError so pre-existing callers that
+# catch ValueError keep working, and every class stamps kind='invalid-input'
+# so the supervisor's FailureRecord / classify_fault_text treat a contract
+# refusal as its own failure class -- deterministic, never retried, never
+# quarantine-worthy beyond the offending input.  io.validate_or_raise is the
+# single front door that raises these; the solve routes (api.KnnProblem,
+# parallel.sharded, the external-query surface, cli) all enforce it.
+
+
+class InputContractError(ValueError):
+    """Root of the illegal-input taxonomy (the input twin of
+    DeviceMemoryError).  Raised when an input violates the engine's
+    documented contract -- see DESIGN.md section 11 for the legal-input
+    table and the degraded modes that do NOT raise (k > n pads, zero-extent
+    clouds normalize)."""
+
+    kind = "invalid-input"
+
+
+class InvalidShapeError(InputContractError):
+    """Points/queries are not a well-formed (n, 3) numeric array."""
+
+
+class NonFiniteInputError(InputContractError, DeviceMemoryError):
+    """NaN/inf coordinates.  Also a DeviceMemoryError: the checked staging
+    helper (to_device) historically raised the device taxonomy here, so both
+    ``except ValueError`` and ``except DeviceMemoryError`` callers keep
+    catching it -- but the kind stamp is 'invalid-input' (InputContractError
+    precedes DeviceMemoryError in the MRO), because the fix is cleaning the
+    input, not anything device-side."""
+
+    kind = "invalid-input"
+
+
+class DomainBoundsError(InputContractError):
+    """Coordinates outside the [0, domain]^3 engine contract
+    (/root/reference/knearests.cu:21); run io.normalize_points first."""
+
+
+class DegenerateExtentError(InputContractError):
+    """An operation that needs a bounding box got no points to take one
+    from (normalize/bbox of an empty cloud).  NOT raised for zero-extent
+    clouds: all-identical points normalize by centering (degraded mode)."""
+
+
+class InvalidKError(InputContractError):
+    """k (or a radius cap) is not a positive integer, or exceeds the
+    prepared k that sized the candidate dilation.  k > n is NOT an error:
+    rows pad -1/inf beyond the available neighbors (degraded mode)."""
+
+
+class CorruptInputError(InputContractError):
+    """An input file that does not parse to its own declared contract
+    (e.g. an .xyz header whose count disagrees with the rows)."""
+
+
+class InvalidConfigError(InputContractError):
+    """A configuration combination the engine cannot honor (e.g. a sharded
+    solve asked to run the single-chip oracle backend, or a ring radius
+    thicker than the z-slab it must fit inside)."""
+
+
 # Lowercased substrings that identify a transient transport fault in backend
 # error text.  UNAVAILABLE is the gRPC status the dead tunnel produces
 # (r5_tpu_all_rows.json: every post-crash device_put failed UNAVAILABLE);
@@ -89,17 +154,30 @@ _OOM_RE = re.compile(
     r"resource[_ ]exhausted|out of memory|\boom\b|allocation failure"
     r"|failed to allocate")
 
+# The input-contract taxonomy's class names as they appear in a traceback /
+# stderr tail, plus the canonical phrase.  A worker that dies on illegal
+# input classifies 'invalid-input' -- deterministic, never retried.
+_INVALID_INPUT_RE = re.compile(
+    r"inputcontracterror|invalidshapeerror|nonfiniteinputerror"
+    r"|domainboundserror|degenerateextenterror|invalidkerror"
+    r"|corruptinputerror|invalidconfigerror|input contract")
+
 
 def classify_fault_text(text: str) -> Optional[str]:
     """Map backend/stderr error text onto the failure taxonomy: 'transport'
-    for transient connection loss, 'oom' for allocation exhaustion, None when
-    the text matches neither (callers keep their own default kind).
+    for transient connection loss, 'invalid-input' for a typed contract
+    refusal, 'oom' for allocation exhaustion, None when the text matches
+    none of them (callers keep their own default kind).
     Transport wins ties: a dark tunnel produces UNAVAILABLE wrapped around
     all sorts of secondary allocator noise, and misclassifying a transient
-    fault as oom would wrongly disable retry."""
+    fault as oom would wrongly disable retry.  invalid-input beats oom: a
+    contract refusal's message may legitimately mention budgets/allocation
+    while still being a deterministic input problem."""
     low = (text or "").lower()
     if any(p in low for p in _TRANSPORT_PATTERNS):
         return "transport"
+    if _INVALID_INPUT_RE.search(low):
+        return "invalid-input"
     if _OOM_RE.search(low):
         return "oom"
     return None
@@ -127,7 +205,12 @@ def to_device(x: np.ndarray, dtype: Any = jnp.float32,
     device placement and error reporting still apply."""
     arr = np.asarray(x)
     if validate and not np.isfinite(arr).all():
-        raise DeviceMemoryError("refusing to stage non-finite data to device")
+        # typed refusal: NonFiniteInputError is BOTH taxonomies (input
+        # contract + device memory), so legacy DeviceMemoryError catches
+        # keep working while the supervisor records kind 'invalid-input'
+        raise NonFiniteInputError(
+            "refusing to stage non-finite data to device (input contract: "
+            "coordinates must be finite; clean the input first)")
     arr = np.ascontiguousarray(arr, dtype=np.dtype(dtype))
     try:
         return jax.device_put(arr, sharding)

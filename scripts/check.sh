@@ -36,4 +36,14 @@ fi
 echo "== kntpu-check (contracts + TPU-hazard lint, CPU-only) =="
 JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.analysis || rc=1
 
+# Bounded differential fuzz smoke (DESIGN.md section 11): a fixed-seed
+# adversarial campaign across all four solve routes vs the exact oracle,
+# CPU-only and deterministic (the seeded case list is identical every run;
+# the 60s budget only truncates its tail on slow machines).  KNTPU_FUZZ_CASES
+# deepens it for nightly runs (e.g. KNTPU_FUZZ_CASES=512).
+echo "== fuzz smoke (differential campaign, ${KNTPU_FUZZ_CASES:-32} cases, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
+    --cases "${KNTPU_FUZZ_CASES:-32}" --seed 0 --budget 60s \
+    --isolation none || rc=1
+
 exit $rc

@@ -1,0 +1,14 @@
+"""Lint fixture: bare-valueerror must fire on untyped raises (never run)."""
+
+
+def validate(points):
+    if points.ndim != 2:
+        raise ValueError("points must be (n, 3)")  # line 6: untyped raise
+    if points.shape[0] == 0:
+        raise ValueError  # line 8: bare-class re-raise form
+    return points
+
+
+def other(code):
+    # unrelated exception types stay out of scope
+    raise RuntimeError(f"not an input problem: {code}")

@@ -26,3 +26,8 @@ def rewrap(fn):
         return fn()
     except Exception as e:  # broad but re-raises: the taxonomy-wrap pattern
         raise RuntimeError(f"wrapped: {e}") from e
+
+
+def invariant(state):
+    if state is None:
+        raise ValueError("internal invariant, not input validation")  # kntpu-ok: bare-valueerror -- fixture: reasoned non-input raise

@@ -40,6 +40,7 @@ def _lint(path):
     ("bad_host_sync_loop.py", "host-sync-loop", {8, 9, 10}),
     ("bad_broad_except.py", "broad-except", {7}),
     ("bad_jnp_in_loop.py", "jnp-in-loop", {8}),
+    ("bad_bare_valueerror.py", "bare-valueerror", {6, 8}),
 ])
 def test_rule_fires_exactly_where_planted(fixture, rule, lines):
     findings = _lint(fixture)
@@ -108,7 +109,7 @@ def test_rule_registry_is_pluggable_and_complete():
 
     ids = {r.rule_id for r in all_rules()}
     assert {"tracer-leak", "wide-dtype", "host-sync-loop", "broad-except",
-            "jnp-in-loop"} <= ids
+            "jnp-in-loop", "bare-valueerror"} <= ids
 
 
 # -- lint engine: the shipped tree is clean -----------------------------------

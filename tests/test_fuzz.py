@@ -1,0 +1,428 @@
+"""Tier-1 gate for the adversarial fuzzing subsystem + the input front door
+(ISSUE 4).
+
+Layers, mirroring the subsystem:
+
+* the unified front door (io.validate_or_raise) and its typed taxonomy:
+  every refusal class, the ValueError/DeviceMemoryError compatibility
+  bridge, and the 'invalid-input' failure-kind classification;
+* degenerate sizes across ALL FOUR routes (n in {1, k-1, k}, k > n,
+  all-duplicate input) -- the coverage test_properties.py only had for the
+  single-chip core;
+* the corpus replay policy: every banked repro in tests/corpus/*.npz must
+  replay CLEAN on the fixed tree (each pins a campaign find);
+* the seeded-fault self-test: KNTPU_FUZZ_FAULT in {drop-neighbor,
+  perturb-d2, skip-route} must each yield a campaign failure with a
+  minimized, banked repro -- proof the harness detects breakage;
+* the campaign driver itself (manifest schema, waiver accounting, budget
+  truncation) and its supervisor-isolated worker path.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu.fuzz.campaign import (CaseFailure, WAIVERS,
+                                              _route_failure, bank_case,
+                                              load_banked, run_campaign,
+                                              run_case)
+from cuda_knearests_tpu.fuzz.compare import Mismatch, check_route_result
+from cuda_knearests_tpu.fuzz.generators import (CaseSpec, draw_cases,
+                                                generate_case, hazard_of,
+                                                zoo_names)
+from cuda_knearests_tpu.fuzz.minimize import ddmin_points
+from cuda_knearests_tpu.fuzz.routes import (ROUTE_NAMES, parse_fault,
+                                            run_route)
+from cuda_knearests_tpu.io import validate_or_raise
+from cuda_knearests_tpu.utils.memory import (DeviceMemoryError,
+                                             DomainBoundsError,
+                                             InputContractError,
+                                             InvalidKError,
+                                             InvalidShapeError,
+                                             NonFiniteInputError,
+                                             classify_fault_text, to_device)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(REPO, "tests", "corpus")
+
+
+# -- the input front door -----------------------------------------------------
+
+def test_front_door_accepts_legal_input():
+    pts = np.array([[0.0, 0.0, 0.0], [1000.0, 1000.0, 1000.0]], np.float32)
+    out = validate_or_raise(pts, k=5)
+    assert out.dtype == np.float32 and out.flags["C_CONTIGUOUS"]
+    # n = 0 is legal (degraded mode: empty results downstream)
+    assert validate_or_raise(np.empty((0, 3), np.float32)).shape == (0, 3)
+    # k > n is legal degraded mode, validated only for positivity
+    validate_or_raise(np.zeros((2, 3), np.float32), k=50)
+
+
+@pytest.mark.parametrize("bad,exc", [
+    (np.zeros((3, 2), np.float32), InvalidShapeError),
+    (np.zeros((3,), np.float32), InvalidShapeError),
+    ("not points", InvalidShapeError),
+    (np.array([[1.0, 2.0, np.nan]]), NonFiniteInputError),
+    (np.array([[1.0, 2.0, np.inf]]), NonFiniteInputError),
+    (np.array([[-1.0, 2.0, 3.0]]), DomainBoundsError),
+    (np.array([[1.0, 2.0, 1001.0]]), DomainBoundsError),
+])
+def test_front_door_rejects_typed(bad, exc):
+    with pytest.raises(exc):
+        validate_or_raise(bad)
+    # compat: every refusal is still a ValueError (and an
+    # InputContractError with the 'invalid-input' kind stamp)
+    with pytest.raises(ValueError):
+        validate_or_raise(bad)
+    with pytest.raises(InputContractError) as ei:
+        validate_or_raise(bad)
+    assert ei.value.kind == "invalid-input"
+
+
+@pytest.mark.parametrize("k", [0, -3, 2.5, True, "ten"])
+def test_front_door_rejects_bad_k(k):
+    with pytest.raises(InvalidKError):
+        validate_or_raise(np.zeros((4, 3), np.float32), k=k)
+
+
+def test_to_device_nonfinite_is_both_taxonomies():
+    """to_device's refusal is typed into the input taxonomy AND still a
+    DeviceMemoryError, so pre-existing catches keep working while the kind
+    stamp says 'invalid-input' (the fix is the input, not the device)."""
+    bad = np.array([1.0, np.nan], np.float32)
+    with pytest.raises(NonFiniteInputError) as ei:
+        to_device(bad)
+    assert isinstance(ei.value, DeviceMemoryError)
+    assert isinstance(ei.value, ValueError)
+    assert ei.value.kind == "invalid-input"
+
+
+def test_classify_fault_text_invalid_input():
+    """The supervisor's stderr classifier recognizes the taxonomy by
+    traceback spelling, so a worker that dies on illegal input records
+    kind 'invalid-input' -- deterministic, never retried."""
+    assert classify_fault_text(
+        "NonFiniteInputError: points contain 2 NaN/inf") == "invalid-input"
+    assert classify_fault_text(
+        "InvalidKError: k must be >= 1") == "invalid-input"
+    assert classify_fault_text(
+        "violates the input contract") == "invalid-input"
+    # transport still wins ties (retryability beats everything)
+    assert classify_fault_text(
+        "UNAVAILABLE: InvalidKError downstream") == "transport"
+    # input-contract beats oom (a refusal may mention budgets)
+    assert classify_fault_text(
+        "InvalidConfigError: launch would exceed memory") == "invalid-input"
+
+
+def test_route_surfaces_reject_illegal_queries():
+    from cuda_knearests_tpu import KnnConfig, KnnProblem
+
+    pts = (np.random.default_rng(0).random((40, 3)) * 1000).astype(np.float32)
+    p = KnnProblem.prepare(pts, KnnConfig(k=4))
+    p.solve()
+    with pytest.raises(NonFiniteInputError):
+        p.query(np.array([[np.nan, 1.0, 2.0]], np.float32))
+    with pytest.raises(InvalidKError):
+        p.query(pts[:2], k=9)  # beyond the prepared candidate dilation
+    with pytest.raises(InvalidKError):
+        p.query_radius(pts[:2], radius=10.0, max_neighbors=9)
+    from cuda_knearests_tpu.parallel.sharded import ShardedKnnProblem
+
+    sp = ShardedKnnProblem.prepare(pts, n_devices=2, config=KnnConfig(k=4))
+    with pytest.raises(DomainBoundsError):
+        sp.query(np.array([[2000.0, 0.0, 0.0]], np.float32))
+    with pytest.raises(InvalidKError):
+        sp.query(pts[:2], k=9)
+
+
+# -- degenerate sizes across all four routes ----------------------------------
+
+def _degenerate_cases():
+    rng = np.random.default_rng(11)
+    in_dom = lambda n: (rng.random((n, 3)) * 1000).astype(np.float32)  # noqa: E731
+    return {
+        "n1": (in_dom(1), 3),
+        "n_eq_k_minus_1": (in_dom(3), 4),
+        "n_eq_k": (in_dom(4), 4),
+        "k_gt_n": (in_dom(4), 6),
+        "all_duplicate": (np.full((12, 3), 321.5, np.float32), 5),
+    }
+
+
+@pytest.mark.parametrize("route", ROUTE_NAMES)
+@pytest.mark.parametrize("case", sorted(_degenerate_cases()))
+def test_degenerate_sizes_every_route(route, case):
+    """n in {1, k-1, k}, k > n, and all-duplicate input must solve exactly
+    (vs oracle, tie-aware) on EVERY route -- including the -1/inf padding
+    contract when fewer than k neighbors exist."""
+    points, k = _degenerate_cases()[case]
+    assert _route_failure(points, k, route, n_devices=2) is None
+
+
+def test_empty_input_every_route():
+    """n = 0 is legal degraded mode on every route (the campaign's first
+    find: the adaptive/legacy planners crashed; pinned by the banked
+    corpus entries and fixed in api.KnnProblem/ops.gridhash)."""
+    empty = np.empty((0, 3), np.float32)
+    for route in ROUTE_NAMES:
+        assert _route_failure(empty, 5, route, n_devices=2) is None, route
+
+
+def test_k_gt_n_keeps_certificates_intact():
+    """The documented degraded mode: k > n pads -1/inf and the result is
+    still fully certified (nothing a bigger candidate set could add)."""
+    from cuda_knearests_tpu import KnnConfig, KnnProblem
+
+    pts = (np.random.default_rng(3).random((4, 3)) * 1000).astype(np.float32)
+    p = KnnProblem.prepare(pts, KnnConfig(k=6))
+    res = p.solve()
+    nbrs = p.get_knearests_original()
+    assert ((nbrs >= 0).sum(axis=1) == 3).all()  # n-1 real neighbors
+    assert np.asarray(res.certified).all()
+
+
+# -- corpus replay ------------------------------------------------------------
+
+def _corpus_entries():
+    return sorted(glob.glob(os.path.join(CORPUS, "*.npz")))
+
+
+def test_corpus_is_nonempty():
+    """The campaign's development finds are banked -- an empty corpus means
+    the replay gate below is vacuous."""
+    assert _corpus_entries(), f"no banked repros under {CORPUS}"
+
+
+@pytest.mark.parametrize("path", _corpus_entries(),
+                         ids=[os.path.basename(p) for p in _corpus_entries()])
+def test_corpus_replays_clean(path):
+    """Every banked minimal repro must stay fixed: the failure it recorded
+    must NOT reproduce on the current tree (regression pin)."""
+    b = load_banked(path)
+    routes = ROUTE_NAMES if b["route"] == "all-routes" else (b["route"],)
+    for route in routes:
+        got = _route_failure(b["points"], b["k"], route, n_devices=2)
+        assert got is None, (f"{os.path.basename(path)} regressed on "
+                             f"{route}: {got} (originally: {b['reason']})")
+
+
+def test_bank_roundtrip(tmp_path):
+    spec = CaseSpec(generator="uniform", seed=1, n=5, k=2)
+    pts = generate_case(spec)
+    p = bank_case(str(tmp_path), spec, "query", "mismatch", "why", pts)
+    b = load_banked(p)
+    np.testing.assert_array_equal(b["points"], pts)
+    assert (b["k"], b["route"], b["kind"]) == (2, "query", "mismatch")
+    assert b["spec"] == spec and b["hazard"] == hazard_of("uniform")
+
+
+# -- seeded-fault self-test ---------------------------------------------------
+
+_FAULT_EXPECT = {
+    "drop-neighbor": "mismatch",
+    "perturb-d2": "mismatch",
+    "skip-route": "missing-route",
+}
+
+
+@pytest.mark.parametrize("fault", sorted(_FAULT_EXPECT))
+def test_seeded_fault_yields_minimized_banked_failure(fault, tmp_path,
+                                                      monkeypatch):
+    """The harness must detect its own seeded breakage: each fault kind
+    yields a campaign failure whose repro is delta-minimized and banked
+    (the acceptance criterion's self-test)."""
+    monkeypatch.setenv("KNTPU_FUZZ_FAULT", fault)
+    spec = CaseSpec(generator="uniform", seed=77, n=33, k=4)
+    failures = run_case(spec, routes=("adaptive",), bank_dir=str(tmp_path),
+                        minimize=True, max_probes=16)
+    assert len(failures) == 1
+    f = failures[0]
+    assert f.kind == _FAULT_EXPECT[fault]
+    assert f.banked and os.path.exists(f.banked)
+    assert f.minimized_n is not None and f.minimized_n < f.original_n
+    b = load_banked(f.banked)
+    assert b["points"].shape[0] == f.minimized_n
+
+
+def test_fault_only_hits_target_route(monkeypatch):
+    monkeypatch.setenv("KNTPU_FUZZ_FAULT", "skip-route:legacy")
+    assert parse_fault() == ("skip-route", "legacy")
+    pts = (np.random.default_rng(5).random((20, 3)) * 1000).astype(np.float32)
+    assert run_route("legacy", pts, 3) is None
+    assert run_route("query", pts, 3) is not None
+    monkeypatch.setenv("KNTPU_FUZZ_FAULT", "no-such-fault")
+    with pytest.raises(ValueError, match="unknown KNTPU_FUZZ_FAULT"):
+        run_route("query", pts, 3)
+
+
+# -- comparison + minimizer units ---------------------------------------------
+
+def test_compare_accepts_tie_flips():
+    """Equal-distance neighbor sets must pass even when ids disagree with
+    the oracle -- the whole point of tie-aware comparison."""
+    pts = np.array([[0, 0, 0], [10, 0, 0], [0, 10, 0]], np.float32)
+    q = np.array([[0, 0, 0]], np.float32)
+    ref_d2 = np.array([[100.0, 100.0]], np.float32)  # oracle picked 1 then 2
+    ids = np.array([[2, 1]], np.int32)               # route flipped the tie
+    d2 = np.array([[100.0, 100.0]], np.float32)
+    assert check_route_result(pts, q, ids, d2, ref_d2, 2) is None
+    # but a genuinely different distance multiset fails
+    bad = np.array([[100.0, 200.0]], np.float32)
+    got = check_route_result(pts, q, np.array([[2, 1]], np.int32), bad,
+                             ref_d2, 2)
+    assert isinstance(got, Mismatch)
+
+
+def test_ddmin_minimizes_to_culprit_subset():
+    rng = np.random.default_rng(0)
+    pts = rng.random((40, 3)).astype(np.float32)
+    culprits = {7, 23}
+
+    def fails(sub):
+        # failure persists iff both culprit coordinates survive
+        vals = {round(float(v[0]), 6) for v in sub}
+        need = {round(float(pts[i, 0]), 6) for i in culprits}
+        return need <= vals
+    out, probes = ddmin_points(pts, fails, max_probes=200)
+    assert out.shape[0] == 2 and probes <= 200
+    assert fails(out)
+
+
+# -- campaign driver ----------------------------------------------------------
+
+def test_campaign_smoke_clean(tmp_path):
+    manifest = run_campaign(n_cases=3, seed=0, routes=("adaptive", "query"),
+                            bank_dir=str(tmp_path), isolation="none",
+                            log=None)
+    assert manifest["ok"] is True
+    assert manifest["completed_cases"] == 3
+    assert manifest["failures"] == [] and manifest["waived"] == []
+    for key in ("seed", "routes", "isolation", "elapsed_s", "corpus_size",
+                "truncated_after", "requested_cases", "waivers"):
+        assert key in manifest
+
+
+def test_campaign_budget_truncates_not_fails(tmp_path):
+    manifest = run_campaign(n_cases=50, seed=0, routes=("query",),
+                            bank_dir=str(tmp_path), isolation="none",
+                            budget_s=0.0, log=None)
+    assert manifest["ok"] is True
+    assert manifest["completed_cases"] == 0
+    assert manifest["truncated_after"] == 0
+
+
+def test_campaign_failure_sets_not_ok(tmp_path, monkeypatch):
+    monkeypatch.setenv("KNTPU_FUZZ_FAULT", "skip-route:query")
+    manifest = run_campaign(n_cases=1, seed=0, routes=("query",),
+                            bank_dir=str(tmp_path), isolation="none",
+                            minimize=False, log=None)
+    assert manifest["ok"] is False
+    assert manifest["failures"][0]["kind"] == "missing-route"
+    assert manifest["failures"][0]["banked"]
+
+
+def test_waived_failure_keeps_campaign_ok(tmp_path, monkeypatch):
+    monkeypatch.setenv("KNTPU_FUZZ_FAULT", "skip-route:query")
+    monkeypatch.setitem(WAIVERS, ("*", "query"), "test: known-missing route")
+    manifest = run_campaign(n_cases=1, seed=0, routes=("query",),
+                            bank_dir=str(tmp_path), isolation="none",
+                            minimize=False, log=None)
+    assert manifest["ok"] is True
+    assert manifest["failures"] == []
+    assert manifest["waived"][0]["waived"] == "test: known-missing route"
+    # a waived failure is EXPECTED to keep reproducing: banking it into the
+    # replayed corpus would turn the waiver into a permanent tier-1 failure
+    assert manifest["waived"][0]["banked"] is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_faulted_run_never_banks_into_real_corpus(monkeypatch):
+    """A KNTPU_FUZZ_FAULT self-test must not pollute tests/corpus with
+    synthetic repros (they pin no engine bug and would replay as no-op
+    tests forever): the default corpus dir diverts to a scratch dir."""
+    from cuda_knearests_tpu.fuzz import CORPUS_DIR
+    from cuda_knearests_tpu.fuzz.campaign import _safe_bank_dir
+
+    monkeypatch.delenv("KNTPU_FUZZ_FAULT", raising=False)
+    assert _safe_bank_dir(CORPUS_DIR) == CORPUS_DIR  # unfaulted: untouched
+    monkeypatch.setenv("KNTPU_FUZZ_FAULT", "skip-route")
+    diverted = _safe_bank_dir(CORPUS_DIR)
+    assert diverted != CORPUS_DIR and os.path.isdir(diverted)
+    # explicit scratch dirs (what the self-tests pass) are respected
+    assert _safe_bank_dir("/tmp/some-scratch") == "/tmp/some-scratch"
+    assert _safe_bank_dir(None) is None
+
+
+def test_case_list_is_deterministic_and_covers_zoo():
+    a = draw_cases(2 * len(zoo_names()), seed=9)
+    b = draw_cases(2 * len(zoo_names()), seed=9)
+    assert a == b
+    assert {c.generator for c in a} == set(zoo_names())
+    for c in a[:4]:
+        pts = generate_case(c)
+        np.testing.assert_array_equal(pts, generate_case(c))
+        assert pts.shape == (c.n, 3) and pts.dtype == np.float32
+        validate_or_raise(pts)  # every generated case is LEGAL input
+
+
+def test_zoo_entries_are_tagged():
+    assert len(zoo_names()) >= 10
+    for name in zoo_names():
+        assert hazard_of(name), name
+
+
+# -- supervisor isolation -----------------------------------------------------
+
+def test_supervised_case_runs_in_worker(tmp_path, monkeypatch):
+    """The 'case' isolation path end-to-end: a fuzz_case job through a real
+    supervisor worker child frames its (empty) failure list back."""
+    from cuda_knearests_tpu.fuzz.campaign import _run_one
+    from cuda_knearests_tpu.runtime.supervisor import Supervisor
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    spec = CaseSpec(generator="uniform", seed=2, n=8, k=2)
+    out = _run_one(spec, ("query",), str(tmp_path), False, 1,
+                   Supervisor(timeout_s=240))
+    assert out == []
+
+
+def test_supervised_worker_crash_banks_case(tmp_path, monkeypatch):
+    """A worker SIGKILL (the containment case the supervisor exists for)
+    costs one case: the parent banks the regenerable spec with the typed
+    failure kind and the campaign continues."""
+    from cuda_knearests_tpu.fuzz.campaign import _run_one
+    from cuda_knearests_tpu.runtime.supervisor import Supervisor
+
+    spec = CaseSpec(generator="uniform", seed=4, n=6, k=2)
+    monkeypatch.setenv("KNTPU_FAULT", f"abort:{spec.case_id()}")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    out = _run_one(spec, ("query",), str(tmp_path), True, 1,
+                   Supervisor(timeout_s=240))
+    assert len(out) == 1 and out[0].kind == "crash"
+    assert out[0].banked and os.path.exists(out[0].banked)
+    b = load_banked(out[0].banked)
+    assert b["points"].shape == (6, 3)
+
+
+def test_corpus_size_stamp():
+    from cuda_knearests_tpu.fuzz import corpus_size
+
+    assert corpus_size() == len(_corpus_entries())
+    assert corpus_size("/nonexistent/dir") == 0
+
+
+def test_bench_rows_carry_fuzz_corpus_size():
+    """Every bench artifact row is attributable to a fuzz-covered tree
+    (the ISSUE 4 traceability satellite, like analysis_version in PR 3)."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    fields = bench._env_fields("cpu")
+    assert fields.get("fuzz_corpus_size") == len(_corpus_entries())

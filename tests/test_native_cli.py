@@ -1,14 +1,65 @@
-"""Native oracle CLI: builds and runs end-to-end on the reference fixture."""
+"""Native oracle CLI: builds and runs end-to-end on the reference fixture.
+Plus the python CLI's input-contract exit path (rc 5, ISSUE 4): typed
+refusals must exit distinctly from device errors (rc 4) and engine
+mismatches (rc 1), with failure_kind stamped machine-readably."""
 
+import json
 import os
 import shutil
 import subprocess
+import sys
 
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ORACLE_DIR = os.path.join(REPO, "oracle")
 FIXTURE = "/root/reference/pts20K.xyz"
+
+
+def _run_cli(*args, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "cuda_knearests_tpu.cli", *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def _summary_line(stdout: str) -> dict:
+    lines = [l for l in stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no JSON summary line in: {stdout!r}"
+    return json.loads(lines[-1])
+
+
+def test_cli_nonfinite_input_exits_rc5(tmp_path):
+    """A NaN coordinate in the input file is an input-contract refusal:
+    rc 5, failure_kind='invalid-input' on the machine-readable line --
+    mirroring the rc-4 device-error path, but distinctly caller-fixable."""
+    bad = tmp_path / "nan.xyz"
+    bad.write_text("3\n1 2 3\nnan 5 6\n7 8 9\n")
+    r = _run_cli(str(bad), "--k", "2")
+    assert r.returncode == 5, r.stdout + r.stderr
+    summary = _summary_line(r.stdout)
+    assert summary["failure_kind"] == "invalid-input"
+    assert "REFUSED [invalid-input]" in r.stderr
+
+
+def test_cli_corrupt_header_exits_rc5(tmp_path):
+    """An .xyz whose header count disagrees with its rows refuses rc 5
+    (CorruptInputError), not a raw traceback."""
+    bad = tmp_path / "short.xyz"
+    bad.write_text("5\n0 0 0\n1 1 1\n")
+    r = _run_cli(str(bad), "--k", "2")
+    assert r.returncode == 5, r.stdout + r.stderr
+    assert _summary_line(r.stdout)["failure_kind"] == "invalid-input"
+
+
+def test_cli_invalid_k_exits_rc5(tmp_path):
+    good = tmp_path / "ok.xyz"
+    good.write_text("2\n1 2 3\n4 5 6\n")
+    r = _run_cli(str(good), "--k", "0")
+    assert r.returncode == 5, r.stdout + r.stderr
+    summary = _summary_line(r.stdout)
+    assert summary["failure_kind"] == "invalid-input"
+    assert "k must be" in summary["error"]
 
 
 @pytest.mark.skipif(shutil.which("make") is None or shutil.which("g++") is None,

@@ -51,7 +51,7 @@ def test_failure_record_schema_roundtrip():
 
 def test_failure_record_rejects_unknown_kind():
     assert set(FAILURE_KINDS) == {"crash", "timeout", "oom", "transport",
-                                  "assertion"}
+                                  "assertion", "invalid-input"}
     with pytest.raises(ValueError, match="unknown failure kind"):
         FailureRecord(kind="meltdown", config="x", message="m")
 
