@@ -61,6 +61,7 @@ NORTH_STAR_METRIC = ("queries/sec/chip, all-points kNN on 900k_blue_cube.xyz "
 # Shared with the CLI driver; probing must stay subprocess-based (see the
 # docstrings in utils/platform.py).  Importing the package is backend-safe:
 # module import never initializes a jax backend.
+from cuda_knearests_tpu.runtime import dispatch as _dispatch
 from cuda_knearests_tpu.utils import platform as _platform
 from cuda_knearests_tpu.utils import watchdog as _watchdog
 
@@ -106,7 +107,11 @@ def _steady_state(fn, iters: int = 3, max_seconds: float | None = None) -> float
 
 def _solve_qps(points, cfg, iters: int = 3, oracle_swap: bool = True,
                problem=None):
-    """(qps, solve_s, problem) steady-state for the single-chip engine.
+    """(qps, solve_s, problem, sync_fields) steady-state for the single-chip
+    engine.  ``sync_fields`` is the runtime.dispatch counter stamp of one
+    steady-state solve (host_syncs / h2d_bytes / d2h_bytes) -- the row-level
+    evidence separating dispatch wall from blocked wall (the one-sync solve
+    contract, DESIGN.md section 12).
 
     On a CPU host with the native oracle built, the engine's fastest exact
     route is the kd-tree backend (config.py: backend='oracle', ~3x the dense
@@ -132,14 +137,21 @@ def _solve_qps(points, cfg, iters: int = 3, oracle_swap: bool = True,
         problem = KnnProblem.prepare(points, cfg)
     _watchdog.heartbeat()
 
+    sync_fields: dict = {}
+
     def run():
+        # per-run counter window: the stamped fields describe exactly one
+        # steady-state solve (the last timed run), at zero extra solves
+        _dispatch.reset_stats()
         res = problem.solve()
         jax.block_until_ready((res.neighbors, res.dists_sq, res.certified))
+        sync_fields.clear()
+        sync_fields.update(_dispatch.stats_dict())
 
     run()  # compile + warmup
     _watchdog.heartbeat()
     s = _steady_state(run, iters, max_seconds=_budget_s())
-    return points.shape[0] / s, s, problem
+    return points.shape[0] / s, s, problem, dict(sync_fields)
 
 
 def _oracle_qps(points, k: int, sample_idx=None):
@@ -251,7 +263,7 @@ def bench_north_star() -> dict:
         sel = np.random.default_rng(900).permutation(full_n)[:n_target]
         points = points[np.sort(sel)]
     n = points.shape[0]
-    qps, solve_s, problem = _solve_qps(points, KnnConfig(k=k))
+    qps, solve_s, problem, sync_fields = _solve_qps(points, KnnConfig(k=k))
     backend_used = problem.config.backend
     sample, sample_n = _sampled_oracle_ref(points, k)
     cpu_qps, _, (ref_ids, _) = _oracle_qps(points, k, sample_idx=sample)
@@ -301,6 +313,7 @@ def bench_north_star() -> dict:
         "backend": backend_used,
         "certified_fraction": float(
             np.asarray(problem.result.certified).mean()),
+        **sync_fields,
     }
     import jax
 
@@ -340,30 +353,30 @@ def bench_config(name: str) -> dict:
                 "seconds": round(s, 4), "n_points": points.shape[0]}
     if name == "grid_300k_k10":
         points = get_dataset("pts300K.xyz")
-        qps, s, prob = _solve_qps(points, KnnConfig(k=10))
+        qps, s, prob, sync = _solve_qps(points, KnnConfig(k=10))
         return {"config": "uniform-grid kNN on pts300K.xyz (k=10, single-chip)"
                           + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
-                "solve_s": round(s, 4), "n_points": points.shape[0],
+                "solve_s": round(s, 4), "n_points": points.shape[0], **sync,
                 **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "blue_900k_k20":
         points = get_dataset("900k_blue_cube.xyz")
-        qps, s, prob = _solve_qps(points, KnnConfig(k=20))
+        qps, s, prob, sync = _solve_qps(points, KnnConfig(k=20))
         return {"config": "blue-noise 900k_blue_cube.xyz (k=20, single-chip)"
                           + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
-                "solve_s": round(s, 4), "n_points": points.shape[0],
+                "solve_s": round(s, 4), "n_points": points.shape[0], **sync,
                 **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "batched_300k_k50":
         points = get_dataset("pts300K.xyz")
-        qps, s, prob = _solve_qps(points, KnnConfig(k=50))
+        qps, s, prob, sync = _solve_qps(points, KnnConfig(k=50))
         return {"config": "all-points-as-queries batched kNN (N=300K, k=50)"
                           + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
-                "solve_s": round(s, 4), "n_points": points.shape[0],
+                "solve_s": round(s, 4), "n_points": points.shape[0], **sync,
                 **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "clustered_300k_adaptive":
         import numpy as np
@@ -385,8 +398,8 @@ def bench_config(name: str) -> dict:
         # planners (adaptive classes vs one global capacity) on
         # density-skewed data -- the adaptive planner's reason to exist
         # (ops/adaptive.py:1-31; VERDICT r4 next #8)
-        qps_a, s_a, prob_a = _solve_qps(points, KnnConfig(k=k),
-                                        oracle_swap=False)
+        qps_a, s_a, prob_a, sync_a = _solve_qps(points, KnnConfig(k=k),
+                                                oracle_swap=False)
         n = points.shape[0]
         # The global planner's pair count explodes on skew (that IS this
         # row's finding), so measure it only when its modeled time fits the
@@ -403,7 +416,7 @@ def bench_config(name: str) -> dict:
                          t_g["hbm_total"] / max(1, t_a["hbm_total"]))
         global_fields: dict = {"modeled_work_ratio": round(work_ratio, 2)}
         if s_a * work_ratio <= _budget_s() / 2:
-            qps_g, s_g, _ = _solve_qps(points, None, problem=prob_g)
+            qps_g, s_g, _, _ = _solve_qps(points, None, problem=prob_g)
             global_fields.update(
                 global_capacity_qps=round(qps_g, 1),
                 global_solve_s=round(s_g, 4),
@@ -429,6 +442,7 @@ def bench_config(name: str) -> dict:
                "oracle_sampled": sample_n,
                "certified_fraction": float(np.asarray(
                    prob_a.result.certified).mean()),
+               **sync_a,
                **roofline_fields(problem_traffic(prob_a), s_a, plat)}
         if n_target != 300_000:
             row["scaled_down_from"] = 300_000
@@ -473,7 +487,11 @@ def bench_config(name: str) -> dict:
             cert_rows.append(np.asarray(jax.device_get(out[2]))[sids >= 0])
         certified = (float(np.concatenate(cert_rows).mean())
                      if cert_rows else 1.0)
+        # counter window around the assembled solve: the sharded route's
+        # host-boundary traffic is its one batched assembly fetch
+        _dispatch.reset_stats()
         neighbors, _, _ = sp.solve(device_out=outs)
+        sync_fields = _dispatch.stats_dict()
         n = points.shape[0]
         sample, sample_n = _sampled_oracle_ref(points, k)
         if sample is None:  # tiny run: the sampled path needs explicit ids
@@ -489,6 +507,7 @@ def bench_config(name: str) -> dict:
                "recall_at_10": round(recall, 6),
                "oracle_sampled": sample_n,
                "certified_fraction": round(certified, 6),
+               **sync_fields,
                **roofline_fields(sharded_traffic(sp), s, plat,
                                  n_devices=ndev)}
         if n_target != 10_000_000:

@@ -148,12 +148,13 @@ def _nbytes_tree(tree) -> int:
 
 def _sig(tree, *statics) -> Tuple:
     """Recompile key of a traced call: every leaf's (shape, dtype) plus the
-    static arguments -- what jit would key its cache on."""
-    import jax
+    static arguments -- what jit would key its cache on.  Shared with the
+    runtime (runtime.dispatch.signature keys the executable cache on exactly
+    this census), so the checker's recompile-key rule and the cache's reuse
+    identity cannot drift apart."""
+    from ..runtime.dispatch import signature
 
-    leaves = tuple((tuple(l.shape), str(np.dtype(l.dtype)))
-                   for l in jax.tree_util.tree_leaves(tree))
-    return leaves + tuple(statics)
+    return signature(tree, *statics)
 
 
 def _expect_result(ck: _Checker, route: str, cfg_label: str, out,
@@ -420,12 +421,13 @@ def _check_adaptive(ck: _Checker, points: np.ndarray, k: int,
     counts = _abstract(grid.cell_counts)
     outs = {}
     for ep in ("gather", "scatter"):
-        fn = functools.partial(_solve_adaptive, k=k, exclude_self=True,
+        fn = functools.partial(_solve_adaptive, n=n, k=k, exclude_self=True,
                                domain=grid.domain, interpret=False,
                                tile=cfg.stream_tile, kernel="kpass",
                                epilogue=ep)
         try:
-            outs[ep] = jax.eval_shape(fn, pts, starts, counts, plan)
+            outs[ep] = jax.eval_shape(fn, pts, starts, counts, plan.classes,
+                                      plan.inv_row, plan.inv_box)
         except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
             ck.fail("route-shape", route,
                     f"[{label},ep={ep}] abstract trace failed: "
@@ -463,26 +465,17 @@ def _check_adaptive(ck: _Checker, points: np.ndarray, k: int,
 
 
 def _query_fixture(grid, plan, supercell: int, m: int = 96):
-    """Host-side twin of ops.query.bucket_queries (no eager device ops)."""
-    from ..ops.solve import _round_up
+    """Query-route fixture THROUGH the real bucketing: since the one-sync
+    hoist, ops.query.bucket_queries is pure host numpy (cell_coords_host),
+    so the contract engine calls it directly -- no hand-maintained twin
+    left to drift from the layout the routes actually launch with."""
+    from ..ops.query import bucket_queries
 
     rng = np.random.default_rng(23)
     queries = (1.0 + rng.random((m, 3)) * 998.0).astype(np.float32)
-    dim, domain = grid.dim, grid.domain
-    s_total = plan.n_chunks * plan.batch
-    coords = np.clip((queries * (dim / domain)).astype(np.int32), 0, dim - 1)
-    n_sc = -(-dim // supercell)
-    sc = coords // supercell
-    sid = sc[:, 0] + n_sc * (sc[:, 1] + n_sc * sc[:, 2])
-    order = np.argsort(sid, kind="stable").astype(np.int32)
-    sc_counts = np.bincount(sid, minlength=s_total).astype(np.int32)
-    q2cap = _round_up(int(sc_counts.max()), 128)
-    starts = np.concatenate([[0], np.cumsum(sc_counts)[:-1]]).astype(np.int32)
-    sid_sorted = sid[order]
-    inv_flat = (sid_sorted * q2cap
-                + (np.arange(m) - starts[sid_sorted])).astype(np.int32)
-    return queries, sc_counts, starts, q2cap, inv_flat, \
-        sid_sorted.astype(np.int32)
+    _order, sc_counts, starts, q2cap, inv_flat, inv_sc = bucket_queries(
+        queries, grid, supercell, plan.n_chunks * plan.batch)
+    return queries, sc_counts, starts, q2cap, inv_flat, inv_sc
 
 
 def _check_query(ck: _Checker, points: np.ndarray, k: int,
@@ -500,7 +493,7 @@ def _check_query(ck: _Checker, points: np.ndarray, k: int,
     m = queries.shape[0]
     args = (jax.ShapeDtypeStruct((m, 3), jnp.float32),
             _abstract(starts), _abstract(sc_counts), _abstract(inv_flat),
-            _abstract(inv_sc), pack, plan)
+            _abstract(inv_sc), pack, plan, _abstract(grid.permutation))
     outs = {}
     for ep in ("gather", "scatter"):
         fn = functools.partial(_query_packed, q2cap=q2cap, k=k,

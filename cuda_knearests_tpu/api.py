@@ -29,9 +29,10 @@ import jax
 import numpy as np
 
 from .config import KnnConfig
-from .ops.gridhash import GridHash, build_grid, unpermute_neighbors
+from .ops.gridhash import GridHash, build_grid
 from .ops.solve import (KnnResult, SolvePlan, brute_force_by_index, build_plan,
                         solve)
+from .runtime import dispatch as _dispatch
 from .utils import stats as _stats
 from .utils.memory import InvalidKError, from_device
 
@@ -85,6 +86,11 @@ class KnnProblem:
     pack: Optional[object] = None  # cached PallasPack (pallas backend only)
     aplan: Optional[object] = None  # cached AdaptivePlan (adaptive solve)
     _oracle: Optional[object] = None  # KdTreeOracle (oracle backend only)
+    # prepare-time executable-signature census (runtime.dispatch.signature
+    # over the built plan): the problem half of the executable-cache key, so
+    # repeated problems with the same class-shape signature reuse compiled
+    # query-launch executables (DESIGN.md section 12)
+    _exec_key: Optional[tuple] = None
 
     @classmethod
     def prepare(cls, points, config: KnnConfig | None = None,
@@ -125,7 +131,19 @@ class KnnProblem:
             problem.aplan = build_adaptive_plan(grid, config)
         else:
             problem.plan = build_plan(grid, config)
+        problem._seal()
         return problem
+
+    def _seal(self) -> None:
+        """Stamp the prepare-time executable-signature census: the
+        recompile key (runtime.dispatch.signature -- the same census the
+        kntpu-check contract engine computes) of everything planning
+        produced.  Two problems with equal keys dispatch shape-identical
+        programs, so the query chunk pipeline's executable cache can reuse
+        one compiled launch across them."""
+        self._exec_key = _dispatch.signature(
+            (self.plan, self.aplan), self.config.k, self.config.supercell,
+            self.grid.dim, self.grid.n_points)
 
     def _adaptive_eligible(self) -> bool:
         cfg = self.config
@@ -162,10 +180,14 @@ class KnnProblem:
             ids, d2 = self._oracle.knn_all_points(self.config.k) \
                 if self.config.exclude_self else self._oracle.knn(
                     self._oracle.points, self.config.k)
+            # host-native result: the kd-tree answers on the host, so no
+            # device round trip ever enters this route (the one-sync
+            # contract's zero-sync case)
             self.result = KnnResult(
-                neighbors=jax.numpy.asarray(ids),
-                dists_sq=jax.numpy.asarray(d2),
-                certified=jax.numpy.ones((self.grid.n_points,), bool))
+                neighbors=np.asarray(ids, np.int32),
+                dists_sq=np.asarray(d2, np.float32),
+                certified=np.ones((self.grid.n_points,), bool),
+                uncert_count=np.int32(0))
             return self.result
         if self._adaptive_eligible():
             from .ops.adaptive import build_adaptive_plan, solve_adaptive
@@ -181,33 +203,48 @@ class KnnProblem:
             if self.pack is None:
                 self.pack = prepare_pack(self.grid, self.config, self.plan)
             res = solve(self.grid, self.config, self.plan, self.pack)
-        if self.config.fallback == "brute":
-            res = self._resolve_uncertified(res)
-        self.result = res
-        return res
+        self.result = self._finalize(res)
+        return self.result
 
-    def _resolve_uncertified(self, res: KnnResult) -> KnnResult:
-        # Scalar readback first: certification is ~always total, so the common
-        # path costs an 8-byte transfer, not the full (n,) mask.  The solve
-        # programs compute the count in-program (KnnResult.uncert_count), so
-        # the common path is ONE readback with no eager device dispatches --
-        # each eager op is a round trip on remote-tunnel backends.
+    def _finalize(self, res: KnnResult) -> KnnResult:
+        """One-sync completion (DESIGN.md section 12): a single batched D2H
+        of the assembled tree -- ids, d2, certificate mask, and uncertified
+        count TOGETHER (the count readback at the old fallback gate rode its
+        own eager sync) -- then, only when uncertified rows exist and the
+        brute fallback is on, ONE more batched fetch of their exact
+        resolution.  <= 2 host round trips per solve on every route, pinned
+        by tests/test_dispatch.py."""
         cnt = (res.uncert_count if res.uncert_count is not None
-               else jax.numpy.sum(~res.certified))
-        if int(jax.device_get(cnt)) == 0:
-            return res
-        cert = from_device(res.certified)
+               else jax.numpy.sum(~res.certified, dtype=jax.numpy.int32))
+        nbr, d2, cert, n_unc = _dispatch.fetch(
+            res.neighbors, res.dists_sq, res.certified, cnt)
+        nbr = np.asarray(nbr)
+        d2 = np.asarray(d2)
+        cert = np.asarray(cert)
+        if int(n_unc) == 0 or self.config.fallback != "brute":
+            return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
+                             uncert_count=np.int32(int(n_unc)))
+        # writable copies only on the (rare) resolution branch: device_get
+        # hands back read-only zero-copy views on the CPU backend
+        nbr, d2, cert = np.array(nbr), np.array(d2), np.array(cert)
         bad = np.nonzero(~cert)[0].astype(np.int32)
         # Pad to a power of two so repeated solves reuse a handful of compiles.
         q_idx = _pad_pow2(bad, fill=-1)
         b_ids, b_d2 = brute_force_by_index(
-            self.grid.points, jax.numpy.asarray(q_idx), self.config.k,
+            self.grid.points, _dispatch.stage(q_idx), self.config.k,
             self.config.exclude_self)
-        safe = np.where(q_idx >= 0, q_idx, self.grid.n_points)
-        neighbors = res.neighbors.at[safe].set(b_ids, mode="drop")
-        dists = res.dists_sq.at[safe].set(b_d2, mode="drop")
-        certified = res.certified.at[safe].set(True, mode="drop")
-        return KnnResult(neighbors=neighbors, dists_sq=dists, certified=certified)
+        # the SAME batched fetch primitive as the main readback: an
+        # uncertified row costs one more round trip total, never a second
+        # sync storm of eager per-array readbacks
+        b_ids, b_d2 = _dispatch.fetch(b_ids, b_d2)
+        sel = q_idx >= 0
+        nbr[q_idx[sel]] = np.asarray(b_ids)[sel]
+        d2[q_idx[sel]] = np.asarray(b_d2)[sel]
+        cert[bad] = True
+        # uncert_count = rows that NEEDED resolution (all resolved now):
+        # populated on every path, so consumers never special-case None
+        return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
+                         uncert_count=np.int32(int(n_unc)))
 
     def query(self, queries, k: int | None = None):
         """Exact kNN of arbitrary query coordinates against the stored points.
@@ -268,7 +305,9 @@ class KnnProblem:
         return query_knn(self.grid, self.plan, pack, queries, k,
                          self.config.supercell, interpret,
                          self.config.fallback,
-                         self.config.resolved_epilogue())
+                         self.config.resolved_epilogue(),
+                         chunk=self.config.resolved_query_chunk(),
+                         exec_key=self._exec_key)
 
     def query_radius(self, queries, radius: float,
                      max_neighbors: int | None = None):
@@ -312,10 +351,26 @@ class KnnProblem:
     def get_knearests_original(self) -> np.ndarray:
         """(n, k) neighbor table re-expressed in original point ids -- the
         un-permute step the reference leaves to its caller
-        (test_knearests.cu:155-160)."""
+        (test_knearests.cu:155-160).
+
+        Pure host numpy after one batched fetch: the finalized result is
+        already host-resident, so re-uploading it for a device unpermute
+        (gridhash.unpermute_neighbors -- still the device-side API) would
+        cost H2D + eager dispatches + D2H on the serving path for nothing."""
         self._require_solved()
-        return from_device(
-            unpermute_neighbors(self.grid, self.result.neighbors))
+        nbrs, perm = _dispatch.fetch(self.result.neighbors,
+                                     self.grid.permutation)
+        if self.grid.n_points == 0:
+            return np.asarray(nbrs)
+        nbrs = np.asarray(nbrs)
+        perm = np.asarray(perm)
+        # same contract as unpermute_neighbors (fill = -1):
+        # out[perm[r]][j] = perm[nbrs[r][j]], sentinels preserved
+        mapped = np.where(nbrs >= 0,
+                          perm[np.clip(nbrs, 0, self.grid.n_points - 1)], -1)
+        out = np.empty_like(mapped)
+        out[perm] = mapped
+        return out
 
     def get_dists_sq(self) -> np.ndarray:
         self._require_solved()
@@ -411,4 +466,5 @@ def load_problem(path: str) -> KnnProblem:
         problem.aplan = build_adaptive_plan(grid, cfg, cell_counts_host=counts)
     else:
         problem.plan = build_plan(grid, cfg, cell_counts_host=counts)
+    problem._seal()
     return problem

@@ -147,6 +147,17 @@ class KnnConfig:
         on-chip A/B (bench_runs/r5_tpu_kernel_ab.json) measured blocked
         slower at every compiling shape and Mosaic-rejected at supercell
         >= 4, so blocked is kept explicit-request-only (see resolve_kernel).
+      query_chunk: external-query pipeline chunk size (queries per chunk),
+        LEGACY (non-adaptive) query route only.  When set, ops/query.py
+        splits large query batches into fixed-size
+        chunks dispatched back-to-back -- chunk i+1's H2D staging overlaps
+        chunk i's compute (async dispatch is the double buffer) -- and reads
+        all results back in ONE batched fetch, so the sync count does not
+        grow with the chunk count (DESIGN.md section 12).  None = single
+        shot.  The adaptive query route ignores it: its per-class launches
+        already dispatch back-to-back against one batched readback, so
+        there is no monolithic upload to split.  Solvers read
+        resolved_query_chunk(), not this field.
     """
 
     k: int = DEFAULT_K
@@ -165,6 +176,7 @@ class KnnConfig:
     hbm_budget_bytes: Optional[int] = None
     kernel: str = "kpass"  # solvers read effective_kernel(), not this field
     epilogue: str = "auto"  # solvers read resolved_epilogue(), not this field
+    query_chunk: Optional[int] = None  # solvers read resolved_query_chunk()
 
     def resolved_ring_radius(self) -> int:
         if self.ring_radius is not None:
@@ -194,6 +206,18 @@ class KnnConfig:
 
         on_kernel = jax.devices()[0].platform == "tpu" or self.interpret
         return resolve_epilogue(self.epilogue, on_kernel)
+
+    def resolved_query_chunk(self) -> Optional[int]:
+        """Chunk size of the external-query double-buffered pipeline
+        (ops/query.py, the LEGACY query route -- the adaptive route's
+        per-class launches already pipeline, see the field docs): queries
+        split into fixed-size chunks whose uploads
+        and launches are dispatched back-to-back (chunk i+1 stages while
+        chunk i computes) with ONE batched readback at the end -- the same
+        one-sync contract as the unchunked path, byte-identical by test
+        (tests/test_dispatch.py).  None or <= 0 means single-shot."""
+        q = self.query_chunk
+        return int(q) if q is not None and int(q) > 0 else None
 
 
 def resolve_epilogue(epilogue: str, on_kernel_platform: bool) -> str:

@@ -42,11 +42,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import KnnConfig, default_ring_radius
+from ..runtime import dispatch as _dispatch
 from .gridhash import GridHash
 from .rings import ring_occupancy
 from .solve import (KnnResult, _boxes_grid, _box_cell_ids, _margin_sq,
                     _round_up, pack_cells)
-from .topk import INVALID_ID, init_topk, masked_topk, merge_topk
+from .topk import (INVALID_ID, init_topk, masked_topk, merge_topk,
+                   translate_ids)
 
 
 def select_radii(points_cum: np.ndarray, cells_cum: np.ndarray, k: int,
@@ -278,18 +280,29 @@ class AdaptivePlan:
               extra data movement (A/B: scripts/epilogue_ab.py).
     inv_box:  (n,) i32 into the concatenation of per-class supercell axes
               (for the per-row lo/hi certificate gather).
-    class_of_sc / row_of_sc: (n_sc_global,) i32 -- which class each global
-              supercell landed in (-1 = dropped/empty) and its row within
-              that class's tables; external queries bucket through these
-              (query_adaptive), so one planning pass serves both the
-              self-solve and arbitrary-coordinate queries.
+    class_of_sc / row_of_sc: (n_sc_global,) i32 HOST numpy arrays -- which
+              class each global supercell landed in (-1 = dropped/empty) and
+              its row within that class's tables; external queries bucket
+              through these (query_adaptive), so one planning pass serves
+              both the self-solve and arbitrary-coordinate queries.  Host-
+              resident on purpose: they are consumed only by host-side query
+              bucketing, and the old device copies cost the query path one
+              readback per call (the prepare-time hoist of DESIGN.md
+              section 12).  The solve program takes (classes, inv_row,
+              inv_box) explicitly, so these leaves never cross a jit
+              boundary.
     """
 
     classes: Tuple[ClassPlan, ...]
     inv_row: jax.Array
     inv_box: jax.Array
-    class_of_sc: jax.Array
-    row_of_sc: jax.Array
+    # HOST numpy on purpose (see docstring): still registered as pytree
+    # data_fields (numpy is a legal leaf; meta fields must be hashable), so
+    # never pass a whole AdaptivePlan across a jit boundary -- that would
+    # silently re-upload these per call (the solve takes classes/inv_row/
+    # inv_box explicitly for exactly this reason)
+    class_of_sc: np.ndarray
+    row_of_sc: np.ndarray
     n_points: int
 
 
@@ -368,8 +381,8 @@ def build_adaptive_plan(grid: GridHash, cfg: KnnConfig,
                for cp, t in zip(classes, tgts)]
     return AdaptivePlan(classes=tuple(classes), inv_row=inv_row,
                         inv_box=inv_box,
-                        class_of_sc=jnp.asarray(class_of),
-                        row_of_sc=jnp.asarray(row_of), n_points=grid.n_points)
+                        class_of_sc=class_of,
+                        row_of_sc=row_of, n_points=grid.n_points)
 
 
 @functools.partial(jax.jit, static_argnames=("qcap", "ccap"))
@@ -723,37 +736,45 @@ def _scatter_classes(points: jax.Array, starts: jax.Array, counts: jax.Array,
     return out_d, out_i
 
 
-@functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
-                                             "interpret", "tile", "kernel",
-                                             "epilogue"))
+@functools.partial(jax.jit, static_argnames=("n", "k", "exclude_self",
+                                             "domain", "interpret", "tile",
+                                             "kernel", "epilogue"))
 def _solve_adaptive(points: jax.Array, starts: jax.Array, counts: jax.Array,
-                    plan: AdaptivePlan, k: int, exclude_self: bool,
+                    classes: Tuple[ClassPlan, ...], inv_row: jax.Array,
+                    inv_box: jax.Array, n: int, k: int, exclude_self: bool,
                     domain: float, interpret: bool, tile: int,
                     kernel: str = "kpass", epilogue: str = "gather"):
-    los = [cp.lo for cp in plan.classes]
-    his = [cp.hi for cp in plan.classes]
+    """One program = the whole class-partitioned solve: every class launch,
+    the device-resident (n, k) assembly, and the certificate -- the solve
+    dispatches as ONE async call and syncs nowhere (api._finalize does the
+    single batched readback).  Takes the plan's device pieces explicitly
+    (classes / inv_row / inv_box) rather than the whole AdaptivePlan so the
+    plan's host-resident query maps (class_of_sc / row_of_sc) never ride a
+    jit boundary."""
+    los = [cp.lo for cp in classes]
+    his = [cp.hi for cp in classes]
     if epilogue == "scatter":
         row_d, row_i = _scatter_classes(
-            points, starts, counts, plan.classes, plan.n_points, k,
+            points, starts, counts, classes, n, k,
             exclude_self, tile, interpret, kernel)
     else:
         flats_d, flats_i = [], []
-        for cp in plan.classes:
+        for cp in classes:
             fd, fi = _class_flat(points, starts, counts, cp, k, exclude_self,
                                  tile, interpret, kernel)
             flats_d.append(fd)
             flats_i.append(fi)
-        all_d, all_i = _rows2d(flats_d, flats_i, plan.classes, k)
-        row_d = jnp.take(all_d, plan.inv_row, axis=0)        # (n, k)
-        row_i = jnp.take(all_i, plan.inv_row, axis=0)
+        all_d, all_i = _rows2d(flats_d, flats_i, classes, k)
+        row_d = jnp.take(all_d, inv_row, axis=0)             # (n, k)
+        row_i = jnp.take(all_i, inv_row, axis=0)
     # raw k-th BEFORE sanitization: blocked-kernel deficit rows carry NaN
     # there, and NaN <= margin is false even for an infinite margin
     raw_kth = row_d[:, k - 1]
     ok = jnp.isfinite(row_d)
     row_i = jnp.where(ok, row_i, INVALID_ID)
     row_d = jnp.where(ok, row_d, jnp.inf)
-    lo = jnp.take(jnp.concatenate(los, axis=0), plan.inv_box, axis=0)
-    hi = jnp.take(jnp.concatenate(his, axis=0), plan.inv_box, axis=0)
+    lo = jnp.take(jnp.concatenate(los, axis=0), inv_box, axis=0)
+    hi = jnp.take(jnp.concatenate(his, axis=0), inv_box, axis=0)
     cert = raw_kth <= _margin_sq(points[:, None, :], lo, hi,
                                  domain)[:, 0]
     return row_i, row_d, cert, jnp.sum(~cert, dtype=jnp.int32)
@@ -767,7 +788,8 @@ def solve_adaptive(grid: GridHash, cfg: KnnConfig,
     if plan is None:
         plan = build_adaptive_plan(grid, cfg)
     nbr, d2, cert, n_unc = _solve_adaptive(
-        grid.points, grid.cell_starts, grid.cell_counts, plan, cfg.k,
+        grid.points, grid.cell_starts, grid.cell_counts, plan.classes,
+        plan.inv_row, plan.inv_box, plan.n_points, cfg.k,
         cfg.exclude_self, grid.domain, cfg.interpret, cfg.stream_tile,
         cfg.effective_kernel(), cfg.resolved_epilogue())
     return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
@@ -871,12 +893,9 @@ def _query_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
     row_i = jnp.where(ok, row_i, INVALID_ID)
     row_d = jnp.where(ok, row_d, jnp.inf)
     if ids_map is not None:
-        # translate to final ids on device (e.g. the sharded path's
-        # ext-index -> original-id block); readback stays O(m*k)
-        row_i = jnp.where(
-            row_i >= 0,
-            jnp.take(ids_map, jnp.clip(row_i, 0, ids_map.shape[0] - 1)),
-            INVALID_ID)
+        # translate to final ids on device (the grid permutation, or the
+        # sharded path's ext-index -> original-id block); readback O(m*k)
+        row_i = translate_ids(row_i, ids_map)
     lo = jnp.take(cp.lo, rows_sel, axis=0)                   # (m_c, 3)
     hi = jnp.take(cp.hi, rows_sel, axis=0)
     cert = raw_kth <= _margin_sq(qsorted[:, None, :], lo, hi,
@@ -919,14 +938,28 @@ def launch_class_query(points, starts, counts, cp: ClassPlan,
     if route == "dense" and q2cap * cp.ccap * 4 > _DENSE_TILE_BYTES:
         route = "streamed"  # query blob inflated the dense tile too
     inv = (rows_sorted * q2cap + rank).astype(np.int32)
+    # counted async staging (runtime.dispatch): the uploads and the launch
+    # dispatch back-to-back; nothing here blocks the host
     r_i, r_d, r_c = _query_class(
         points, starts, counts, cp,
-        jnp.asarray(queries_sel[order]), jnp.asarray(rstarts),
-        jnp.asarray(rcounts), jnp.asarray(inv),
-        jnp.asarray(rows_sorted.astype(np.int32)), q2cap, k,
+        _dispatch.stage(queries_sel[order]), _dispatch.stage(rstarts),
+        _dispatch.stage(rcounts), _dispatch.stage(inv),
+        _dispatch.stage(rows_sorted.astype(np.int32)), q2cap, k,
         route, domain, cfg.interpret, cfg.stream_tile, ids_map,
         cfg.effective_kernel(), cfg.resolved_epilogue())
     return order, r_i, r_d, r_c
+
+
+@jax.jit
+def _place_query_rows(out_i, out_d, cert, rows, r_i, r_d, r_c):
+    """Device-resident assembly of one class's external-query rows into the
+    final (m, k) buffers -- the query-side twin of _scatter_classes' forward-
+    map placement.  The destination rows come from the host bucketing (the
+    query set's analog of a prepare-time ClassPlan.tgt), but the contract is
+    the same: per-class results never detour through host ``out[sel] = ...``
+    assembly, and no class launch waits on another's readback."""
+    return (out_i.at[rows].set(r_i), out_d.at[rows].set(r_d),
+            cert.at[rows].set(r_c))
 
 
 def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
@@ -936,21 +969,25 @@ def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
     external-query twin of solve_adaptive, reusing the one plan prepare()
     built (no legacy SolvePlan or PallasPack is ever materialized).
 
-    Queries bucket by supercell, inherit their supercell's class (radius,
-    candidate box, route), and un-pad by a per-class gather.  Queries landing
-    in supercells no class owns (empty regions) and uncertified rows are
-    resolved exactly by the tiled brute-force pass.  Returns ((m, k) ids in
+    Queries bucket by supercell on the HOST (numpy cell coords against the
+    plan's prepare-time class maps -- no device round trip), inherit their
+    supercell's class (radius, candidate box, route), and every class launch
+    dispatches back-to-back with its results scattered into device-resident
+    final (m, k) buffers (_place_query_rows).  The call then syncs ONCE on a
+    batched readback of the assembled buffers; classless queries (empty
+    supercells) and uncertified rows resolve exactly through the tiled
+    brute-force pass behind at most one more batched fetch -- <= 2 host
+    round trips total (DESIGN.md section 12).  Returns ((m, k) ids in
     ORIGINAL indexing, ascending; (m, k) squared distances), query order.
     """
-    from .gridhash import cell_coords
+    from .gridhash import cell_coords_host
     from .query import brute_force_by_coords
 
     queries = np.ascontiguousarray(queries, np.float32)
     m = queries.shape[0]
     if m == 0:
         return (np.empty((0, k), np.int32), np.empty((0, k), np.float32))
-    coords = np.asarray(jax.device_get(cell_coords(
-        jnp.asarray(queries), grid.dim, grid.domain)))
+    coords = cell_coords_host(queries, grid.dim, grid.domain)
     s = cfg.supercell
     n_sc = -(-grid.dim // s)
     scc = coords // s
@@ -958,41 +995,47 @@ def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
     # inside the 10M+-point roadmap scale -- host-only, indexes host arrays
     sid = (scc[:, 0].astype(np.int64) + n_sc * (scc[:, 1].astype(np.int64)   # kntpu-ok: wide-dtype -- supercell-id headroom (see above)
            + n_sc * scc[:, 2].astype(np.int64)))                             # kntpu-ok: wide-dtype -- supercell-id headroom (see above)
-    cls_of = np.asarray(jax.device_get(plan.class_of_sc))
-    row_of = np.asarray(jax.device_get(plan.row_of_sc))
-    qcls, qrow = cls_of[sid], row_of[sid]
+    qcls, qrow = plan.class_of_sc[sid], plan.row_of_sc[sid]
 
-    out_i = np.full((m, k), INVALID_ID, np.int32)
-    out_d = np.full((m, k), np.inf, np.float32)
-    cert = np.zeros((m,), bool)
-
+    # device-resident final buffers: every class scatters into these, and
+    # ids translate to ORIGINAL indexing on device (ids_map) so the one
+    # readback below needs no host-side permutation fetch
+    out_i = jnp.full((m, k), INVALID_ID, jnp.int32)
+    out_d = jnp.full((m, k), jnp.inf, jnp.float32)
+    cert = jnp.zeros((m,), bool)
     for ci, cp in enumerate(plan.classes):
         sel = np.nonzero(qcls == ci)[0]
         if sel.size == 0:
             continue
         order, r_i, r_d, r_c = launch_class_query(
             grid.points, grid.cell_starts, grid.cell_counts, cp,
-            queries[sel], qrow[sel], k, cfg, grid.domain)
-        sel_sorted = sel[order]
-        # per-class readback is inherent here: each class is its own launch
-        # and the loop is bounded by cfg.max_classes (<= 4), not supercells
-        out_i[sel_sorted] = np.asarray(jax.device_get(r_i))  # kntpu-ok: host-sync-loop -- one readback per class launch, <= max_classes
-        out_d[sel_sorted] = np.asarray(jax.device_get(r_d))  # kntpu-ok: host-sync-loop -- one readback per class launch, <= max_classes
-        cert[sel_sorted] = np.asarray(jax.device_get(r_c))   # kntpu-ok: host-sync-loop -- one readback per class launch, <= max_classes
+            queries[sel], qrow[sel], k, cfg, grid.domain,
+            ids_map=grid.permutation)
+        rows = _dispatch.stage(sel[order].astype(np.int32))
+        out_i, out_d, cert = _place_query_rows(out_i, out_d, cert, rows,
+                                               r_i, r_d, r_c)
+    # the one sync: a single batched readback of the assembled buffers
+    out_i, out_d, cert = _dispatch.fetch(out_i, out_d, cert)
 
     # Exact resolve: classless queries (empty supercells) have no grid route,
-    # so they are always brute-forced; uncertified class rows go through the
-    # same pass when the fallback is enabled.
-    need = (qcls < 0) if fallback != "brute" else ~cert
+    # so they are always brute-forced (their rows stay uncertified above);
+    # uncertified class rows go through the same pass when the fallback is
+    # enabled.  One more batched fetch, not a per-array readback storm.
+    need = (qcls < 0) if fallback != "brute" else ~np.asarray(cert)
     if need.any():
+        # writable copies only on the resolution branch (device_get hands
+        # back read-only zero-copy views on the CPU backend)
+        out_i, out_d = np.array(out_i), np.array(out_d)
         bad = np.nonzero(need)[0].astype(np.int32)
-        b_i, b_d = brute_force_by_coords(grid.points, jnp.asarray(queries[bad]),
-                                         k)
-        out_i[bad] = np.asarray(jax.device_get(b_i))
-        out_d[bad] = np.asarray(jax.device_get(b_d))
-
-    perm = np.asarray(jax.device_get(grid.permutation))
-    valid = out_i >= 0
-    ids_orig = np.where(valid, perm[np.clip(out_i, 0, grid.n_points - 1)],
-                        INVALID_ID)
-    return ids_orig, out_d
+        b_i, b_d = brute_force_by_coords(
+            grid.points, _dispatch.stage(queries[bad]), k,
+            ids_map=grid.permutation)
+        b_i, b_d = _dispatch.fetch(b_i, b_d)
+        out_i[bad] = b_i
+        out_d[bad] = b_d
+    # writable results on every path, like the legacy route's fresh buffers
+    # (without the resolution branch the fetch hands back read-only
+    # zero-copy views on the CPU backend)
+    if not out_i.flags.writeable:
+        out_i, out_d = np.array(out_i), np.array(out_d)
+    return out_i, out_d

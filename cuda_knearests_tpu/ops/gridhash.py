@@ -24,6 +24,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import DEFAULT_CELL_DENSITY, DOMAIN_SIZE, grid_dim_for
 
@@ -72,6 +73,16 @@ def cell_coords(points: jax.Array, dim: int, domain: float = DOMAIN_SIZE) -> jax
     """
     scaled = points * (dim / domain)
     return jnp.clip(scaled.astype(jnp.int32), 0, dim - 1)
+
+
+def cell_coords_host(points: np.ndarray, dim: int,
+                     domain: float = DOMAIN_SIZE) -> np.ndarray:
+    """Host numpy twin of :func:`cell_coords` -- identical f32 scale and
+    i32 floor-clamp, so host-side query bucketing agrees with the device
+    grid bit-for-bit with NO device round trip (the query paths used to
+    stage queries up and read coordinates back once per call)."""
+    scaled = np.asarray(points, np.float32) * np.float32(dim / domain)
+    return np.clip(scaled.astype(np.int32), 0, dim - 1)
 
 
 def linearize(coords: jax.Array, dim: int) -> jax.Array:
@@ -133,14 +144,16 @@ def unpermute_neighbors(grid: GridHash, neighbors_sorted: jax.Array,
     ``neighbors[perm[i]*K+j] = perm[knearests[i*K+j]]``).  Same contract here;
     `fill` (< 0) marks not-found slots (the reference uses UINT_MAX).
     """
+    from .topk import INVALID_ID, translate_ids
+
     if grid.n_points == 0:
         # empty problem (degraded mode): nothing to translate, and a take
         # from the empty permutation would not broadcast
         return neighbors_sorted
-    valid = neighbors_sorted >= 0
-    mapped = jnp.where(valid,
-                       jnp.take(grid.permutation,
-                                jnp.clip(neighbors_sorted, 0, grid.n_points - 1)),
-                       fill)
+    # the one shared sentinel-preserving translation (topk.translate_ids);
+    # only a non-default fill needs the extra rewrite
+    mapped = translate_ids(neighbors_sorted, grid.permutation)
+    if fill != INVALID_ID:
+        mapped = jnp.where(neighbors_sorted >= 0, mapped, fill)
     out = jnp.zeros_like(mapped)
     return out.at[grid.permutation].set(mapped)

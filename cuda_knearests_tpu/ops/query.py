@@ -23,9 +23,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .gridhash import GridHash, cell_coords
+from ..runtime import dispatch as _dispatch
+from .gridhash import GridHash, cell_coords_host
 from .solve import SolvePlan, _margin_sq, _round_up
-from .topk import INVALID_ID, init_topk, merge_topk
+from .topk import INVALID_ID, init_topk, merge_topk, translate_ids
 
 _FAR = 1.0e30
 
@@ -34,16 +35,22 @@ def bucket_queries(queries: np.ndarray, grid: GridHash, supercell: int,
                    s_total: int):
     """Host-side query bucketing: sort queries by supercell id.
 
+    Pure numpy -- cell coordinates come from gridhash.cell_coords_host (the
+    bit-identical host twin of the device mapping), so bucketing costs no
+    device round trip (the old eager cell_coords staging+readback was one
+    full round trip per query call).
+
     Returns (order, sc_counts, sc_starts, q2cap, inv_flat, inv_sc): `order`
     sorts queries supercell-major (stable), `sc_counts`/`sc_starts` the
     per-supercell query count / exclusive prefix over the plan's flat
     supercell axis, `q2cap` the padded per-supercell capacity, and
     `inv_flat`/`inv_sc` the slot-partition inverse (sorted query row r lives
     in flat slot inv_flat[r]; its supercell is inv_sc[r]) -- the static map
-    that makes the epilogue a gather, like PallasPack.inv_flat.
+    that makes the epilogue a gather, like PallasPack.inv_flat.  The chunk
+    pipeline re-derives inv_flat alone at a shared capacity via
+    _inv_flat_at (it is the only q2cap-dependent output).
     """
-    coords = np.asarray(jax.device_get(
-        cell_coords(jnp.asarray(queries, jnp.float32), grid.dim, grid.domain)))
+    coords = cell_coords_host(queries, grid.dim, grid.domain)
     n_sc = -(-grid.dim // supercell)
     sc = coords // supercell
     sid = sc[:, 0] + n_sc * (sc[:, 1] + n_sc * sc[:, 2])
@@ -60,18 +67,35 @@ def bucket_queries(queries: np.ndarray, grid: GridHash, supercell: int,
             sid_sorted.astype(np.int32))
 
 
+def _inv_flat_at(sc_starts: np.ndarray, inv_sc: np.ndarray,
+                 q2cap: int) -> np.ndarray:
+    """Recompute a bucketing's slot-partition inverse at a pinned capacity
+    -- inv_flat is the ONLY q2cap-dependent output of bucket_queries, so
+    the chunk pipeline pins every chunk to the shared capacity with one
+    cheap indexed subtraction instead of a full re-bucket (argsort +
+    bincount twice per chunk)."""
+    # same pre-cast i64 headroom rationale as bucket_queries
+    starts64 = sc_starts.astype(np.int64)                                       # kntpu-ok: wide-dtype -- pre-cast index headroom (see bucket_queries)
+    sid64 = inv_sc.astype(np.int64)                                             # kntpu-ok: wide-dtype -- pre-cast index headroom (see bucket_queries)
+    rank = np.arange(sid64.size) - starts64[sid64]
+    return (sid64 * q2cap + rank).astype(np.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("q2cap", "k", "exclude_hint",
                                              "domain", "interpret",
                                              "epilogue"))
 def _query_packed(queries_sorted: jax.Array, sc_starts: jax.Array,
                   sc_counts: jax.Array, inv_flat: jax.Array,
-                  inv_sc: jax.Array, pack, plan: SolvePlan, q2cap: int,
+                  inv_sc: jax.Array, pack, plan: SolvePlan, perm: jax.Array,
+                  q2cap: int,
                   k: int, exclude_hint: bool, domain: float,
                   interpret: bool = False, epilogue: str = "gather"):
     """Kernel launch over the plan's supercells with external query blocks.
 
-    Returns ((m,k) ids in *sorted stored-point* indexing, (m,k) d2,
-    (m,) certified), rows in *sorted query* order.  epilogue='gather' is the
+    Returns ((m,k) ids in ORIGINAL point indexing -- translated on device
+    through ``perm`` so the caller needs no host-side permutation fetch --
+    (m,k) d2, (m,) certified), rows in *sorted query* order.
+    epilogue='gather' is the
     same transpose + row-gather epilogue as pallas_solve._solve_packed;
     'scatter' has the kernel emit row-major rows at scalar-prefetched block
     offsets (_pallas_topk_rows, empty supercells sink) so only the inv_flat
@@ -111,6 +135,9 @@ def _query_packed(queries_sorted: jax.Array, sc_starts: jax.Array,
     ok = jnp.isfinite(row_d)
     row_i = jnp.where(ok, row_i, INVALID_ID)
     row_d = jnp.where(ok, row_d, jnp.inf)
+    # sorted stored-point ids -> ORIGINAL ids on device: readback stays
+    # O(m*k) and the caller never fetches the (n,) permutation
+    row_i = translate_ids(row_i, perm)
 
     lo = jnp.take(plan.box_lo.reshape(s_total, 3), inv_sc, axis=0)
     hi = jnp.take(plan.box_hi.reshape(s_total, 3), inv_sc, axis=0)
@@ -121,10 +148,12 @@ def _query_packed(queries_sorted: jax.Array, sc_starts: jax.Array,
 
 @functools.partial(jax.jit, static_argnames=("k", "tile"))
 def brute_force_by_coords(points: jax.Array, queries: jax.Array, k: int,
-                          tile: int = 8192):
+                          tile: int = 8192, ids_map: jax.Array | None = None):
     """Exact kNN of explicit query coordinates against the full stored set,
     streaming merge_topk over point tiles (the external-query twin of
-    solve.brute_force_by_index)."""
+    solve.brute_force_by_index).  ``ids_map`` (e.g. the grid permutation)
+    translates result ids on device before readback, same contract as
+    _query_class."""
     n = points.shape[0]
     n_pad = -(-n // tile) * tile
     pts = jnp.concatenate(
@@ -145,15 +174,60 @@ def brute_force_by_coords(points: jax.Array, queries: jax.Array, k: int,
     init = init_topk((queries.shape[0],), k)
     (best_d, best_i), _ = jax.lax.scan(
         body, init, (pts.reshape(-1, tile, 3), ids_all.reshape(-1, tile)))
+    if ids_map is not None:
+        best_i = translate_ids(best_i, ids_map)
     return best_i, best_d
+
+
+def _launch_packed(qs, starts, sc_counts, inv_flat, inv_sc, pack, plan, perm,
+                   q2cap: int, k: int, domain: float, interpret: bool,
+                   epilogue: str, base_key=None):
+    """One chunk's kernel launch through the executable-signature cache.
+
+    The cache key is the recompile-key census (runtime.dispatch.signature,
+    the same function the kntpu-check contract engine reports per route)
+    over the launch's abstract arguments plus its statics, prefixed by the
+    problem's prepare-time key -- so repeated problems (and repeated query
+    chunks) with the same class-shape signature reuse ONE AOT-compiled
+    executable instead of re-tracing.  A backend that cannot AOT-lower
+    falls back to the plain jitted call (EXEC_CACHE disables itself)."""
+    args = (qs, _dispatch.stage(starts), _dispatch.stage(sc_counts),
+            _dispatch.stage(inv_flat), _dispatch.stage(inv_sc), pack, plan,
+            perm)
+    statics = dict(q2cap=q2cap, k=k, exclude_hint=False, domain=domain,
+                   interpret=interpret, epilogue=epilogue)
+    # the function identity leads the key: EXEC_CACHE is process-wide, and
+    # two different launch functions with a coincidentally equal shape
+    # census must never serve each other's executables
+    key = (("ops.query._query_packed",) + tuple(base_key or ())
+           + _dispatch.signature(args, *sorted(statics.items())))
+    exe = _dispatch.EXEC_CACHE.get_or_build(
+        key, lambda: _query_packed.lower(*args, **statics).compile())
+    if exe is not None:
+        return exe(*args)
+    return _query_packed(*args, **statics)
 
 
 def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
               k: int, supercell: int, interpret: bool = False,
               fallback: str = "brute",
-              epilogue: str = "gather") -> Tuple[np.ndarray, np.ndarray]:
+              epilogue: str = "gather", chunk: int | None = None,
+              exec_key=None) -> Tuple[np.ndarray, np.ndarray]:
     """Full external-query pipeline.  Returns ((m,k) neighbor ids in ORIGINAL
     point indexing, ascending; (m,k) squared distances), rows in query order.
+
+    One-sync contract (DESIGN.md section 12): bucketing is pure host numpy,
+    every launch's inputs stage asynchronously, result ids translate to
+    original indexing ON DEVICE, and the call blocks exactly once on a
+    batched readback of every chunk's results -- plus at most one more fetch
+    for the exact resolution of uncertified kernel rows.  With ``chunk`` set
+    the queries split into fixed-size chunks whose uploads and launches
+    dispatch back-to-back (chunk i+1 stages while chunk i computes -- the
+    double buffer is the async dispatch queue itself), all chunks bucketed
+    at ONE shared per-supercell capacity so they reuse one cached executable
+    (``exec_key`` prefixes the cache key with the problem's prepare-time
+    signature census).  Byte-identical to the single-shot path
+    (tests/test_dispatch.py).
 
     `k` must not exceed the k the plan's ring radius was sized for -- the
     completeness certificate is only as deep as the candidate dilation.
@@ -162,9 +236,19 @@ def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
     m = queries.shape[0]
     if m == 0:
         return (np.empty((0, k), np.int32), np.empty((0, k), np.float32))
-    order, sc_counts, starts, q2cap, inv_flat, inv_sc = bucket_queries(
-        queries, grid, supercell, plan.n_chunks * plan.batch)
-    qs = jnp.asarray(queries[order])
+    s_total = plan.n_chunks * plan.batch
+    step = m if not chunk else max(1, int(chunk))
+    bounds = [(a, min(a + step, m)) for a in range(0, m, step)]
+    buckets = [bucket_queries(queries[a:b], grid, supercell, s_total)
+               for a, b in bounds]
+    q2cap = max(bk[3] for bk in buckets)
+    if len(bounds) > 1:
+        # pin every chunk to the shared capacity -> one executable
+        # signature; only inv_flat depends on q2cap, so this is one indexed
+        # subtraction per chunk, not a re-bucket
+        buckets = [(order, cnt, st, q2cap, _inv_flat_at(st, inv_sc, q2cap),
+                    inv_sc)
+                   for order, cnt, st, _q2, _inv, inv_sc in buckets]
 
     # Backend gate: the kernel tile must fit VMEM *with this query set's*
     # per-supercell capacity (clustered queries can exceed the stored-point
@@ -173,35 +257,46 @@ def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
     from .pallas_solve import pick_qsub
 
     use_kernel = pack is not None and pick_qsub(q2cap, pack.ccap, k) > 0
-    if use_kernel:
-        out_i, out_d, cert = _query_packed(
-            qs, jnp.asarray(starts), jnp.asarray(sc_counts),
-            jnp.asarray(inv_flat), jnp.asarray(inv_sc), pack, plan,
-            q2cap, k, False, grid.domain, interpret, epilogue)
-        out_i = np.asarray(jax.device_get(out_i))
-        out_d = np.asarray(jax.device_get(out_d))
-        cert = np.asarray(jax.device_get(cert))
-    else:
-        out_i = np.full((m, k), INVALID_ID, np.int32)
-        out_d = np.full((m, k), np.inf, np.float32)
-        cert = np.zeros((m,), bool)
 
-    # Brute resolution: fallback for uncertified kernel rows, primary path
-    # when the kernel was gated off (then it ignores fallback='none' -- it is
-    # the only exact route, not a fallback).
-    if not cert.all() and (fallback == "brute" or not use_kernel):
+    # dispatch phase: no readback between chunks -- chunk i+1's staging
+    # overlaps chunk i's compute on the async dispatch queue
+    pending = []
+    for (a, b), (order, sc_counts, starts, _q2, inv_flat, inv_sc) \
+            in zip(bounds, buckets):
+        qs = _dispatch.stage(queries[a:b][order])
+        if use_kernel:
+            r_i, r_d, r_c = _launch_packed(
+                qs, starts, sc_counts, inv_flat, inv_sc, pack, plan,
+                grid.permutation, q2cap, k, grid.domain, interpret, epilogue,
+                base_key=exec_key)
+        else:
+            r_i, r_d = brute_force_by_coords(grid.points, qs, k,
+                                             ids_map=grid.permutation)
+            r_c = None  # exact by construction
+        pending.append((r_i, r_d, r_c))
+
+    # the one sync: a single batched readback of every chunk's results
+    fetched = _dispatch.fetch(pending)
+
+    nbrs = np.empty((m, k), np.int32)
+    d2 = np.empty((m, k), np.float32)
+    cert = np.ones((m,), bool)
+    for (a, _b), (order, *_), (h_i, h_d, h_c) in zip(bounds, buckets,
+                                                     fetched):
+        rows = a + order  # sorted chunk row r belongs to input a + order[r]
+        nbrs[rows] = h_i  # fetch() already landed host numpy -- no sync here
+        d2[rows] = h_d
+        if h_c is not None:
+            cert[rows] = h_c
+
+    # Brute resolution of uncertified kernel rows (the brute-primary path is
+    # exact already): one more dispatch + batched fetch, never a sync storm.
+    if use_kernel and not cert.all() and fallback == "brute":
         bad = np.nonzero(~cert)[0].astype(np.int32)
-        b_i, b_d = brute_force_by_coords(grid.points, qs[bad], k)
-        out_i[bad] = np.asarray(b_i)
-        out_d[bad] = np.asarray(b_d)
-
-    # sorted stored-point ids -> original ids; sorted query rows -> input order
-    perm = np.asarray(jax.device_get(grid.permutation))
-    valid = out_i >= 0
-    ids_orig = np.where(valid, perm[np.clip(out_i, 0, grid.n_points - 1)],
-                        INVALID_ID)
-    nbrs = np.empty_like(ids_orig)
-    d2 = np.empty_like(out_d)
-    nbrs[order] = ids_orig
-    d2[order] = out_d
+        b_i, b_d = brute_force_by_coords(
+            grid.points, _dispatch.stage(queries[bad]), k,
+            ids_map=grid.permutation)
+        b_i, b_d = _dispatch.fetch(b_i, b_d)
+        nbrs[bad] = np.asarray(b_i)
+        d2[bad] = np.asarray(b_d)
     return nbrs, d2

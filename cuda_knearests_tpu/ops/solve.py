@@ -77,10 +77,12 @@ class KnnResult:
     dists_sq: np.ndarray | jax.Array   # (n, k) f32
     certified: np.ndarray | jax.Array  # (n,) bool
     # 0-d i32 count of uncertified rows, computed INSIDE the solve program
-    # when the producing path supports it: the fallback dispatch then costs
-    # one scalar readback instead of two eager device ops + readback (each
-    # eager dispatch is a round trip on remote-tunnel backends).  None =
-    # caller computes it (oracle/fallback-constructed results).
+    # when the producing path supports it: api._finalize then reads it in
+    # the SAME batched fetch as the result arrays (one round trip total --
+    # each eager dispatch is a round trip on remote-tunnel backends).  On
+    # api-finalized results this is always populated: the PRE-resolution
+    # count (rows the exact fallback had to resolve; certified is all-True
+    # afterwards).  None = a raw solver result whose caller computes it.
     uncert_count: np.ndarray | jax.Array | None = None
 
 

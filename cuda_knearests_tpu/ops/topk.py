@@ -20,6 +20,19 @@ import jax.numpy as jnp
 INVALID_ID = -1  # "not found" sentinel (the reference uses UINT_MAX, knearests.cu:107)
 
 
+def translate_ids(ids: jax.Array, ids_map: jax.Array) -> jax.Array:
+    """Sentinel-preserving on-device id translation: valid entries (>= 0)
+    gather through ``ids_map`` (e.g. sorted-storage index -> original id via
+    the grid permutation, or the sharded path's ext-index -> original-id
+    block); INVALID_ID rows stay INVALID_ID.  The ONE implementation every
+    solve/query route uses, so the clip bound and sentinel handling can
+    never drift between copies."""
+    return jnp.where(
+        ids >= 0,
+        jnp.take(ids_map, jnp.clip(ids, 0, ids_map.shape[0] - 1)),
+        INVALID_ID)
+
+
 def masked_topk(d2: jax.Array, ids: jax.Array, mask: jax.Array,
                 k: int) -> Tuple[jax.Array, jax.Array]:
     """Smallest-k over the last axis with a validity mask.

@@ -48,6 +48,7 @@ if _shard_map is None:  # pragma: no cover - depends on installed jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..config import DOMAIN_SIZE, KnnConfig, default_ring_radius
+from ..runtime import dispatch as _dispatch
 from ..utils.memory import InvalidConfigError, InvalidKError
 from ..ops.adaptive import (ClassPlan, _class_flat, _prepack_kernel_inputs,
                             _rows2d, build_class_specs, select_radii)
@@ -825,6 +826,7 @@ class ShardedKnnProblem:
         out_i = np.full((m, k), INVALID_ID, np.int32)
         out_d = np.full((m, k), np.inf, np.float32)
         cert = np.zeros((m,), bool)
+        pending = []  # (dest rows, device r_i, r_d, r_c) per class launch
         for d in chips:
             on_d = np.nonzero(owner == d)[0]
             if on_d.size == 0:
@@ -848,16 +850,22 @@ class ShardedKnnProblem:
                 if sel.size == 0:
                     continue
                 # ids_map=ext_ids translates ext indices to ORIGINAL ids on
-                # device, so readback is O(m*k) -- not the whole id block
+                # device, so readback is O(m*k) -- not the whole id block.
+                # No readback happens HERE: every chip's every class launch
+                # dispatches back-to-back and the results collect below in
+                # one batched fetch (the one-sync contract, DESIGN.md s12)
                 order, r_i, r_d, r_c = launch_class_query(
                     ext_pts, ext_starts, ext_counts, cp, queries[sel],
                     qrow[qcls == ci], k, cfg, meta.domain, ids_map=ext_ids)
-                sel_sorted = sel[order]
-                # one readback per class launch, bounded by max_classes per
-                # chip -- same inherent-per-launch shape as query_adaptive
-                out_i[sel_sorted] = np.asarray(jax.device_get(r_i))  # kntpu-ok: host-sync-loop -- per-class launch readback
-                out_d[sel_sorted] = np.asarray(jax.device_get(r_d))  # kntpu-ok: host-sync-loop -- per-class launch readback
-                cert[sel_sorted] = np.asarray(jax.device_get(r_c))   # kntpu-ok: host-sync-loop -- per-class launch readback
+                pending.append((sel[order], r_i, r_d, r_c))
+
+        # the one sync: a single batched readback across every chip's
+        # per-class results (device_get batches across devices), then the
+        # host placement is pure numpy
+        for rows, h_i, h_d, h_c in _dispatch.fetch(pending):
+            out_i[rows] = h_i  # fetch() already landed host numpy
+            out_d[rows] = h_d
+            cert[rows] = h_c
 
         if not cert.all():
             bad = np.nonzero(~cert)[0].astype(np.int32)
@@ -1027,14 +1035,15 @@ class ShardedKnnProblem:
         neighbors = np.full((n, k), INVALID_ID, np.int32)
         d2 = np.full((n, k), np.inf, np.float32)
         cert = np.zeros((n,), bool)
-        for d in sorted(outs):
-            if outs[d] is None:
-                continue
-            # assembly IS one readback per chip slab; the loop is bounded by
-            # ndev and each iteration moves O(n/ndev * k) result bytes
-            sids = np.asarray(jax.device_get(self._chip_inputs(d)["sids"]))   # kntpu-ok: host-sync-loop -- per-chip assembly readback
-            o_i, o_d, o_c = (np.asarray(jax.device_get(x)) for x in outs[d])  # kntpu-ok: host-sync-loop -- per-chip assembly readback
-            rows = sids >= 0
+        # assembly is ONE batched readback across every chip slab
+        # (device_get batches across devices), then pure-numpy placement --
+        # the per-chip readback loop this replaces serialized the assembly
+        # on ndev round trips (DESIGN.md section 12)
+        live = [d for d in sorted(outs) if outs[d] is not None]
+        fetched = _dispatch.fetch(
+            [(self._chip_inputs(d)["sids"],) + tuple(outs[d]) for d in live])
+        for sids, o_i, o_d, o_c in fetched:
+            rows = sids >= 0  # fetch() already landed host numpy
             neighbors[sids[rows]] = o_i[rows]
             d2[sids[rows]] = o_d[rows]
             cert[sids[rows]] = o_c[rows]

@@ -5,10 +5,18 @@ structured JSON result protocol, so a worker crash (SIGKILL, Mosaic abort,
 libtpu wedge) kills only that job; ``worker`` is the minimal child entry
 module.  See DESIGN.md section 9 for the protocol, the failure taxonomy, and
 the preflight/demotion matrix.
+
+``dispatch`` is the async-dispatch accounting layer of the one-sync solve
+(DESIGN.md section 12): the batched ``fetch``/``stage`` host-boundary
+primitives, the per-window sync/transfer counters, and the signature-keyed
+executable cache.
 """
 
+from .dispatch import (EXEC_CACHE, SYNC_BUDGET, DispatchStats,
+                       ExecutableCache, fetch, reset_stats, stage, stats)
 from .supervisor import (FAILURE_KINDS, RESULT_PREFIX, FailureRecord,
                          RetryPolicy, Supervisor)
 
 __all__ = ["FailureRecord", "RetryPolicy", "Supervisor", "FAILURE_KINDS",
-           "RESULT_PREFIX"]
+           "RESULT_PREFIX", "DispatchStats", "ExecutableCache", "EXEC_CACHE",
+           "SYNC_BUDGET", "fetch", "stage", "stats", "reset_stats"]

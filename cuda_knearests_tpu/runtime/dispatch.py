@@ -1,0 +1,285 @@
+"""Async dispatch instrumentation: the one-sync solve's accounting layer.
+
+The reference runs its whole solve as one kernel launch plus one explicit
+D2H phase (/root/reference/knearests.cu:349-376) -- host synchronization is
+*structural* there, visible in the program text.  A JAX engine hides it:
+``jax.device_get`` / ``np.asarray`` on a device array blocks the host, and
+on remote-tunnel backends each such call is a full round trip.  TPU-KNN
+(arXiv 2206.14286, PAPERS.md) reaches peak FLOP/s precisely by keeping
+dispatch asynchronous and never round-tripping mid-solve.
+
+This module makes the engine's host-boundary traffic explicit and countable:
+
+* :func:`fetch` -- the ONE sanctioned readback primitive: a single batched
+  ``jax.device_get`` over everything the caller needs (one host sync no
+  matter how many arrays ride it).  Every solve route reads back through it,
+  so ``stats()`` reports exactly how many times a solve blocked.
+* :func:`stage` -- the H2D twin: counted, non-blocking device staging.
+* :class:`DispatchStats` / :func:`reset_stats` / :func:`stats` -- per-window
+  counters (``host_syncs`` / ``d2h_bytes`` / ``h2d_bytes``) consumed by the
+  tier-1 sync-budget tests, ``bench.py`` row stamps, and
+  ``scripts/phase_breakdown.py``.
+* :func:`signature` -- the recompile key of a traced call (every leaf's
+  shape/dtype plus the static arguments): the same census the kntpu-check
+  contract engine computes (``analysis/contracts.py`` imports this), reused
+  here to key the executable cache.
+* :class:`ExecutableCache` -- prepare/launch-time cache of AOT-compiled
+  executables keyed by :func:`signature`, so repeated problems (and repeated
+  query chunks) with the same class-shape signature reuse one compiled
+  program instead of re-tracing (DESIGN.md section 12).
+
+``python -m cuda_knearests_tpu.runtime.dispatch`` runs the CPU sync-budget
+smoke (all four solve routes on a small fixture, each must complete within
+:data:`SYNC_BUDGET` host round trips) -- wired into ``scripts/check.sh``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import warnings
+from collections import OrderedDict
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+# The one-sync solve contract (DESIGN.md section 12): a solve or query call
+# completes with at most one batched readback of its assembled results, plus
+# at most one more for the exact resolution of uncertified rows.
+SYNC_BUDGET = 2
+
+
+@dataclasses.dataclass
+class DispatchStats:
+    """Host-boundary traffic counters for one measurement window.
+
+    ``host_syncs`` counts BLOCKING host round trips (batched ``fetch`` calls
+    that actually touched a device array); ``d2h_bytes``/``h2d_bytes`` the
+    result/staging traffic that rode them.  Async H2D staging is traffic,
+    not a sync -- dispatch continues while it is in flight."""
+
+    host_syncs: int = 0
+    d2h_bytes: int = 0
+    h2d_bytes: int = 0
+
+    def as_dict(self) -> dict:
+        return {"host_syncs": self.host_syncs,
+                "d2h_bytes": self.d2h_bytes,
+                "h2d_bytes": self.h2d_bytes}
+
+
+_STATS = DispatchStats()
+# Guards the counter increments so concurrent solves cannot corrupt them.
+# The counters themselves are still ONE process-wide window: a measurement
+# (reset_stats .. stats) only attributes syncs to a single solve when no
+# other thread dispatches inside the window -- the bench/test harnesses are
+# single-threaded by construction; concurrent serving should read the
+# counters as process totals.
+_STATS_LOCK = threading.Lock()
+
+
+def reset_stats() -> None:
+    """Zero the counters (the start of a measurement window).  See the
+    single-threaded-window caveat on _STATS_LOCK."""
+    with _STATS_LOCK:
+        _STATS.host_syncs = 0
+        _STATS.d2h_bytes = 0
+        _STATS.h2d_bytes = 0
+
+
+def stats() -> DispatchStats:
+    """Snapshot of the current window's counters."""
+    with _STATS_LOCK:
+        return dataclasses.replace(_STATS)
+
+
+def stats_dict() -> dict:
+    return stats().as_dict()
+
+
+def _device_leaves(tree: Any) -> list:
+    import jax
+
+    return [l for l in jax.tree_util.tree_leaves(tree)
+            if isinstance(l, jax.Array)]
+
+
+def fetch(*trees: Any) -> Any:
+    """ONE batched D2H readback of everything passed, counted as one sync.
+
+    Accepts any pytrees (device arrays, numpy arrays, scalars mixed); the
+    whole batch moves through a single ``jax.device_get`` call, so the host
+    blocks once regardless of how many arrays ride it.  A batch with no
+    device leaves (e.g. the oracle backend's host results) costs zero syncs.
+    Returns host values with the argument structure (a single argument comes
+    back bare, several as a tuple)."""
+    import jax
+
+    dev = _device_leaves(trees)
+    if dev:
+        with _STATS_LOCK:
+            _STATS.host_syncs += 1
+            _STATS.d2h_bytes += int(sum(l.nbytes for l in dev))
+    out = jax.device_get(trees)
+    return out[0] if len(out) == 1 else out
+
+
+def stage(x: Any, dtype: Any = None):
+    """Counted async H2D staging (``jnp.asarray``): traffic, not a sync.
+
+    The upload is dispatched and the host continues -- the double-buffered
+    query chunk pipeline leans on exactly this (chunk i+1 uploads while
+    chunk i computes, DESIGN.md section 12)."""
+    import jax
+    import jax.numpy as jnp
+
+    if not isinstance(x, jax.Array):
+        arr = np.asarray(x) if dtype is None else np.asarray(x, dtype)
+        with _STATS_LOCK:
+            _STATS.h2d_bytes += int(arr.nbytes)
+        return jnp.asarray(arr)
+    return x if dtype is None else jnp.asarray(x, dtype)
+
+
+def signature(tree: Any, *statics: Any) -> Tuple:
+    """Recompile key of a traced call: every leaf's (shape, dtype) plus the
+    static arguments -- what jit would key its compilation cache on.  The
+    same census the kntpu-check contract engine reports per route
+    (``analysis/contracts.py`` delegates here), reused as the
+    :class:`ExecutableCache` key so cache identity and the static checker's
+    recompile-key rule can never drift apart."""
+    import jax
+
+    leaves = tuple((tuple(l.shape), str(np.dtype(l.dtype)))
+                   for l in jax.tree_util.tree_leaves(tree))
+    return leaves + tuple(statics)
+
+
+class ExecutableCache:
+    """Signature-keyed cache of AOT-compiled executables.
+
+    ``jax.jit`` already caches per (function, abstract signature) inside one
+    wrapper; this cache makes the reuse *explicit and countable* across
+    problems and query chunks: the key is the :func:`signature` census
+    computed at prepare/launch time, the value a ``lower().compile()``
+    product.  A build failure (e.g. a backend that cannot AOT-lower the
+    launch) disables the cache for the process -- callers fall back to their
+    plain jitted path, losing only the explicit reuse accounting."""
+
+    def __init__(self, maxsize: int = 64):
+        self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.maxsize = int(maxsize)
+        self.hits = 0
+        self.misses = 0
+        self.enabled = True
+        self.disabled_by: Optional[str] = None
+
+    def get_or_build(self, key: Tuple, build: Callable[[], Any]):
+        """The cached executable for ``key``, building (and caching) on miss.
+        Returns None when the cache is disabled or the build fails -- the
+        caller then runs its plain jitted path."""
+        with self._lock:
+            if not self.enabled:
+                return None
+            if key in self._cache:
+                self.hits += 1
+                self._cache.move_to_end(key)
+                return self._cache[key]
+            self.misses += 1
+        try:
+            exe = build()
+        except Exception as e:  # noqa: BLE001 -- AOT lowering is an optimization; a backend that cannot lower falls back to the jitted path, never fails the query
+            # record + announce WHY before disabling, so the silent
+            # fall-back-to-retracing degradation is diagnosable (the reason
+            # also rides stats_dict into bench artifacts)
+            with self._lock:
+                self.enabled = False
+                self.disabled_by = f"{type(e).__name__}: {e}"
+            warnings.warn(
+                f"executable cache disabled (AOT lower/compile failed; "
+                f"queries fall back to the jitted path): {self.disabled_by}",
+                RuntimeWarning, stacklevel=2)
+            return None
+        with self._lock:
+            self._cache[key] = exe
+            while len(self._cache) > self.maxsize:
+                self._cache.popitem(last=False)
+        return exe
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cache.clear()
+            self.hits = 0
+            self.misses = 0
+            self.enabled = True
+            self.disabled_by = None
+
+    def stats_dict(self) -> dict:
+        with self._lock:
+            out = {"exec_cache_hits": self.hits,
+                   "exec_cache_misses": self.misses,
+                   "exec_cache_size": len(self._cache)}
+            if self.disabled_by is not None:
+                out["exec_cache_disabled_by"] = self.disabled_by
+            return out
+
+
+# Process-wide executable cache (the external-query chunk pipeline's compiled
+# launches live here; see ops/query.py).
+EXEC_CACHE = ExecutableCache()
+
+
+# -- CPU sync-budget smoke (scripts/check.sh) ---------------------------------
+
+def _smoke(n: int = 4000, budget: int = SYNC_BUDGET) -> int:
+    """Run all four solve routes on a small fixture and enforce the sync
+    budget on each -- the check.sh CPU smoke for the one-sync contract."""
+    import json
+
+    import jax
+
+    from .. import KnnConfig, KnnProblem
+    from ..io import generate_uniform
+    from ..parallel.sharded import ShardedKnnProblem
+
+    points = generate_uniform(n, seed=5)
+    queries = generate_uniform(max(256, n // 16), seed=6)
+    rc = 0
+
+    def row(route: str, run) -> None:
+        nonlocal rc
+        reset_stats()
+        run()
+        s = stats()
+        ok = s.host_syncs <= budget
+        rc |= 0 if ok else 1
+        print(json.dumps({"route": route, "budget": budget, "ok": ok,
+                          **s.as_dict()}), flush=True)
+
+    p_a = KnnProblem.prepare(points, KnnConfig(k=8))
+    row("adaptive-solve", p_a.solve)
+    p_l = KnnProblem.prepare(points, KnnConfig(k=8, adaptive=False))
+    row("legacy-pack-solve", p_l.solve)
+    row("external-query[adaptive]", lambda: p_a.query(queries))
+    p_c = KnnProblem.prepare(points, KnnConfig(
+        k=8, adaptive=False, query_chunk=128))
+    row("external-query[chunked]", lambda: p_c.query(queries))
+    sp = ShardedKnnProblem.prepare(
+        points, n_devices=min(2, len(jax.devices())),
+        config=KnnConfig(k=8))
+    row("sharded-solve", sp.solve)
+    row("sharded-query", lambda: sp.query(queries))
+    return rc
+
+
+if __name__ == "__main__":
+    import sys
+
+    # `python -m` executes this file as the `__main__` module, a DIFFERENT
+    # module object from the `cuda_knearests_tpu.runtime.dispatch` the engine
+    # imports -- run the canonical instance's smoke so its counters are the
+    # ones the solve routes actually increment
+    from cuda_knearests_tpu.runtime.dispatch import _smoke as _canonical
+
+    sys.exit(_canonical())

@@ -236,3 +236,13 @@ def nbytes(tree: Any) -> int:
     """
     leaves = jax.tree.leaves(tree)
     return int(sum(getattr(l, "nbytes", 0) for l in leaves))
+
+
+def device_nbytes(tree: Any) -> int:
+    """Bytes of the DEVICE-resident arrays in a pytree only.
+
+    Plans deliberately carry host-resident numpy leaves (e.g. the adaptive
+    plan's query-bucketing maps, hoisted off-device by the one-sync solve,
+    DESIGN.md section 12) -- a device-footprint stat must not count them."""
+    leaves = jax.tree.leaves(tree)
+    return int(sum(l.nbytes for l in leaves if isinstance(l, jax.Array)))

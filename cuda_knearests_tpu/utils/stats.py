@@ -15,7 +15,7 @@ from typing import Any, Dict
 
 import numpy as np
 
-from .memory import nbytes
+from .memory import device_nbytes
 
 
 def occupancy_stats(cell_counts: np.ndarray) -> Dict[str, Any]:
@@ -140,8 +140,10 @@ def problem_stats(problem) -> Dict[str, Any]:
         "ring_radius": problem.config.resolved_ring_radius(),
         "supercell": problem.config.supercell,
         "occupancy": occupancy_stats(np.asarray(grid.cell_counts)),
-        "device_bytes": nbytes((grid, problem.plan, aplan,
-                                getattr(problem, "pack", None))),
+        # device-resident leaves only: the adaptive plan's query-bucketing
+        # maps are deliberately host numpy (one-sync hoist, DESIGN.md s12)
+        "device_bytes": device_nbytes((grid, problem.plan, aplan,
+                                       getattr(problem, "pack", None))),
     }
     # aplan wins the report when both schedules exist: solve() routes adaptive
     # whenever an aplan is present, the legacy plan then only serves query()

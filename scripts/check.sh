@@ -46,4 +46,13 @@ JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
     --cases "${KNTPU_FUZZ_CASES:-32}" --seed 0 --budget 60s \
     --isolation none || rc=1
 
+# Sync-budget smoke (DESIGN.md section 12): every solve route -- adaptive,
+# legacy pack, external query (single-shot + chunked pipeline), sharded
+# solve + query -- must complete within the one-sync contract's budget of
+# <= 2 host round trips, counted by the runtime.dispatch instrumentation.
+echo "== sync-budget smoke (one-sync solve contract, CPU-only) =="
+JAX_PLATFORMS=cpu python -c \
+    "from cuda_knearests_tpu.runtime.dispatch import _smoke; \
+     raise SystemExit(_smoke())" || rc=1
+
 exit $rc

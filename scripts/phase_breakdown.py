@@ -35,6 +35,7 @@ from kernel_ab import (liveness_op,  # shared timing + rc-contract helpers
                        steady, transport_shaped)
 from cuda_knearests_tpu import KnnConfig, KnnProblem
 from cuda_knearests_tpu.io import get_dataset, generate_uniform
+from cuda_knearests_tpu.runtime import dispatch
 from cuda_knearests_tpu.utils import watchdog
 
 
@@ -76,14 +77,23 @@ def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
 
     def t_epilogue():
         out = _solve_adaptive(grid.points, grid.cell_starts,
-                              grid.cell_counts, plan, cfg.k,
+                              grid.cell_counts, plan.classes, plan.inv_row,
+                              plan.inv_box, plan.n_points, cfg.k,
                               cfg.exclude_self, grid.domain, cfg.interpret,
                               cfg.stream_tile, cfg.effective_kernel(), epi)
         jax.block_until_ready(out)
 
+    # per-run counter window, like bench.py's run(): the stamped fields
+    # describe exactly one full solve (the last timed iteration) and
+    # separate dispatch wall from blocked wall at zero extra solves
+    sync_fields = {}
+
     def t_full():
+        dispatch.reset_stats()
         r = p.solve()
         jax.block_until_ready((r.neighbors, r.dists_sq, r.certified))
+        sync_fields.clear()
+        sync_fields.update(dispatch.stats_dict())
 
     ms_k = steady(t_kernel) * 1e3
     ms_e = steady(t_epilogue) * 1e3
@@ -111,6 +121,7 @@ def breakdown(tag: str, points: np.ndarray, cfg: KnnConfig) -> None:
         "epilogue_pct": round(100 * (ms_e - ms_k) / ms_f, 1),
         "sync_pct": round(100 * (ms_f - ms_e) / ms_f, 1),
         "qps": round(n / (ms_f / 1e3), 1),
+        **sync_fields,
         **roof,
     }), flush=True)
 

@@ -1,0 +1,18 @@
+"""Lint fixture: the retired per-class readback pattern (never run).
+
+This is the exact shape the engine's query paths carried before the one-sync
+solve (PR 5): one launch per capacity class, three blocking host readbacks
+per class inside the loop.  The host-sync-loop rule must keep firing on it so
+the pattern can never quietly return without a reasoned waiver.
+"""
+import jax
+import numpy as np
+
+
+def assemble(classes, launch, out_i, out_d, cert):
+    for sel_sorted, cp in classes:
+        r_i, r_d, r_c = launch(cp)
+        out_i[sel_sorted] = np.asarray(jax.device_get(r_i))  # line 15
+        out_d[sel_sorted] = np.asarray(jax.device_get(r_d))  # line 16
+        cert[sel_sorted] = np.asarray(jax.device_get(r_c))   # line 17
+    return out_i, out_d, cert

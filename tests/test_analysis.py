@@ -38,6 +38,9 @@ def _lint(path):
     ("bad_tracer_leak.py", "tracer-leak", {11, 16}),
     ("bad_wide_dtype.py", "wide-dtype", {6, 7}),
     ("bad_host_sync_loop.py", "host-sync-loop", {8, 9, 10}),
+    # the retired per-class readback loop (PR 5's one-sync solve deleted the
+    # engine's three waivers; this pins the rule still catches the pattern)
+    ("bad_per_class_readback.py", "host-sync-loop", {15, 16, 17}),
     ("bad_broad_except.py", "broad-except", {7}),
     ("bad_jnp_in_loop.py", "jnp-in-loop", {8}),
     ("bad_bare_valueerror.py", "bare-valueerror", {6, 8}),

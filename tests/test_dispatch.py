@@ -1,0 +1,212 @@
+"""One-sync solve: sync-budget regression + dispatch instrumentation.
+
+The counter-backed acceptance gate of the async-dispatch pipeline
+(DESIGN.md section 12): every solve route -- adaptive self-solve, legacy
+pack, external query (adaptive, legacy, and the chunked pipeline), and the
+sharded per-chip engine -- must complete within
+``runtime.dispatch.SYNC_BUDGET`` (= 2) host round trips on the reference's
+20k fixture, where the pre-PR-5 engine blocked on three readbacks per
+capacity class.  Also pins:
+
+  * the ``fetch``/``stage`` counting semantics the budget test relies on,
+  * byte-identity of the chunked (double-buffered) external-query pipeline
+    against the single-shot path,
+  * the executable-signature cache (reuse across same-signature launches),
+  * the ``_finalize`` fallback bugfix: an uncertified row costs exactly one
+    extra batched fetch, never a second sync storm.
+"""
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.io import generate_blue_noise, generate_uniform
+from cuda_knearests_tpu.runtime import dispatch
+
+
+def _count(run):
+    dispatch.reset_stats()
+    out = run()
+    return dispatch.stats(), out
+
+
+# -- counting semantics -------------------------------------------------------
+
+def test_fetch_batches_as_one_sync():
+    import jax.numpy as jnp
+
+    a = jnp.arange(128, dtype=jnp.float32)
+    b = jnp.arange(64, dtype=jnp.int32)
+    stats, (ha, hb) = _count(lambda: dispatch.fetch(a, b))
+    assert stats.host_syncs == 1
+    assert stats.d2h_bytes == a.nbytes + b.nbytes
+    assert isinstance(ha, np.ndarray) and isinstance(hb, np.ndarray)
+    np.testing.assert_array_equal(ha, np.arange(128, dtype=np.float32))
+
+
+def test_fetch_host_only_is_free():
+    stats, _ = _count(lambda: dispatch.fetch(np.zeros(8), [np.ones(3), None]))
+    assert stats.host_syncs == 0 and stats.d2h_bytes == 0
+
+
+def test_stage_counts_h2d_not_sync():
+    import jax
+
+    x = np.zeros((16, 3), np.float32)
+    stats, dev = _count(lambda: dispatch.stage(x))
+    assert isinstance(dev, jax.Array)
+    assert stats.h2d_bytes == x.nbytes and stats.host_syncs == 0
+    # re-staging an already-device array moves nothing
+    stats, _ = _count(lambda: dispatch.stage(dev))
+    assert stats.h2d_bytes == 0
+
+
+def test_signature_census():
+    a = np.zeros((4, 3), np.float32)
+    b = np.zeros((4, 3), np.float32)
+    assert dispatch.signature((a,), 8) == dispatch.signature((b,), 8)
+    assert dispatch.signature((a,), 8) != dispatch.signature((a,), 9)
+    assert dispatch.signature((a,), 8) != dispatch.signature(
+        (a.astype(np.int32),), 8)
+
+
+def test_executable_cache_reuse():
+    cache = dispatch.ExecutableCache(maxsize=4)
+    built = []
+
+    def build():
+        built.append(1)
+        return "exe"
+
+    key = dispatch.signature((np.zeros(3),), "s")
+    assert cache.get_or_build(key, build) == "exe"
+    assert cache.get_or_build(key, build) == "exe"
+    assert len(built) == 1 and cache.hits == 1 and cache.misses == 1
+
+    def boom():
+        raise RuntimeError("no AOT here")
+
+    assert cache.get_or_build(("other",), boom) is None
+    assert not cache.enabled  # failed build disables, callers fall back
+    assert cache.get_or_build(key, build) is None  # disabled: jitted path
+
+
+# -- the sync-budget regression gate (ISSUE 5 acceptance) ---------------------
+
+@pytest.fixture(scope="module")
+def queries_2k():
+    return generate_uniform(2_000, seed=99)
+
+
+def test_budget_adaptive_solve(pts20k):
+    p = KnnProblem.prepare(pts20k, KnnConfig(k=10))
+    assert p.aplan is not None  # the adaptive route, not a stand-in
+    stats, res = _count(p.solve)
+    assert stats.host_syncs <= dispatch.SYNC_BUDGET
+    assert np.asarray(res.certified).all()
+
+
+def test_budget_legacy_pack_solve(pts20k):
+    p = KnnProblem.prepare(pts20k, KnnConfig(k=10, adaptive=False))
+    assert p.plan is not None
+    stats, _ = _count(p.solve)
+    assert stats.host_syncs <= dispatch.SYNC_BUDGET
+
+
+def test_budget_external_query_adaptive(pts20k, queries_2k):
+    p = KnnProblem.prepare(pts20k, KnnConfig(k=10))
+    stats, (ids, d2) = _count(lambda: p.query(queries_2k))
+    assert stats.host_syncs <= dispatch.SYNC_BUDGET
+    assert ids.shape == (2_000, 10) and (np.diff(d2, axis=1) >= 0).all()
+
+
+def test_budget_external_query_legacy_chunked(pts20k, queries_2k):
+    p = KnnProblem.prepare(pts20k, KnnConfig(k=10, adaptive=False,
+                                             query_chunk=256))
+    stats, (ids, _) = _count(lambda: p.query(queries_2k))
+    # 8 chunks, still <= 2 syncs: the pipeline batches all readbacks
+    assert stats.host_syncs <= dispatch.SYNC_BUDGET
+    assert ids.shape == (2_000, 10)
+
+
+def test_budget_sharded_solve_and_query(pts20k, queries_2k):
+    from cuda_knearests_tpu.parallel.sharded import ShardedKnnProblem
+
+    sp = ShardedKnnProblem.prepare(pts20k, n_devices=8,
+                                   config=KnnConfig(k=10))
+    stats, (nbrs, _, cert) = _count(sp.solve)
+    assert stats.host_syncs <= dispatch.SYNC_BUDGET
+    assert cert.all() and nbrs.shape == (pts20k.shape[0], 10)
+    stats, (ids, d2) = _count(lambda: sp.query(queries_2k))
+    assert stats.host_syncs <= dispatch.SYNC_BUDGET
+    assert ids.shape == (2_000, 10) and (np.diff(d2, axis=1) >= 0).all()
+
+
+def test_fallback_is_one_extra_fetch_not_a_storm(uniform_10k):
+    """The _finalize bugfix: with uncertified rows, the brute resolution
+    rides ONE more batched fetch (2 round trips total), and the resolved
+    result is exact."""
+    p = KnnProblem.prepare(uniform_10k, KnnConfig(k=24, ring_radius=1))
+    stats, res = _count(p.solve)
+    assert stats.host_syncs <= dispatch.SYNC_BUDGET
+    assert np.asarray(res.certified).all()  # fallback resolved every row
+    # differential vs the no-starvation plan: identical neighbors
+    ref = KnnProblem.prepare(uniform_10k, KnnConfig(k=24))
+    ref.solve()
+    np.testing.assert_array_equal(ref.get_knearests_original(),
+                                  p.get_knearests_original())
+
+
+# -- chunked pipeline: byte-identity + executable reuse -----------------------
+
+def _query_both(points, queries, chunk, **cfg_kw):
+    outs = {}
+    for label, qc in (("single", None), ("chunked", chunk)):
+        p = KnnProblem.prepare(points, KnnConfig(query_chunk=qc, **cfg_kw))
+        outs[label] = p.query(queries)
+    return outs
+
+
+def test_chunked_matches_single_shot_brute(pts20k, queries_2k):
+    """Default CPU legacy route (brute primary): chunking must not change a
+    byte."""
+    outs = _query_both(pts20k, queries_2k, chunk=300, k=10, adaptive=False)
+    np.testing.assert_array_equal(outs["single"][0], outs["chunked"][0])
+    np.testing.assert_array_equal(outs["single"][1], outs["chunked"][1])
+
+
+def test_chunked_matches_single_shot_kernel(blue_8k, rng):
+    """Interpret-mode kernel route: chunks share one executable signature
+    (one shared q2cap) and stay byte-identical to single shot."""
+    queries = rng.uniform(0.0, 1000.0, (700, 3)).astype(np.float32)
+    outs = _query_both(blue_8k, queries, chunk=200, k=8, adaptive=False,
+                       backend="pallas", interpret=True)
+    np.testing.assert_array_equal(outs["single"][0], outs["chunked"][0])
+    np.testing.assert_array_equal(outs["single"][1], outs["chunked"][1])
+
+
+def test_chunked_kernel_reuses_executable(blue_8k, rng):
+    """Across same-shape chunks the executable cache must hit (when the
+    backend can AOT-lower at all; a disabled cache skips, not fails)."""
+    queries = rng.uniform(0.0, 1000.0, (600, 3)).astype(np.float32)
+    p = KnnProblem.prepare(blue_8k, KnnConfig(
+        k=8, adaptive=False, backend="pallas", interpret=True,
+        query_chunk=150))
+    dispatch.EXEC_CACHE.clear()
+    p.query(queries)
+    if not dispatch.EXEC_CACHE.enabled:
+        pytest.skip("backend cannot AOT-lower the query launch")
+    st = dispatch.EXEC_CACHE.stats_dict()
+    assert st["exec_cache_misses"] >= 1
+    assert st["exec_cache_hits"] >= 1  # chunks 2..4 reuse chunk 1's compile
+    # a repeat query re-traces nothing
+    before = st["exec_cache_hits"]
+    p.query(queries)
+    assert dispatch.EXEC_CACHE.stats_dict()["exec_cache_hits"] > before
+
+
+def test_query_chunk_resolution():
+    cfg = KnnConfig(query_chunk=128)
+    assert cfg.resolved_query_chunk() == 128
+    assert KnnConfig().resolved_query_chunk() is None
+    assert KnnConfig(query_chunk=0).resolved_query_chunk() is None
