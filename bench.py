@@ -521,6 +521,99 @@ _ALL_CONFIGS = ("kdtree_cpu_20k", "grid_300k_k10", "blue_900k_k20",
                 "sharded_10m_k10")
 
 
+# -- serving rows (--serve): the open-loop load harness as first-class bench --
+
+_SERVE_SCENARIOS = ("serve_20k_steady", "serve_20k_mutating",
+                    "serve_20k_contained_fault")
+
+
+def serve_scenario(name: str) -> dict:
+    """One open-loop serving session (serve/, DESIGN.md section 13) as a
+    bench row: sustained QPS under Poisson arrivals, p50/p99/p999 latency,
+    batch occupancy, steady-state recompile count, and the dispatch-layer
+    host-sync counters -- all measured on the 20k fixture so the rows land
+    on CPU CI exactly like everywhere else.
+
+    ``serve_20k_contained_fault`` seeds a synthetic batch fault
+    (KNTPU_SERVE_FAULT, an injected oom on one batch) plus one malformed
+    request: the row demonstrates the containment law -- the fault costs
+    its batch (typed failure_kinds entry), the refusal costs its request
+    (typed, kind 'invalid-input'), and the daemon finishes the session."""
+    import numpy as np
+
+    from cuda_knearests_tpu import KnnConfig, KnnProblem
+    from cuda_knearests_tpu.config import ServeConfig
+    from cuda_knearests_tpu.io import get_dataset
+    from cuda_knearests_tpu.serve import LoadSpec, ServeDaemon, run_session
+
+    if name not in _SERVE_SCENARIOS:
+        raise ValueError(f"unknown serve scenario {name!r}")
+    points = get_dataset("pts20K.xyz")
+    k = 10
+    # the serving problem pins the legacy external-query route: its
+    # launches ride the executable cache, which is what makes the
+    # zero-recompile steady state countable
+    problem = KnnProblem.prepare(points, KnnConfig(k=k, adaptive=False))
+    _watchdog.heartbeat()
+    _dispatch.EXEC_CACHE.clear()
+    cfg = ServeConfig(max_batch=128, max_delay_s=0.004,
+                      compact_threshold=4096)
+    specs = {
+        "serve_20k_steady": LoadSpec(rate=400.0, requests=240, seed=20),
+        "serve_20k_mutating": LoadSpec(rate=400.0, requests=160,
+                                       mutation_ratio=0.2, seed=21),
+        "serve_20k_contained_fault": LoadSpec(rate=400.0, requests=120,
+                                              seed=22),
+    }
+    fault_env = None
+    if name == "serve_20k_contained_fault":
+        fault_env = os.environ.get("KNTPU_SERVE_FAULT")
+        os.environ["KNTPU_SERVE_FAULT"] = "batch:1:oom"
+    try:
+        daemon = ServeDaemon(problem, cfg)
+        _watchdog.heartbeat()  # warmup compiled every bucket
+        summary = run_session(daemon, specs[name])
+        refused_probe = 0
+        if name == "serve_20k_contained_fault":
+            # one deliberately malformed request: out-of-domain coords must
+            # refuse typed (kind 'invalid-input'), costing nothing else
+            bad = np.full((4, 3), -5.0, np.float32)
+            resp = daemon.submit(req_id=-1, kind="query", payload=bad)
+            refused_probe = int(bool(resp and not resp[0].ok
+                                     and resp[0].failure_kind
+                                     == "invalid-input"))
+    finally:
+        if name == "serve_20k_contained_fault":
+            if fault_env is None:
+                os.environ.pop("KNTPU_SERVE_FAULT", None)
+            else:
+                os.environ["KNTPU_SERVE_FAULT"] = fault_env
+    row = {
+        "config": f"serving [{name}]: open-loop Poisson "
+                  f"{specs[name].rate:g}/s on pts20K.xyz (k={k})",
+        "value": summary["sustained_qps"],
+        "unit": "queries/sec",
+        "n_points": points.shape[0],
+        **{key: summary[key] for key in (
+            "requests", "completed_queries", "failed_requests", "refused",
+            "p50_ms", "p99_ms", "p999_ms", "elapsed_s", "recompiles",
+            "batches", "failed_batches", "failure_kinds", "occupancy_mean",
+            "flushes", "host_syncs", "d2h_bytes", "h2d_bytes",
+            "exec_cache_hits", "exec_cache_misses", "exec_cache_evictions",
+            "mutation_ratio")},
+        **{key: summary[key] for key in summary if key.startswith("overlay_")},
+    }
+    if name == "serve_20k_contained_fault":
+        row["refusal_typed"] = bool(refused_probe)
+        # the containment law, machine-checkable on the row itself: the
+        # injected fault cost exactly one batch and the daemon finished
+        row["containment_ok"] = bool(
+            summary["failed_batches"] == 1
+            and summary["failure_kinds"].get("oom") == 1
+            and summary["completed_queries"] > 0 and refused_probe)
+    return row
+
+
 def _analysis_fields() -> dict:
     """kntpu-check traceability stamp (ISSUE 3): which static-gate version
     and accepted-findings baseline the measured tree carries, so every bench
@@ -576,6 +669,15 @@ def main(argv=None) -> int:
                             "exit (rc 0 iff the row carries no error) -- "
                             "used for rc-stamped single-row artifacts, e.g. "
                             "the full-size sharded run")
+    group.add_argument("--serve", action="store_true",
+                       help="measure the serving scenarios instead: one "
+                            "JSON row per open-loop load session "
+                            "(sustained QPS, p50/p99/p999 latency, batch "
+                            "occupancy, recompile count) on the 20k "
+                            "fixture, CPU-capable.  Supervised by default "
+                            "like --all: each session runs in an isolated "
+                            "worker, so a daemon process death costs one "
+                            "typed failure row")
     ap.add_argument("--skip", choices=_ALL_CONFIGS, action="append",
                     default=None,
                     help="with --all: leave this config out entirely "
@@ -597,8 +699,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.skip and not args.all:
         ap.error("--skip requires --all")
-    if args.no_supervise and not args.all:
-        ap.error("--no-supervise requires --all")
+    if args.no_supervise and not (args.all or args.serve):
+        ap.error("--no-supervise requires --all or --serve")
 
     # cheap env stamp for the signal/error paths; refreshed with real jax
     # device info once the backend is safely up (the handler itself must never
@@ -649,6 +751,49 @@ def main(argv=None) -> int:
                                                    honor_jax_platforms_env)
     honor_jax_platforms_env()
     enable_compile_cache()  # remote-tunnel compiles persist across runs
+
+    if args.serve:
+        # Serving rows (ISSUE 6): one row per open-loop load scenario.
+        # Supervised by default, same rationale as --all -- the PR 2
+        # supervisor is the daemon's whole-process crash boundary, so a
+        # serving session that dies (SIGKILL mid-batch on hardware) costs
+        # one typed failure row, never the bench.  rc 0 iff every row
+        # landed without error.
+        rc = 0
+        if args.no_supervise:
+            env = _env_fields(platform)
+            for name in _SERVE_SCENARIOS:
+                _watchdog.heartbeat()
+                try:
+                    row = serve_scenario(name)
+                except Exception as e:  # noqa: BLE001 -- keep measuring the rest
+                    row = {"config": name,
+                           "error": f"{type(e).__name__}: {e}"}
+                    rc = 1
+                row.update(env)
+                print(json.dumps(row), flush=True)
+            state["emitted"] = True
+            return rc
+        _watchdog.disable()  # parent does no device work (workers do)
+        from cuda_knearests_tpu.runtime import Supervisor
+
+        sup = Supervisor()
+        a_fields = _analysis_fields()
+        a_fields.update(_fuzz_fields())
+        for name in _SERVE_SCENARIOS:
+            row, failure = sup.run_job(
+                name, {"job": "serve_scenario", "name": name})
+            if failure is not None:
+                row = {"config": name,
+                       "error": f"supervised serve worker failed "
+                                f"[{failure.kind}]: {failure.message}",
+                       "failure": failure.to_json(),
+                       "platform": platform}
+                rc = 1
+            row.update(a_fields)
+            print(json.dumps(row), flush=True)
+        state["emitted"] = True
+        return rc
 
     if args.all and not args.no_supervise:
         # Supervised mode (default for --all): each row runs in an isolated

@@ -332,7 +332,8 @@ def _r_broad_except(ctx: FileContext) -> Iterator[Finding]:
       "bare ValueError raise on an input-validation path (use the typed "
       "input-contract taxonomy)",
       path_filter=("cuda_knearests_tpu/io.py", "cuda_knearests_tpu/api.py",
-                   "cuda_knearests_tpu/parallel/"))
+                   "cuda_knearests_tpu/parallel/",
+                   "cuda_knearests_tpu/serve/"))
 def _r_bare_valueerror(ctx: FileContext) -> Iterator[Finding]:
     """The input front door (io.validate_or_raise) exists so that illegal
     input is refused with the TYPED taxonomy (utils/memory.py

@@ -145,6 +145,13 @@ class KnnProblem:
             (self.plan, self.aplan), self.config.k, self.config.supercell,
             self.grid.dim, self.grid.n_points)
 
+    def with_points(self, points, validate: bool = True) -> "KnnProblem":
+        """A fresh problem over ``points`` under THIS problem's config --
+        the rebuild-from-scratch primitive of the serving delta overlay
+        (serve/delta.py compacts through it, and the mutation fuzz uses it
+        as the oracle the overlay is pinned byte-identical against)."""
+        return KnnProblem.prepare(points, self.config, validate=validate)
+
     def _adaptive_eligible(self) -> bool:
         cfg = self.config
         if not (cfg.adaptive and cfg.dist_method == "diff"):

@@ -73,6 +73,16 @@ def main(argv=None) -> int:
     ap.add_argument("--no-oracle", action="store_true",
                     help="skip the CPU oracle comparison (benchmark mode)")
     ap.add_argument("--json", action="store_true", help="emit a JSON summary line")
+    ap.add_argument("--serve", type=float, default=None, metavar="RATE",
+                    help="serve the loaded cloud instead of solving it: "
+                         "run the open-loop load harness (Poisson arrivals "
+                         "at RATE/sec through the dynamic-batching daemon, "
+                         "serve/) and print the serving summary JSON.  "
+                         "rc 0 iff every request completed (typed "
+                         "invalid-input refusals excluded; a failed batch "
+                         "fails its riders and therefore the rc)")
+    ap.add_argument("--serve-requests", type=int, default=200,
+                    help="with --serve: scheduled arrivals (default 200)")
     args = ap.parse_args(argv)
 
     # Bounded-time backend acquisition BEFORE the first jax touch: with the
@@ -135,10 +145,37 @@ def main(argv=None) -> int:
     cfg = KnnConfig(k=args.k, density=args.density, ring_radius=args.ring_radius,
                     dist_method=args.dist, **cfg_kw)
     summary = {"n": n, "k": args.k,
-               "mode": "sharded" if args.sharded else "single",
+               "mode": ("serve" if args.serve is not None else
+                        "sharded" if args.sharded else "single"),
                "platform": platform}
     if backend_note:
         summary["backend_note"] = backend_note
+
+    if args.serve is not None:
+        # serving mode: the daemon + open-loop harness instead of the
+        # one-shot differential solve; same typed rc 4/5 containment
+        from .utils.memory import DeviceMemoryError, InputContractError
+        from .config import ServeConfig
+        from .serve import LoadSpec, ServeDaemon, run_session
+        import dataclasses as _dc
+
+        try:
+            # the serving route is the legacy external-query path (its
+            # launches ride the executable cache; serve/__main__.py has
+            # the same pin)
+            problem = KnnProblem.prepare(
+                points, _dc.replace(cfg, adaptive=False))
+            daemon = ServeDaemon(problem, ServeConfig())
+        except InputContractError as e:
+            return _refuse(e, summary, 5)
+        except DeviceMemoryError as e:
+            return _refuse(e, summary, 4)
+        watchdog.disable()  # open-loop pacing, not a stall
+        result = run_session(daemon, LoadSpec(rate=args.serve,
+                                              requests=args.serve_requests))
+        summary.update(result)
+        print(json.dumps(summary), flush=True)
+        return 0 if result["failed_requests"] == 0 else 1
 
     # --- accelerated solve (reference "knn gpu" phase, test_knearests.cu:136) ---
     # Classified failure containment: a preflight refusal (LaunchBudgetError,

@@ -28,6 +28,13 @@ DEFAULT_CELL_DENSITY = 3.1
 # Default k matches the reference's DEFAULT_NB_PLANES (/root/reference/params.h:4).
 DEFAULT_K = 50
 
+# Default entry cap of the process-wide executable cache
+# (runtime.dispatch.EXEC_CACHE).  A long-lived serving daemon compiles one
+# executable per (route, capacity-bucket, k) signature; the cap bounds the
+# cache's footprint with LRU eviction and the KNTPU_EXEC_CACHE_CAP env knob
+# overrides it (DESIGN.md section 13).
+DEFAULT_EXEC_CACHE_ENTRIES = 64
+
 
 def grid_dim_for(n_points: int, density: float = DEFAULT_CELL_DENSITY) -> int:
     """Cells per axis for a cubic grid with ~`density` points per cell.
@@ -218,6 +225,81 @@ class KnnConfig:
         (tests/test_dispatch.py).  None or <= 0 means single-shot."""
         q = self.query_chunk
         return int(q) if q is not None and int(q) > 0 else None
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Tunables of the persistent serving daemon (serve/, DESIGN.md s13).
+
+    Attributes:
+      max_batch: largest dynamic-batch capacity (queries per flushed batch).
+        Also the size-trigger: the batcher flushes as soon as admitting the
+        next request would exceed it.  A single request larger than
+        max_batch is REFUSED at admission (typed InvalidRequestError) --
+        capacity buckets must be bounded for the zero-recompile law to hold.
+      max_delay_s: deadline trigger -- a pending request older than this
+        forces a flush even when the batch is not full (bounds queueing
+        latency at low arrival rates).
+      min_bucket: smallest capacity bucket.  Flushed batches pad up to the
+        next power-of-two bucket in [min_bucket, max_batch], so the set of
+        batch shapes -- and therefore of executable signatures -- is fixed
+        and finite: after one warmup pass per bucket the steady-state loop
+        performs ZERO recompiles (asserted by tests/test_serve.py).
+      compact_threshold: mutations (inserts + deletes) absorbed by the
+        delta overlay before it compacts into a full re-prepare of the
+        mutated cloud (serve/delta.py).  Compaction changes the stored-point
+        count, so the next batch per bucket recompiles once; between
+        compactions the signature set is stable.
+      warmup: pre-execute one sentinel batch per capacity bucket at daemon
+        start (and after compaction) so steady state begins hot.
+      k: neighbors per served query (None -> the problem's prepared k).
+        Every batch executes at THIS k regardless of per-request k (one
+        signature); per-request k <= k truncates columns on the way out.
+    """
+
+    max_batch: int = 256
+    max_delay_s: float = 0.01
+    min_bucket: int = 8
+    compact_threshold: int = 512
+    warmup: bool = True
+    k: Optional[int] = None
+
+    def __post_init__(self):
+        if self.min_bucket < 1 or self.max_batch < self.min_bucket:
+            raise ValueError(
+                f"serve buckets need 1 <= min_bucket <= max_batch, got "
+                f"min_bucket={self.min_bucket} max_batch={self.max_batch}")
+        if self.max_delay_s < 0:
+            raise ValueError(f"max_delay_s must be >= 0, "
+                             f"got {self.max_delay_s}")
+        if self.compact_threshold < 1:
+            raise ValueError(f"compact_threshold must be >= 1, "
+                             f"got {self.compact_threshold}")
+        if self.k is not None and self.k < 1:
+            # k=0 must refuse loudly, not silently coerce to the prepared k
+            raise ValueError(f"serving k must be >= 1 (or None for the "
+                             f"prepared k), got {self.k}")
+
+    def buckets(self) -> tuple:
+        """The fixed capacity-bucket ladder: powers of two from min_bucket
+        up to (and including) a bucket covering max_batch."""
+        out = []
+        b = 1 << (self.min_bucket - 1).bit_length()
+        while b < self.max_batch:
+            out.append(b)
+            b <<= 1
+        out.append(b)
+        return tuple(out)
+
+    def bucket_for(self, m: int) -> int:
+        """Smallest bucket covering an m-query batch (m <= max_batch)."""
+        for b in self.buckets():
+            if m <= b:
+                return b
+        # internal invariant: the batcher never forms an over-cap batch
+        # (admission refuses oversized requests with the typed taxonomy)
+        raise ValueError(f"batch of {m} queries exceeds max_batch="
+                         f"{self.max_batch}")
 
 
 def resolve_epilogue(epilogue: str, on_kernel_platform: bool) -> str:

@@ -34,6 +34,12 @@ def main(argv=None) -> int:
     ap.add_argument("--cases", type=int,
                     default=int(os.environ.get("KNTPU_FUZZ_CASES", "64")),
                     help="campaign size (default: $KNTPU_FUZZ_CASES or 64)")
+    ap.add_argument("--mutations", type=int, default=None, metavar="N",
+                    help="run the MUTATION-STREAM campaign instead (N "
+                         "seeded insert/delete/query interleavings through "
+                         "the serving delta overlay vs the rebuild-from-"
+                         "scratch oracle; failures minimized and banked "
+                         "like point cases -- see fuzz/mutation.py)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--routes", default=None,
                     help="comma-separated subset of "
@@ -73,6 +79,26 @@ def main(argv=None) -> int:
         os.environ["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count="
             f"{max(1, args.devices)}").strip()
+
+    if args.mutations is not None:
+        from .mutation import run_mutation_campaign
+
+        kwargs = {} if args.bank_dir is None else {"bank_dir": args.bank_dir}
+        manifest = run_mutation_campaign(
+            n_cases=args.mutations, seed=args.seed, budget_s=budget,
+            minimize=not args.no_minimize, **kwargs)
+        if args.manifest:
+            os.makedirs(os.path.dirname(os.path.abspath(args.manifest)),
+                        exist_ok=True)
+            with open(args.manifest, "w") as f:
+                json.dump(manifest, f, indent=2)
+        print(json.dumps(manifest))
+        if not manifest["ok"]:
+            print(f"MUTATION FUZZ FAILED: {len(manifest['failures'])} "
+                  f"failure(s); minimized op streams banked",
+                  file=sys.stderr)
+            return 1
+        return 0
 
     from .campaign import run_campaign
     from .routes import ROUTE_NAMES

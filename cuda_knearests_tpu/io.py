@@ -22,7 +22,8 @@ import numpy as np
 from .config import DOMAIN_SIZE
 from .utils.memory import (CorruptInputError, DegenerateExtentError,
                            DomainBoundsError, InvalidKError,
-                           InvalidShapeError, NonFiniteInputError)
+                           InvalidRequestError, InvalidShapeError,
+                           NonFiniteInputError)
 
 
 def load_xyz(path: str) -> np.ndarray:
@@ -145,6 +146,78 @@ def validate_or_raise(points: np.ndarray, k: Optional[int] = None,
                 f"first (the reference hard-codes the same contract, "
                 f"knearests.cu:21)")
     return np.ascontiguousarray(points)
+
+
+# Legal request-stream operation kinds (the serving daemon's wire surface).
+REQUEST_KINDS = ("query", "insert", "delete")
+
+
+def validate_request(kind: str, payload, *, k=None, k_max: Optional[int] = None,
+                     n_current: Optional[int] = None,
+                     max_batch: Optional[int] = None,
+                     domain: float = DOMAIN_SIZE):
+    """The request-stream front door: the per-request twin of
+    :func:`validate_or_raise`, enforced by the serving daemon at admission
+    (serve/daemon.py) so a malformed request is REFUSED with the typed
+    ``InputContractError`` taxonomy instead of crashing the batch it would
+    have ridden.
+
+    Legal requests (DESIGN.md section 13):
+      * ``('query', (m, 3) coords)`` -- the points contract of
+        validate_or_raise against the PREPARED domain bounds, plus
+        ``k`` (when given) a positive integer <= ``k_max`` (the serving k
+        that sized the hot executables), plus ``m <= max_batch`` (a request
+        wider than the largest capacity bucket can never flush).
+      * ``('insert', (m, 3) coords)`` -- same points contract (delta
+        inserts must land inside the prepared grid's domain; points that
+        need normalization are the CALLER's job, exactly as at prepare).
+      * ``('delete', (m,) integer ids)`` -- ids must index the CURRENT
+        mutated cloud: integer dtype, unique, within [0, n_current).
+
+    Raises InvalidRequestError (unknown kind / oversized / bad ids),
+    InvalidKError, or the points-contract taxonomy.  Returns the validated
+    payload array (f32 (m, 3) for query/insert, i64->i32-safe (m,) int
+    array for delete)."""
+    if kind not in REQUEST_KINDS:
+        raise InvalidRequestError(
+            f"unknown request kind {kind!r}: expected one of "
+            f"{REQUEST_KINDS} (request contract)")
+    if kind in ("query", "insert"):
+        what = "request queries" if kind == "query" else "request inserts"
+        out = validate_or_raise(payload, k=k if kind == "query" else None,
+                                domain=domain, what=what)
+        if kind == "query" and k is not None and k_max is not None \
+                and int(k) > int(k_max):
+            raise InvalidKError(
+                f"request k={int(k)} exceeds the serving k={int(k_max)} "
+                f"that sized the hot executables (request contract)")
+        if max_batch is not None and out.shape[0] > int(max_batch):
+            raise InvalidRequestError(
+                f"{what} carry {out.shape[0]} rows but the daemon's largest "
+                f"capacity bucket is max_batch={int(max_batch)}; split the "
+                f"request (request contract)")
+        return out
+    try:
+        ids = np.asarray(payload)
+    except (TypeError, ValueError) as e:
+        raise InvalidRequestError(
+            f"delete ids are not an array: {e} (request contract)") from e
+    if ids.ndim != 1 or not np.issubdtype(ids.dtype, np.integer):
+        raise InvalidRequestError(
+            f"delete ids must be a 1-d integer array, got shape "
+            f"{ids.shape} dtype {ids.dtype} (request contract)")
+    if ids.size and np.unique(ids).size != ids.size:
+        raise InvalidRequestError(
+            "delete ids contain duplicates (request contract: each id "
+            "deletes one point of the current cloud)")
+    if n_current is not None and ids.size:
+        lo, hi = int(ids.min()), int(ids.max())
+        if lo < 0 or hi >= int(n_current):
+            raise InvalidRequestError(
+                f"delete ids span [{lo}, {hi}] but the current cloud has "
+                f"{int(n_current)} points (request contract: ids index the "
+                f"mutated cloud at admission time)")
+    return ids
 
 
 def validate_points(points: np.ndarray,

@@ -134,6 +134,58 @@ def build_grid(points: jax.Array, dim: int | None = None,
     return _build(staged, dim=int(dim), domain=float(domain))
 
 
+def delta_csr_host(points: np.ndarray, dim: int,
+                   domain: float = DOMAIN_SIZE):
+    """Host-side CSR layout of a DELTA point set on an existing grid's cell
+    partition -- the incremental-update twin of :func:`_build`, run only
+    over the mutated points (serve/delta.py, DESIGN.md section 13).
+
+    The same count / reserve / scatter structure as the reference's three
+    grid-build kernels (knearests.cu:22-60), in its deterministic sort-based
+    form -- ``count`` = unique-cell occupancy counts, ``reserve`` =
+    exclusive prefix sum, ``scatter`` = stable argsort by cell id -- held
+    COMPACT: segments index by dirty-cell *position*, not cell id, so the
+    cost is O(d log d) in the delta alone (never O(dim^3)) and a moving
+    point cloud pays per-mutation cost proportional to its delta, not a
+    full re-sort + device restage.
+
+    Returns (order, dirty, starts, counts): ``order`` sorts delta points
+    cell-major (stable); ``dirty`` the sorted unique cell ids the delta
+    occupies (the dirty-cell overlay); ``starts``/``counts`` the CSR
+    segment of each dirty cell within ``order`` (``order[starts[j] :
+    starts[j] + counts[j]]`` are the delta rows in cell ``dirty[j]`` --
+    what the overlay's pruned delta launch gathers its candidates
+    through, serve/delta.py)."""
+    coords = cell_coords_host(points, dim, domain)
+    cids = coords[:, 0] + dim * (coords[:, 1] + dim * coords[:, 2])
+    order = np.argsort(cids, kind="stable").astype(np.int32)
+    dirty, counts = np.unique(cids, return_counts=True)
+    counts = counts.astype(np.int32)
+    starts = (np.cumsum(counts) - counts).astype(np.int32)
+    return order, dirty.astype(np.int32), starts, counts
+
+
+def cell_min_d2_host(queries: np.ndarray, cells: np.ndarray, dim: int,
+                     domain: float = DOMAIN_SIZE) -> np.ndarray:
+    """(m, c) lower bound on the squared distance from each query to any
+    point inside each cell -- the dirty-cell pruning bound of the delta
+    overlay (a delta launch is skipped when every query's bound to every
+    dirty cell exceeds its current k-th distance).
+
+    Conservative by construction: computed in f64 against the exact cell
+    box [lo, hi], with the per-axis clamp max(lo - q, 0, q - hi).  A bound
+    of 0 (query inside the cell) never prunes."""
+    w = np.float64(domain) / dim  # kntpu-ok: wide-dtype -- conservative pruning bound computed in f64 on host, never staged
+    cx = cells % dim
+    cy = (cells // dim) % dim
+    cz = cells // (dim * dim)
+    lo = np.stack([cx, cy, cz], axis=-1).astype(np.float64) * w  # kntpu-ok: wide-dtype -- conservative pruning bound computed in f64 on host, never staged
+    hi = lo + w
+    q = np.asarray(queries, np.float64)[:, None, :]  # kntpu-ok: wide-dtype -- conservative pruning bound computed in f64 on host, never staged
+    d = np.maximum(np.maximum(lo[None] - q, q - hi[None]), 0.0)
+    return (d * d).sum(-1)
+
+
 def unpermute_neighbors(grid: GridHash, neighbors_sorted: jax.Array,
                         fill: int = -1) -> jax.Array:
     """Translate a (n, k) neighbor table from sorted indexing to original ids.

@@ -179,6 +179,31 @@ def brute_force_by_coords(points: jax.Array, queries: jax.Array, k: int,
     return best_i, best_d
 
 
+def launch_brute(points, queries, k: int, ids_map, tile: int = 8192,
+                 base_key=None):
+    """One brute-force launch through the executable-signature cache -- the
+    host-platform twin of :func:`_launch_packed`.
+
+    On kernel-less platforms the external-query route (and the serving
+    daemon's capacity-bucketed batches, serve/engine -- whose zero-recompile
+    steady state is asserted against exactly these cache counters) executes
+    through this launch: the AOT ``lower().compile()`` product is keyed by
+    the same :func:`~..runtime.dispatch.signature` census as the kernel
+    route, so repeated same-shape batches reuse ONE compiled program.  A
+    backend that cannot AOT-lower falls back to the plain jitted call
+    (EXEC_CACHE disables itself, same contract as _launch_packed)."""
+    args = (points, queries, ids_map)
+    key = (("ops.query.brute_force_by_coords",) + tuple(base_key or ())
+           + _dispatch.signature(args, k, tile))
+    exe = _dispatch.EXEC_CACHE.get_or_build(
+        key, lambda: brute_force_by_coords.lower(
+            points, queries, k=k, tile=tile, ids_map=ids_map).compile())
+    if exe is not None:
+        return exe(points, queries, ids_map=ids_map)
+    return brute_force_by_coords(points, queries, k, tile=tile,
+                                 ids_map=ids_map)
+
+
 def _launch_packed(qs, starts, sc_counts, inv_flat, inv_sc, pack, plan, perm,
                    q2cap: int, k: int, domain: float, interpret: bool,
                    epilogue: str, base_key=None):
@@ -270,8 +295,9 @@ def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
                 grid.permutation, q2cap, k, grid.domain, interpret, epilogue,
                 base_key=exec_key)
         else:
-            r_i, r_d = brute_force_by_coords(grid.points, qs, k,
-                                             ids_map=grid.permutation)
+            r_i, r_d = launch_brute(grid.points, qs, k,
+                                    ids_map=grid.permutation,
+                                    base_key=exec_key)
             r_c = None  # exact by construction
         pending.append((r_i, r_d, r_c))
 

@@ -36,12 +36,15 @@ smoke (all four solve routes on a small fixture, each must complete within
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import warnings
 from collections import OrderedDict
 from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
+
+from ..config import DEFAULT_EXEC_CACHE_ENTRIES
 
 # The one-sync solve contract (DESIGN.md section 12): a solve or query call
 # completes with at most one batched readback of its assembled results, plus
@@ -164,14 +167,25 @@ class ExecutableCache:
     computed at prepare/launch time, the value a ``lower().compile()``
     product.  A build failure (e.g. a backend that cannot AOT-lower the
     launch) disables the cache for the process -- callers fall back to their
-    plain jitted path, losing only the explicit reuse accounting."""
+    plain jitted path, losing only the explicit reuse accounting.
 
-    def __init__(self, maxsize: int = 64):
+    BOUNDED for long-lived processes (the serving daemon holds one of these
+    hot for its whole life): ``maxsize`` caps the entry count with LRU
+    eviction -- a hit refreshes recency, an insert beyond the cap evicts the
+    least-recently-used executable and counts it in ``evictions``.  The
+    process-wide instance resolves its cap from the KNTPU_EXEC_CACHE_CAP
+    env knob (default config.DEFAULT_EXEC_CACHE_ENTRIES); hit/miss/eviction
+    counters ride ``stats_dict`` into bench rows and serving summaries, so
+    an eviction-thrashing cap (more live signatures than entries) is
+    visible, not silent."""
+
+    def __init__(self, maxsize: int = DEFAULT_EXEC_CACHE_ENTRIES):
         self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._lock = threading.Lock()
-        self.maxsize = int(maxsize)
+        self.maxsize = max(1, int(maxsize))
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
         self.enabled = True
         self.disabled_by: Optional[str] = None
 
@@ -205,6 +219,7 @@ class ExecutableCache:
             self._cache[key] = exe
             while len(self._cache) > self.maxsize:
                 self._cache.popitem(last=False)
+                self.evictions += 1
         return exe
 
     def clear(self) -> None:
@@ -212,6 +227,7 @@ class ExecutableCache:
             self._cache.clear()
             self.hits = 0
             self.misses = 0
+            self.evictions = 0
             self.enabled = True
             self.disabled_by = None
 
@@ -219,15 +235,30 @@ class ExecutableCache:
         with self._lock:
             out = {"exec_cache_hits": self.hits,
                    "exec_cache_misses": self.misses,
-                   "exec_cache_size": len(self._cache)}
+                   "exec_cache_evictions": self.evictions,
+                   "exec_cache_size": len(self._cache),
+                   "exec_cache_cap": self.maxsize}
             if self.disabled_by is not None:
                 out["exec_cache_disabled_by"] = self.disabled_by
             return out
 
 
-# Process-wide executable cache (the external-query chunk pipeline's compiled
-# launches live here; see ops/query.py).
-EXEC_CACHE = ExecutableCache()
+def _env_cache_cap() -> int:
+    """KNTPU_EXEC_CACHE_CAP override for the process-wide cache's entry cap
+    (>= 1 enforced; junk falls back to the default so a typo'd export can
+    never unbound a long-lived daemon's cache)."""
+    raw = os.environ.get("KNTPU_EXEC_CACHE_CAP", "")
+    try:
+        return max(1, int(raw)) if raw else DEFAULT_EXEC_CACHE_ENTRIES
+    except ValueError:
+        return DEFAULT_EXEC_CACHE_ENTRIES
+
+
+# Process-wide executable cache (the external-query chunk pipeline's and the
+# serving executor's compiled launches live here; see ops/query.py and
+# serve/).  Entry cap: KNTPU_EXEC_CACHE_CAP, default
+# config.DEFAULT_EXEC_CACHE_ENTRIES.
+EXEC_CACHE = ExecutableCache(maxsize=_env_cache_cap())
 
 
 # -- CPU sync-budget smoke (scripts/check.sh) ---------------------------------

@@ -9,6 +9,10 @@ device job and reports through the one-line framed JSON protocol
   {"job": "fuzz_case", "spec": {...}, ...}  -> fuzz.campaign.run_case_job
                             (one differential fuzz case, isolated so a
                             hostile input's crash costs only that case)
+  {"job": "serve_scenario", "name": "<serve row>"} -> bench.serve_scenario
+                            (one open-loop serving session; isolation makes
+                            the PR 2 supervisor the daemon's whole-process
+                            crash boundary -- bench.py --serve)
   {"job": "selftest"}    -> a trivial well-formed row, no device work (the
                             fast vehicle for the fault-injection tests)
 
@@ -132,6 +136,10 @@ def _run_job(job: dict) -> dict:
         row = bench.bench_config(job["name"])
     elif job.get("job") == "north_star":
         row = bench.bench_north_star()
+    elif job.get("job") == "serve_scenario":
+        # one open-loop serving session (bench.py --serve): isolated so a
+        # daemon process death costs one typed scenario row, not the bench
+        row = bench.serve_scenario(job["name"])
     else:
         raise ValueError(f"unknown worker job {job.get('job')!r}")
     row.setdefault("platform", platform)

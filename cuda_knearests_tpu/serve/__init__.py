@@ -1,0 +1,43 @@
+"""Persistent serving subsystem: the long-lived daemon over the kNN engine.
+
+The reference is a one-shot batch executable; the ROADMAP north star is a
+server under heavy streaming traffic.  This package is that server mode
+(ROADMAP item 3), built on the substrate the earlier PRs laid down:
+
+* :mod:`batching` -- dynamic batching: requests coalesce into
+  capacity-bucketed batches (size- and deadline-triggered flush) whose
+  shapes come from a FIXED power-of-two bucket ladder, so the executable
+  signatures a session dispatches are finite, warmable, and served hot by
+  the PR 5 ``ExecutableCache`` (zero recompiles in steady state, asserted).
+* :mod:`delta` -- incremental point insert/delete: grid-hash delta updates
+  (count/reserve/scatter over the delta alone, a dirty-cell overlay for
+  pruning, threshold-triggered compaction into a full re-prepare), with
+  query results pinned byte-identical to a rebuild-from-scratch on the
+  mutated cloud.
+* :mod:`daemon` -- the serving core: typed admission (io.validate_request,
+  the request-stream front door), per-batch failure containment mapped
+  onto the supervisor's ``FAILURE_KINDS`` taxonomy (a crashed or refused
+  request costs one batch, never the daemon), injected-clock event-loop
+  surface.
+* :mod:`loadgen` -- the open-loop Poisson load harness whose summaries
+  become ``bench.py --serve`` rows: sustained QPS, p50/p99/p999 latency,
+  batch occupancy, recompile count.
+
+``python -m cuda_knearests_tpu.serve`` runs the daemon: ``--loadgen`` for
+a self-driving synthetic session (the CI smoke), default mode reads
+JSON-lines requests on stdin.  Everything runs on CPU, so tier-1 and
+``scripts/check.sh`` exercise the whole loop.  DESIGN.md section 13 has
+the batching law, the delta-overlay invariants, and the failure model.
+"""
+
+from __future__ import annotations
+
+from ..config import ServeConfig
+from .batching import Batch, DynamicBatcher, Request
+from .daemon import Response, ServeDaemon
+from .delta import DeltaOverlay
+from .loadgen import LoadSpec, build_schedule, run_session
+
+__all__ = ["ServeConfig", "ServeDaemon", "Response", "DeltaOverlay",
+           "DynamicBatcher", "Batch", "Request", "LoadSpec",
+           "build_schedule", "run_session"]

@@ -1,0 +1,297 @@
+"""The persistent serving daemon: request admission, batched execution,
+typed failure containment.
+
+One ``ServeDaemon`` owns a prepared problem wrapped in the mutation
+overlay (serve/delta.py), a dynamic batcher (serve/batching.py), and the
+execution counters.  The wire-level error model IS the engine's existing
+typed taxonomy: a malformed request is REFUSED at admission with an
+``InputContractError`` subclass (kind 'invalid-input', the CLI's rc-5
+class), and a batch whose execution dies is contained -- every rider gets
+a typed failure response whose ``failure_kind`` comes from
+``runtime.supervisor.FAILURE_KINDS`` exactly as a supervised worker death
+would, and the daemon keeps serving (the acceptance law: a crashed or
+refused request costs one batch, never the daemon).  Whole-process deaths
+are the PR 2 supervisor's layer: ``bench.py --serve`` runs each serving
+session in a supervised worker, so even a SIGKILL costs one typed row.
+
+Execution: every batch pads to its capacity bucket with sentinel
+queries (domain center -- legal input, rows discarded on reply) and runs
+at the SERVING k regardless of per-request k, so steady state always
+dispatches an already-cached executable signature (zero recompiles after
+warmup, asserted in tests/test_serve.py via the ExecutableCache
+counters).  Batches execute through the runtime/dispatch machinery; the
+per-session host-sync counters ride the summary.
+
+Fault injection (CPU-testable): ``KNTPU_SERVE_FAULT=batch:<n>[:kind]``
+raises a synthetic failure on the n-th executed batch (kind 'oom' raises
+a LaunchBudgetError, anything else a RuntimeError classified 'crash') --
+how tests prove containment without real hardware faults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..api import KnnProblem
+from ..config import DOMAIN_SIZE, ServeConfig
+from ..io import validate_request
+from ..runtime import dispatch as _dispatch
+from ..runtime.supervisor import FAILURE_KINDS
+from ..utils.memory import (InputContractError, InvalidConfigError,
+                            LaunchBudgetError, classify_fault_text)
+from .batching import Batch, DynamicBatcher, Request
+from .delta import DeltaOverlay
+
+
+@dataclasses.dataclass
+class Response:
+    """One request's outcome (the wire reply, minus serialization)."""
+
+    req_id: int
+    ok: bool
+    ids: Optional[np.ndarray] = None      # (m, k_req) canonical CURRENT ids
+    d2: Optional[np.ndarray] = None
+    n_points: Optional[int] = None        # mutations: cloud size after
+    error: Optional[str] = None
+    failure_kind: Optional[str] = None    # FAILURE_KINDS member when not ok
+    arrived_at: float = 0.0
+    completed_at: float = 0.0
+
+    @property
+    def latency_s(self) -> float:
+        return self.completed_at - self.arrived_at
+
+    def to_wire(self) -> dict:
+        out: dict = {"id": self.req_id, "ok": self.ok}
+        if self.ok and self.ids is not None:
+            out["ids"] = self.ids.tolist()
+            # RFC 8259 has no Infinity token (json.dumps would emit one a
+            # strict parser rejects): pad slots -- id -1 -- carry d2 null
+            # on the wire
+            out["d2"] = [[float(v) if np.isfinite(v) else None
+                          for v in row] for row in self.d2]
+        if self.n_points is not None:
+            out["n_points"] = self.n_points
+        if not self.ok:
+            out["error"] = self.error
+            out["failure_kind"] = self.failure_kind
+        return out
+
+
+def _parse_serve_fault() -> Optional[tuple]:
+    spec = os.environ.get("KNTPU_SERVE_FAULT", "")
+    if not spec.startswith("batch:"):
+        return None
+    parts = spec.split(":")
+    return int(parts[1]), (parts[2] if len(parts) > 2 else "crash")
+
+
+class ServeDaemon:
+    """Single-threaded serving core: admit / poll / drain.
+
+    The event loop lives in the CALLER (serve/loadgen.py's session runner,
+    or the stdio front end in serve/__main__.py): the daemon exposes pure
+    state transitions driven by an injected clock, which is what makes the
+    batching law unit-testable with synthetic time.
+    """
+
+    def __init__(self, problem: KnnProblem,
+                 config: Optional[ServeConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config or ServeConfig()
+        self.clock = clock
+        k_max = int(problem.config.k)
+        self.k_serve = (int(self.config.k) if self.config.k is not None
+                        else k_max)
+        if self.k_serve > k_max:
+            raise InvalidConfigError(
+                f"serving k={self.k_serve} exceeds the prepared "
+                f"k={k_max} that sized the candidate dilation")
+        self.overlay = DeltaOverlay(
+            problem, compact_threshold=self.config.compact_threshold)
+        self.batcher = DynamicBatcher(self.config)
+        self.batches_executed = 0
+        self.failed_batches = 0
+        self.failed_mutations = 0
+        self.refused = 0
+        self.failure_kinds: Dict[str, int] = {}
+        self.occupancies: List[float] = []
+        self._fault = _parse_serve_fault()
+        self._compactions_seen = 0
+        if self.config.warmup:
+            self.warmup()
+
+    # -- warmup ---------------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Pre-execute one sentinel batch per capacity bucket so every
+        steady-state signature is compiled (and cached) before the first
+        real request.  Re-run after compaction (the point count changed,
+        so each bucket's signature is new)."""
+        dom = float(self.overlay.base.grid.domain or DOMAIN_SIZE)
+        for cap in self.config.buckets():
+            sentinel = np.full((cap, 3), dom / 2.0, np.float32)
+            self.overlay.query(sentinel, self.k_serve)
+
+    # -- admission ------------------------------------------------------------
+
+    def submit(self, req_id: int, kind: str, payload, k: Optional[int] = None,
+               now: Optional[float] = None) -> List[Response]:
+        """Admit one request.  Queries queue into the batcher (responses
+        surface later via poll/drain); mutations are barriers -- the
+        pending batch flushes first, then the mutation applies and answers
+        immediately.  A contract violation refuses THIS request (typed,
+        kind 'invalid-input') and nothing else."""
+        now = self.clock() if now is None else now
+        try:
+            payload = validate_request(
+                kind, payload, k=k, k_max=self.k_serve,
+                n_current=self.overlay.n_points,
+                max_batch=self.config.max_batch,
+                domain=float(self.overlay.base.grid.domain or DOMAIN_SIZE))
+        except InputContractError as e:
+            self.refused += 1
+            return [Response(req_id=req_id, ok=False, error=str(e),
+                             failure_kind=e.kind, arrived_at=now,
+                             completed_at=self.clock())]
+        if kind == "query":
+            req = Request(req_id=req_id, queries=payload,
+                          k=int(k) if k else self.k_serve, arrived_at=now)
+            out = []
+            for batch in self.batcher.admit(req, now):
+                out.extend(self._execute(batch))
+            return out
+        # mutation barrier: queries already pending answer against the
+        # pre-mutation cloud (their batch formed first)
+        out = []
+        barrier = self.batcher.flush("barrier", now)
+        if barrier is not None:
+            out.extend(self._execute(barrier))
+        # same containment law as batches: a mutation whose apply dies
+        # (compaction's re-prepare, the post-compaction re-warm) costs THIS
+        # request a typed failure, never the daemon.  Overlay state stays
+        # consistent either way: compact() swaps its base atomically after
+        # the re-prepare succeeds, so a failed apply leaves the previous
+        # overlay intact and serving.
+        try:
+            if kind == "insert":
+                self.overlay.insert(payload)
+            else:
+                self.overlay.delete(payload)
+            if self.overlay.stats.compactions and self.config.warmup \
+                    and self.overlay.mutations_pending == 0 \
+                    and self._compactions_seen \
+                    != self.overlay.stats.compactions:
+                self._compactions_seen = self.overlay.stats.compactions
+                self.warmup()
+        except Exception as e:  # noqa: BLE001 -- containment IS the contract: a mutation-apply death becomes one typed failure response, the daemon survives
+            fkind = self._classify(e)
+            self.failed_mutations += 1
+            self.failure_kinds[fkind] = self.failure_kinds.get(fkind, 0) + 1
+            out.append(Response(
+                req_id=req_id, ok=False,
+                error=f"mutation failed: {type(e).__name__}: {e}",
+                failure_kind=fkind, arrived_at=now,
+                completed_at=self.clock()))
+            return out
+        out.append(Response(req_id=req_id, ok=True,
+                            n_points=self.overlay.n_points,
+                            arrived_at=now, completed_at=self.clock()))
+        return out
+
+    def poll(self, now: Optional[float] = None) -> List[Response]:
+        """Deadline-trigger check; the event loop calls this between
+        arrivals."""
+        now = self.clock() if now is None else now
+        batch = self.batcher.poll(now)
+        return self._execute(batch) if batch is not None else []
+
+    def drain(self, now: Optional[float] = None) -> List[Response]:
+        """Flush whatever is pending (end of stream / EOF)."""
+        now = self.clock() if now is None else now
+        batch = self.batcher.flush("drain", now)
+        return self._execute(batch) if batch is not None else []
+
+    def next_deadline(self) -> Optional[float]:
+        return self.batcher.next_deadline()
+
+    # -- execution ------------------------------------------------------------
+
+    @staticmethod
+    def _classify(e: BaseException) -> str:
+        """Taxonomy kind of a contained failure: the exception's own kind
+        stamp when it carries one, else text classification, else
+        'crash' -- the same ladder the supervisor's workers use."""
+        kind = getattr(e, "kind", None)
+        if kind in FAILURE_KINDS:
+            return kind
+        return classify_fault_text(f"{type(e).__name__}: {e}") or "crash"
+
+    def _run_batch(self, batch: Batch, idx: int):
+        """One padded bucket-capacity launch at the serving k."""
+        if self._fault is not None and idx == self._fault[0]:
+            if self._fault[1] == "oom":
+                raise LaunchBudgetError(
+                    "injected synthetic over-budget serving batch",
+                    requested=1 << 40, budget=1 << 30, site="serve-fault")
+            raise RuntimeError("injected serving batch fault")
+        cap = batch.capacity
+        dom = float(self.overlay.base.grid.domain or DOMAIN_SIZE)
+        padded = np.full((cap, 3), dom / 2.0, np.float32)
+        padded[: batch.total] = batch.queries
+        ids, d2 = self.overlay.query(padded, self.k_serve)
+        return ids[: batch.total], d2[: batch.total]
+
+    def _execute(self, batch: Batch) -> List[Response]:
+        """Run one batch with containment: a raise costs every rider of
+        THIS batch a typed failure response (kind from the supervisor
+        taxonomy) and nothing more -- the daemon's loop state stays
+        consistent and the next batch runs fresh."""
+        idx = self.batches_executed
+        self.batches_executed += 1
+        try:
+            ids, d2 = self._run_batch(batch, idx)
+        except Exception as e:  # noqa: BLE001 -- containment IS the contract: any batch death becomes typed per-request failures, the daemon survives
+            kind = self._classify(e)
+            self.failed_batches += 1
+            self.failure_kinds[kind] = self.failure_kinds.get(kind, 0) + 1
+            done = self.clock()
+            return [Response(req_id=r.req_id, ok=False,
+                             error=f"batch {idx} failed: "
+                                   f"{type(e).__name__}: {e}",
+                             failure_kind=kind, arrived_at=r.arrived_at,
+                             completed_at=done)
+                    for r in batch.requests]
+        self.occupancies.append(batch.occupancy)
+        done = self.clock()
+        out = []
+        for req, a, b in batch.slices():
+            out.append(Response(
+                req_id=req.req_id, ok=True,
+                ids=np.ascontiguousarray(ids[a:b, : req.k]),
+                d2=np.ascontiguousarray(d2[a:b, : req.k]),
+                arrived_at=req.arrived_at, completed_at=done))
+        return out
+
+    # -- introspection --------------------------------------------------------
+
+    def stats_dict(self) -> dict:
+        occ = self.occupancies
+        return {
+            "batches": self.batches_executed,
+            "failed_batches": self.failed_batches,
+            "failed_mutations": self.failed_mutations,
+            "refused": self.refused,
+            "failure_kinds": dict(self.failure_kinds),
+            "flushes": dict(self.batcher.flushes),
+            "occupancy_mean": (float(np.mean(occ)) if occ else None),
+            "k_serve": self.k_serve,
+            "n_points": self.overlay.n_points,
+            **{f"overlay_{k}": v
+               for k, v in self.overlay.stats.as_dict().items()},
+        }

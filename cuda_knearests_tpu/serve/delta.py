@@ -1,0 +1,334 @@
+"""Incremental point insert/delete: the grid-hash delta overlay.
+
+The base engine pays a full ``prepare`` (O(n log n) sort + plan + device
+restage) for ANY change to the point cloud.  A serving daemon fronting a
+moving cloud cannot: mutations arrive continuously and each one is tiny.
+This module makes mutations O(delta):
+
+* **Inserts** accumulate in a host-side delta set organized by the SAME
+  cell partition as the base grid via ``gridhash.delta_csr_host`` -- the
+  deterministic count/reserve/scatter idiom run over the delta alone --
+  with the touched cells tracked as the **dirty-cell overlay**.
+* **Deletes** tombstone base points (a host boolean mask) -- no cell
+  tracking needed: a tombstone only matters when it intrudes into a base
+  result row, which is detected by id.
+* **Queries** stay exact AND byte-identical to a rebuild-from-scratch on
+  the mutated cloud (tests/test_serve.py pins both the overlay and the
+  post-compaction state): the base problem answers as prepared; rows whose
+  base top-k touches a tombstone re-resolve against the alive base set;
+  delta candidates merge in through one extra launch.  Every distance on
+  the result path comes from the ONE brute launch HLO
+  (ops/query.brute_force_by_coords -- measured bit-stable across point
+  count, tile, and query count), because host numpy accumulation does NOT
+  bit-match XLA's fused multiply-adds.
+* **Compaction**: once absorbed mutations cross ``compact_threshold`` the
+  overlay folds into a full re-prepare of the mutated cloud
+  (api.KnnProblem.with_points) and the delta empties.
+
+Canonical indexing: the mutated cloud is ``[surviving base points in
+original order] + [inserts in arrival order]`` -- exactly
+``np.delete`` + ``np.concatenate`` semantics, so the rebuild oracle is one
+line.  Result ids are canonical CURRENT ids; delete requests address the
+same indexing (validated by io.validate_request at admission).
+
+Dirty-cell pruning: before launching the delta pass the overlay bounds
+each query's distance to every dirty cell (gridhash.cell_min_d2_host,
+exact f64 cell-box geometry).  A cell no query's bound can reach is
+dropped; its delta rows never enter the launch (the CSR gathers only
+surviving cells' rows), and when EVERY cell drops the launch is skipped
+outright -- a mutation in one corner of the domain costs queries
+elsewhere nothing.  The bound is conservative, so pruning never changes
+the answer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..api import KnnProblem
+from ..ops.gridhash import cell_min_d2_host, delta_csr_host
+from ..ops.query import launch_brute
+from ..runtime import dispatch as _dispatch
+
+# Far-away sentinel for delta-capacity padding rows: any real candidate in
+# the [0, 1000]^3 domain (d2 <= 3e6) beats a pad (d2 ~ 1e60), and pads map
+# to id -1 so they drop out of the merge as invalid.
+_FAR = np.float32(1.0e30)
+
+
+def _round_pow2(x: int, minimum: int = 8) -> int:
+    return max(minimum, 1 << max(0, int(x) - 1).bit_length())
+
+
+@dataclasses.dataclass
+class OverlayStats:
+    """Counters of one overlay's life (serving summaries stamp these)."""
+
+    inserts: int = 0
+    deletes: int = 0
+    compactions: int = 0
+    delta_launches: int = 0
+    delta_skips: int = 0        # dirty-cell bound pruned the whole launch
+    delta_candidates: int = 0   # CSR-gathered rows the launches scored
+    resolved_rows: int = 0      # rows re-resolved for tombstone intrusions
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class DeltaOverlay:
+    """A mutable point cloud served as base problem + delta, exact always.
+
+    Thread-unsafe by design (the daemon's event loop is single-threaded);
+    every public method runs on the host except the query launches.
+    """
+
+    def __init__(self, problem: KnnProblem, compact_threshold: int = 512):
+        self.base = problem
+        self.compact_threshold = max(1, int(compact_threshold))
+        self.stats = OverlayStats()
+        self._reset_delta()
+
+    # -- state ---------------------------------------------------------------
+
+    def _reset_delta(self) -> None:
+        n = self.base.grid.n_points
+        base_pts = np.asarray(self.base.get_points())  # sorted order
+        perm = np.asarray(self.base.get_permutation())
+        # original order view of the base cloud (canonical ids 0..n-1 before
+        # any mutation): base_orig[perm[r]] = sorted row r.  The sorted-order
+        # copy is NOT retained -- one resident host copy per overlay, not two
+        self._base_orig = np.empty_like(base_pts)
+        if n:
+            self._base_orig[perm] = base_pts
+        self.alive = np.ones((n,), bool)
+        self.n_deleted = 0
+        self.delta = np.empty((0, 3), np.float32)
+        self.dirty_cells = np.empty((0,), np.int32)
+        self._delta_csr: Optional[Tuple] = None  # (order, starts, counts)
+        self._alive_cache: Optional[Tuple] = None  # (pts_dev, ids_dev)
+        self._old2new: Optional[np.ndarray] = None
+
+    @property
+    def n_points(self) -> int:
+        """Size of the CURRENT mutated cloud."""
+        return int(self.alive.sum()) + self.delta.shape[0]
+
+    @property
+    def mutations_pending(self) -> int:
+        return self.n_deleted + self.delta.shape[0]
+
+    def mutated_points(self) -> np.ndarray:
+        """The mutated cloud in canonical order (the rebuild oracle's
+        input): surviving base originals, then inserts in arrival order."""
+        return np.ascontiguousarray(
+            np.concatenate([self._base_orig[self.alive], self.delta]),
+            dtype=np.float32)
+
+    def _invalidate(self, alive_changed: bool) -> None:
+        """Recompute the delta CSR + dirty-cell overlay after a mutation:
+        O(d log d) in the CURRENT delta (bounded by compact_threshold),
+        never in the base cloud.  Deletes need no cell tracking at all --
+        tombstone intrusions are detected by id against the base result
+        rows -- so the dirty set is exactly the cells the delta occupies.
+        The alive-set caches (the staged resolution arrays and the
+        old->new id map) depend only on the tombstone mask, so inserts
+        leave them intact -- an insert must never restage the O(n) base."""
+        if alive_changed:
+            self._alive_cache = None
+            self._old2new = None
+        if self.delta.shape[0]:
+            order, dirty, starts, counts = delta_csr_host(
+                self.delta, self.base.grid.dim, self.base.grid.domain)
+            self._delta_csr = (order, starts, counts)
+            self.dirty_cells = dirty
+        else:
+            self._delta_csr = None
+            self.dirty_cells = np.empty((0,), np.int32)
+
+    def _map_old2new(self) -> np.ndarray:
+        """base original id -> canonical CURRENT id (-1 for deleted)."""
+        if self._old2new is None:
+            m = np.cumsum(self.alive) - 1
+            self._old2new = np.where(self.alive, m, -1).astype(np.int32)
+        return self._old2new
+
+    # -- mutations -----------------------------------------------------------
+
+    def insert(self, points: np.ndarray) -> None:
+        """Append validated points (the daemon validates at admission; this
+        layer trusts its caller, same as the ops layer)."""
+        points = np.asarray(points, np.float32).reshape(-1, 3)
+        if points.shape[0] == 0:
+            return
+        self.delta = np.concatenate([self.delta, points])
+        self.stats.inserts += points.shape[0]
+        self._invalidate(alive_changed=False)
+        self._maybe_compact()
+
+    def delete(self, ids: np.ndarray) -> None:
+        """Remove points by canonical CURRENT id (np.delete semantics)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)  # kntpu-ok: wide-dtype -- host id arithmetic headroom, never staged
+        if ids.size == 0:
+            return
+        n_alive = int(self.alive.sum())
+        base_ids = ids[ids < n_alive]
+        delta_ids = ids[ids >= n_alive] - n_alive
+        if base_ids.size:
+            orig = np.nonzero(self.alive)[0][base_ids]
+            self.alive[orig] = False
+            self.n_deleted += base_ids.size
+        if delta_ids.size:
+            keep = np.ones((self.delta.shape[0],), bool)
+            keep[delta_ids] = False
+            self.delta = self.delta[keep]
+        self.stats.deletes += ids.size
+        self._invalidate(alive_changed=True)
+        self._maybe_compact()
+
+    def _maybe_compact(self) -> None:
+        if self.mutations_pending >= self.compact_threshold:
+            self.compact()
+
+    def compact(self) -> None:
+        """Fold the overlay into a full re-prepare of the mutated cloud.
+        The one O(n) step, amortized over compact_threshold mutations; the
+        post-compaction answers stay byte-identical (the new base IS a
+        rebuild-from-scratch)."""
+        self.base = self.base.with_points(self.mutated_points(),
+                                          validate=False)
+        self.stats.compactions += 1
+        self._reset_delta()
+
+    # -- queries -------------------------------------------------------------
+
+    def _alive_launch_arrays(self):
+        """Device (points, canonical-ids) of the alive base set, cached
+        until the next mutation -- the tombstone-resolution launch's
+        inputs.  Padded to a power-of-two row count (pads at _FAR, id -1)
+        so a trickle of deletes does not mint a fresh executable signature
+        per mutation: the padded shape is stable until alive count crosses
+        a power of two."""
+        if self._alive_cache is None:
+            n_alive = int(self.alive.sum())
+            cap = _round_pow2(n_alive, minimum=128)
+            pts = np.full((cap, 3), _FAR, np.float32)
+            pts[:n_alive] = self._base_orig[self.alive]
+            ids = np.full((cap,), -1, np.int32)
+            ids[:n_alive] = np.arange(n_alive, dtype=np.int32)
+            self._alive_cache = (_dispatch.stage(pts), _dispatch.stage(ids))
+        return self._alive_cache
+
+    def _delta_launch_arrays(self, sel: np.ndarray, cap: int):
+        """Device (points, canonical-ids) of the SELECTED delta rows padded
+        to ``cap`` (pad points sit at _FAR with id -1, so they lose every
+        merge) -- power-of-two capacity keeps the launch signature
+        bucketed.  ``sel`` comes out of the delta CSR: only rows in cells
+        some query's bound could not prune."""
+        pts = np.full((cap, 3), _FAR, np.float32)
+        pts[: sel.size] = self.delta[sel]
+        n_alive = int(self.alive.sum())
+        ids = np.full((cap,), -1, np.int32)
+        ids[: sel.size] = n_alive + sel.astype(np.int32)
+        return _dispatch.stage(pts), _dispatch.stage(ids)
+
+    def query(self, queries: np.ndarray, k: int):
+        """Exact kNN of ``queries`` against the CURRENT mutated cloud.
+
+        Returns ((m, k) canonical ids, -1 padded; (m, k) d2 ascending, inf
+        padded) -- byte-identical to
+        ``base.with_points(mutated_points()).query(queries, k)`` under the
+        serving config (the legacy/brute route; tests/test_serve.py pins
+        it).  Host round trips: the base query's own (<= 2), plus one for
+        tombstone resolution only when a row touched a deleted point, plus
+        one for the delta merge only when the dirty-cell bound could not
+        prune it."""
+        queries = np.ascontiguousarray(queries, np.float32)
+        m = queries.shape[0]
+        if m == 0:
+            return (np.empty((0, k), np.int32),
+                    np.empty((0, k), np.float32))
+        ids, d2 = self.base.query(queries, k)
+        ids = np.array(ids)  # writable (fetch may hand back views)
+        d2 = np.array(d2)
+        # base ORIGINAL ids -> canonical ids; tombstone intrusions resolve
+        # against the alive set (the certify-then-fallback idiom: the rare
+        # row pays one extra launch, the batch never pays per-row syncs)
+        if self.n_deleted:
+            deleted = np.nonzero(~self.alive)[0]
+            bad = np.isin(ids, deleted).any(axis=1)
+            o2n = self._map_old2new()
+            ids = np.where(ids >= 0, o2n[np.clip(ids, 0, None)], -1)
+            if bad.any():
+                a_pts, a_ids = self._alive_launch_arrays()
+                # bad-row count buckets to a power of two as well (sentinel
+                # query pads, discarded), for the same signature-stability
+                # reason as the batch capacities
+                nb = int(bad.sum())
+                bcap = _round_pow2(nb)
+                bq = np.full((bcap, 3), np.float32(0.0), np.float32)
+                bq[:nb] = queries[bad]
+                r_i, r_d = launch_brute(
+                    a_pts, _dispatch.stage(bq), k, ids_map=a_ids,
+                    base_key=(self.base._exec_key, "overlay-resolve"))
+                r_i, r_d = _dispatch.fetch(r_i, r_d)
+                r_i = np.asarray(r_i)[:nb]
+                r_d = np.asarray(r_d)[:nb]
+                # alive-set pads carry id -1 at a huge-but-finite distance;
+                # restore the -1/inf pad contract (only reachable when the
+                # alive set has fewer than k points)
+                r_d = np.where(r_i >= 0, r_d, np.inf)
+                ids[bad] = r_i
+                d2[bad] = r_d
+                self.stats.resolved_rows += nb
+        if self.delta.shape[0] == 0:
+            return ids, d2
+        # dirty-cell pruning: a dirty cell survives only when SOME query's
+        # exact cell-box bound beats that query's current k-th distance
+        # (rows with fewer than k neighbors have inf there, which no bound
+        # exceeds -- they keep every cell).  Conservative, so dropping a
+        # pruned cell's points can never change an answer.
+        kth = np.where(np.isfinite(d2[:, k - 1]), d2[:, k - 1], np.inf)
+        bound = cell_min_d2_host(queries, self.dirty_cells,
+                                 self.base.grid.dim, self.base.grid.domain)
+        need = (bound <= kth[:, None]).any(axis=0)
+        if not need.any():
+            self.stats.delta_skips += 1
+            return ids, d2
+        # gather the surviving cells' delta rows through the CSR (the
+        # count/reserve/scatter layout _invalidate built)
+        order, starts, counts = self._delta_csr
+        sel = np.concatenate([order[s: s + c] for s, c
+                              in zip(starts[need], counts[need])])
+        cap = _round_pow2(int(sel.size))
+        d_pts, d_ids = self._delta_launch_arrays(sel, cap)
+        kd = min(k, cap)
+        g_i, g_d = launch_brute(
+            d_pts, _dispatch.stage(queries), kd, ids_map=d_ids,
+            base_key=(self.base._exec_key, "overlay-delta"))
+        g_i, g_d = _dispatch.fetch(g_i, g_d)
+        self.stats.delta_launches += 1
+        self.stats.delta_candidates += int(sel.size)
+        return _merge_rows(ids, d2, np.asarray(g_i), np.asarray(g_d), k)
+
+
+def _merge_rows(a_i: np.ndarray, a_d: np.ndarray, b_i: np.ndarray,
+                b_d: np.ndarray, k: int):
+    """Merge two ascending per-row candidate lists into the final top-k.
+
+    Pure comparisons -- no arithmetic -- so merged distances carry the
+    launch's exact bits.  Invalid slots (id < 0, which covers the delta
+    pad rows) sort last via inf; ties break by lower canonical id, which
+    is only reachable on exactly-tied f32 distances (the tie-aware fuzz
+    comparison owns that regime)."""
+    ids = np.concatenate([a_i, b_i], axis=1)
+    d2 = np.concatenate([a_d, b_d], axis=1)
+    d2 = np.where(ids >= 0, d2, np.inf)
+    order = np.lexsort((ids, d2), axis=1)[:, :k]
+    rows = np.arange(ids.shape[0])[:, None]
+    out_i, out_d = ids[rows, order], d2[rows, order]
+    out_i = np.where(np.isfinite(out_d), out_i, -1)
+    return np.ascontiguousarray(out_i), np.ascontiguousarray(out_d)

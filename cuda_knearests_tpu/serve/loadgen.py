@@ -1,0 +1,166 @@
+"""Open-loop synthetic load generator + session runner.
+
+OPEN loop means arrivals are scheduled in advance by a Poisson process and
+never wait for completions -- the generator models independent users, so
+when the daemon falls behind, queueing delay shows up as latency instead of
+silently throttling the offered load (the closed-loop failure mode that
+flatters slow servers).  The whole schedule is drawn up front from one
+seeded RNG, so a session is replayable from (spec, seed).
+
+A request is a query burst (size drawn from ``batch_mix``) or, with
+probability ``mutation_ratio``, a mutation (insert of fresh in-domain
+points, or delete of currently-live ids, 50/50).  The session runner
+drives the daemon's admit/poll/drain surface against real wall time and
+reports the serving metrics that become ``bench.py --serve`` rows:
+sustained QPS, p50/p99/p999 latency, batch occupancy, flush-trigger
+counts, recompile count (ExecutableCache misses inside the measured
+window), and the dispatch-layer host-sync counters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import DOMAIN_SIZE, ServeConfig
+from ..runtime import dispatch as _dispatch
+from .daemon import Response, ServeDaemon
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSpec:
+    """Regenerable identity of one open-loop load session."""
+
+    rate: float = 200.0                 # mean arrivals per second (Poisson)
+    requests: int = 200                 # total scheduled arrivals
+    batch_mix: Tuple[Tuple[int, float], ...] = (
+        (1, 0.45), (4, 0.25), (16, 0.2), (64, 0.1))  # (queries, weight)
+    mutation_ratio: float = 0.0         # fraction of arrivals that mutate
+    mutation_size: int = 8              # points per insert / ids per delete
+    k: Optional[int] = None             # per-request k (None = serving k)
+    seed: int = 0
+
+    def arrivals(self) -> np.ndarray:
+        """Relative arrival times: cumulative sum of Exp(1/rate) gaps --
+        the Poisson process, drawn once (open loop)."""
+        rng = np.random.default_rng(self.seed)
+        gaps = rng.exponential(1.0 / max(self.rate, 1e-9), self.requests)
+        return np.cumsum(gaps)
+
+
+def build_schedule(spec: LoadSpec, n_current: int,
+                   domain: float = DOMAIN_SIZE) -> List[dict]:
+    """The full request schedule: [{t, kind, payload, k}] in arrival order.
+
+    Delete ids are drawn against a TRACKED running cloud size, so every
+    scheduled delete is legal at its arrival time (the fuzz layer owns
+    hostile streams; the load harness offers legal load)."""
+    rng = np.random.default_rng(spec.seed + 1)
+    sizes = np.asarray([s for s, _ in spec.batch_mix])
+    weights = np.asarray([w for _, w in spec.batch_mix], np.float64)  # kntpu-ok: wide-dtype -- host-side sampling weights, never staged
+    weights = weights / weights.sum()
+    out = []
+    n = int(n_current)
+    for t in spec.arrivals():
+        if spec.mutation_ratio > 0 and rng.random() < spec.mutation_ratio:
+            if rng.random() < 0.5 or n <= spec.mutation_size:
+                pts = (rng.random((spec.mutation_size, 3))
+                       * (domain * 0.98) + domain * 0.01).astype(np.float32)
+                out.append({"t": float(t), "kind": "insert", "payload": pts})
+                n += spec.mutation_size
+            else:
+                ids = rng.choice(n, size=spec.mutation_size, replace=False)
+                out.append({"t": float(t), "kind": "delete",
+                            "payload": np.sort(ids).astype(np.int64)})  # kntpu-ok: wide-dtype -- host id payload, validated then used on host
+                n -= spec.mutation_size
+        else:
+            m = int(rng.choice(sizes, p=weights))
+            qs = (rng.random((m, 3)) * (domain * 0.98)
+                  + domain * 0.01).astype(np.float32)
+            out.append({"t": float(t), "kind": "query", "payload": qs,
+                        "k": spec.k})
+    return out
+
+
+def _percentiles(latencies_s: List[float]) -> dict:
+    if not latencies_s:
+        return {"p50_ms": None, "p99_ms": None, "p999_ms": None}
+    arr = np.asarray(latencies_s) * 1000.0
+    p50, p99, p999 = np.percentile(arr, [50, 99, 99.9])
+    return {"p50_ms": round(float(p50), 3), "p99_ms": round(float(p99), 3),
+            "p999_ms": round(float(p999), 3)}
+
+
+def run_session(daemon: ServeDaemon, spec: LoadSpec,
+                clock=time.monotonic, sleep=time.sleep) -> dict:
+    """Drive one open-loop session against a (warmed) daemon; returns the
+    serving summary.
+
+    The recompile count is the ExecutableCache miss delta across the
+    measured window -- the daemon warmed every capacity bucket at
+    construction, so in a mutation-free session this MUST be zero (the
+    steady-state law tests and the check.sh smoke assert it)."""
+    schedule = build_schedule(spec, daemon.overlay.n_points,
+                              domain=float(daemon.overlay.base.grid.domain
+                                           or DOMAIN_SIZE))
+    cache0 = dict(_dispatch.EXEC_CACHE.stats_dict())
+    _dispatch.reset_stats()
+    responses: List[Response] = []
+    t0 = clock()
+    i = 0
+    while i < len(schedule) or daemon.batcher.pending_queries:
+        now = clock()
+        if i < len(schedule) and t0 + schedule[i]["t"] <= now:
+            item = schedule[i]
+            i += 1
+            responses.extend(daemon.submit(
+                req_id=i, kind=item["kind"], payload=item["payload"],
+                k=item.get("k"), now=t0 + item["t"]))
+            continue
+        responses.extend(daemon.poll(now))
+        next_events = []
+        if i < len(schedule):
+            next_events.append(t0 + schedule[i]["t"])
+        deadline = daemon.next_deadline()
+        if deadline is not None:
+            next_events.append(deadline)
+        if not next_events:
+            break
+        wait = min(next_events) - clock()
+        if wait > 0:
+            sleep(min(wait, 0.005))
+    responses.extend(daemon.drain(clock()))
+    elapsed = max(clock() - t0, 1e-9)
+
+    cache1 = _dispatch.EXEC_CACHE.stats_dict()
+    ok = [r for r in responses if r.ok and r.ids is not None]
+    failed = [r for r in responses if not r.ok and r.failure_kind
+              != "invalid-input"]
+    lat = [r.latency_s for r in responses if r.ok]
+    completed_queries = int(sum(r.ids.shape[0] for r in ok))
+    summary = {
+        "requests": len(schedule),
+        "responses": len(responses),
+        "completed_query_requests": len(ok),
+        "completed_queries": completed_queries,
+        "failed_requests": len(failed),
+        "elapsed_s": round(elapsed, 4),
+        "sustained_qps": round(completed_queries / elapsed, 1),
+        "offered_rate": spec.rate,
+        "mutation_ratio": spec.mutation_ratio,
+        "seed": spec.seed,
+        **_percentiles(lat),
+        "recompiles": int(cache1["exec_cache_misses"]
+                          - cache0["exec_cache_misses"]),
+        "exec_cache_enabled": _dispatch.EXEC_CACHE.enabled,
+        **{k: v for k, v in cache1.items() if k != "exec_cache_disabled_by"},
+        **_dispatch.stats_dict(),   # host_syncs / d2h_bytes / h2d_bytes
+        **daemon.stats_dict(),
+    }
+    if not _dispatch.EXEC_CACHE.enabled:
+        summary["exec_cache_disabled_by"] = cache1.get(
+            "exec_cache_disabled_by")
+    return summary

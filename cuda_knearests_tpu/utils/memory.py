@@ -135,6 +135,15 @@ class InvalidConfigError(InputContractError):
     thicker than the z-slab it must fit inside)."""
 
 
+class InvalidRequestError(InputContractError):
+    """A serving-stream request that violates the request contract
+    (io.validate_request): unknown operation kind, a query/insert payload
+    failing the points contract, delete ids out of range for the current
+    cloud, or a request larger than the daemon's batch capacity.  The
+    daemon REFUSES the request with this typed taxonomy (wire error model,
+    DESIGN.md section 13) instead of letting it crash a batch."""
+
+
 # Lowercased substrings that identify a transient transport fault in backend
 # error text.  UNAVAILABLE is the gRPC status the dead tunnel produces
 # (r5_tpu_all_rows.json: every post-crash device_put failed UNAVAILABLE);
@@ -160,7 +169,8 @@ _OOM_RE = re.compile(
 _INVALID_INPUT_RE = re.compile(
     r"inputcontracterror|invalidshapeerror|nonfiniteinputerror"
     r"|domainboundserror|degenerateextenterror|invalidkerror"
-    r"|corruptinputerror|invalidconfigerror|input contract")
+    r"|corruptinputerror|invalidconfigerror|invalidrequesterror"
+    r"|input contract")
 
 
 def classify_fault_text(text: str) -> Optional[str]:

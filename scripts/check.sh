@@ -46,6 +46,23 @@ JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
     --cases "${KNTPU_FUZZ_CASES:-32}" --seed 0 --budget 60s \
     --isolation none || rc=1
 
+# Serve smoke (DESIGN.md section 13): a short fixed-seed open-loop loadgen
+# session through the dynamic-batching daemon on CPU.  --assert-steady is
+# the acceptance gate: rc 0 requires >= 1 flushed batch, ZERO steady-state
+# recompiles (ExecutableCache counters), and no failed requests.
+echo "== serve smoke (daemon + open-loop loadgen, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.serve --loadgen \
+    --points uniform:4000 --requests 60 --rate 300 --seed 0 \
+    --assert-steady || rc=1
+
+# Mutation-stream fuzz smoke (DESIGN.md section 13): seeded insert/delete/
+# query interleavings through the serving delta overlay, differentially
+# checked against the rebuild-from-scratch oracle; failures are minimized
+# and banked like the point-case campaign's.
+echo "== mutation fuzz smoke (delta overlay vs rebuild oracle, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
+    --mutations "${KNTPU_MUT_CASES:-4}" --seed 0 --budget 60s || rc=1
+
 # Sync-budget smoke (DESIGN.md section 12): every solve route -- adaptive,
 # legacy pack, external query (single-shot + chunked pipeline), sharded
 # solve + query -- must complete within the one-sync contract's budget of

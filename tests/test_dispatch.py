@@ -91,6 +91,39 @@ def test_executable_cache_reuse():
     assert cache.get_or_build(key, build) is None  # disabled: jitted path
 
 
+def test_executable_cache_bounded_lru():
+    """ISSUE 6 satellite: the cache is BOUNDED -- a long-lived daemon's
+    cache evicts least-recently-used entries at the cap, counts evictions,
+    and a hit refreshes recency."""
+    cache = dispatch.ExecutableCache(maxsize=2)
+    for name in ("a", "b"):
+        cache.get_or_build((name,), lambda name=name: f"exe-{name}")
+    assert cache.get_or_build(("a",), lambda: "rebuilt") == "exe-a"  # hit
+    cache.get_or_build(("c",), lambda: "exe-c")  # evicts b (LRU), not a
+    st = cache.stats_dict()
+    assert st["exec_cache_evictions"] == 1 and st["exec_cache_size"] == 2
+    assert st["exec_cache_cap"] == 2
+    assert cache.get_or_build(("a",), lambda: "rebuilt") == "exe-a"
+    built = []
+    cache.get_or_build(("b",), lambda: built.append(1) or "exe-b2")
+    assert built == [1]  # b was really evicted: rebuilt on next use
+    cache.clear()
+    st = cache.stats_dict()
+    assert (st["exec_cache_hits"], st["exec_cache_misses"],
+            st["exec_cache_evictions"], st["exec_cache_size"]) == (0, 0, 0, 0)
+
+
+def test_exec_cache_cap_env_knob(monkeypatch):
+    monkeypatch.setenv("KNTPU_EXEC_CACHE_CAP", "7")
+    assert dispatch._env_cache_cap() == 7
+    monkeypatch.setenv("KNTPU_EXEC_CACHE_CAP", "junk")
+    assert dispatch._env_cache_cap() == dispatch.DEFAULT_EXEC_CACHE_ENTRIES
+    monkeypatch.setenv("KNTPU_EXEC_CACHE_CAP", "-3")
+    assert dispatch._env_cache_cap() == 1  # clamped, never unbounded
+    monkeypatch.delenv("KNTPU_EXEC_CACHE_CAP")
+    assert dispatch._env_cache_cap() == dispatch.DEFAULT_EXEC_CACHE_ENTRIES
+
+
 # -- the sync-budget regression gate (ISSUE 5 acceptance) ---------------------
 
 @pytest.fixture(scope="module")

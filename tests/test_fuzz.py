@@ -187,6 +187,19 @@ def test_k_gt_n_keeps_certificates_intact():
 # -- corpus replay ------------------------------------------------------------
 
 def _corpus_entries():
+    # point-case repros only: mutation-stream repros (*-mutation.npz) have
+    # their own schema and replay via their own loader below
+    return sorted(p for p in glob.glob(os.path.join(CORPUS, "*.npz"))
+                  if not p.endswith("-mutation.npz"))
+
+
+def _mutation_corpus_entries():
+    return sorted(glob.glob(os.path.join(CORPUS, "*-mutation.npz")))
+
+
+def _all_corpus_entries():
+    # what fuzz.corpus_size() counts (and bench stamps as
+    # fuzz_corpus_size): every banked repro of BOTH flavors
     return sorted(glob.glob(os.path.join(CORPUS, "*.npz")))
 
 
@@ -410,7 +423,7 @@ def test_supervised_worker_crash_banks_case(tmp_path, monkeypatch):
 def test_corpus_size_stamp():
     from cuda_knearests_tpu.fuzz import corpus_size
 
-    assert corpus_size() == len(_corpus_entries())
+    assert corpus_size() == len(_all_corpus_entries())
     assert corpus_size("/nonexistent/dir") == 0
 
 
@@ -425,4 +438,85 @@ def test_bench_rows_carry_fuzz_corpus_size():
     finally:
         sys.path.pop(0)
     fields = bench._env_fields("cpu")
-    assert fields.get("fuzz_corpus_size") == len(_corpus_entries())
+    assert fields.get("fuzz_corpus_size") == len(_all_corpus_entries())
+
+
+# -- mutation-stream fuzzing (ISSUE 6 satellite: fuzz/mutation.py) ------------
+
+@pytest.mark.parametrize("path", _mutation_corpus_entries() or ["<empty>"],
+                         ids=[os.path.basename(p)
+                              for p in _mutation_corpus_entries()] or ["none"])
+def test_mutation_corpus_replays_clean(path):
+    """Every banked mutation-stream repro must stay fixed on the current
+    tree (regression pin, same policy as the point-case corpus)."""
+    if path == "<empty>":
+        pytest.skip("no banked mutation-stream repros (none found yet)")
+    from cuda_knearests_tpu.fuzz.mutation import load_mutation_case, replay_ops
+
+    b = load_mutation_case(path)
+    got = replay_ops(b["spec"], b["ops"])
+    assert got is None, (f"{os.path.basename(path)} regressed: {got} "
+                         f"(originally: {b['reason']})")
+
+
+def test_mutation_case_clean_and_deterministic():
+    """A fixed-spec stream replays clean against the rebuild oracle, and
+    its op list is regenerable (the corpus never ships arrays it can
+    rebuild from four scalars)."""
+    from cuda_knearests_tpu.fuzz.mutation import (MutationSpec, generate_ops,
+                                                  run_mutation_case)
+
+    spec = MutationSpec(seed=123, n0=80, n_ops=12, k=4)
+    ops1, ops2 = generate_ops(spec), generate_ops(spec)
+    assert [o["op"] for o in ops1] == [o["op"] for o in ops2]
+    kinds = {o["op"] for o in ops1}
+    assert "query" in kinds
+    assert run_mutation_case(spec, bank_dir=None) is None
+
+
+def test_mutation_seeded_fault_banks_minimized_repro(tmp_path, monkeypatch):
+    """The self-test: a seeded overlay corruption must yield a detected,
+    minimized, banked failure -- and the banked stream must round-trip."""
+    from cuda_knearests_tpu.fuzz.mutation import (MutationSpec,
+                                                  load_mutation_case,
+                                                  replay_ops,
+                                                  run_mutation_case)
+
+    monkeypatch.setenv("KNTPU_MUT_FAULT", "drop-neighbor")
+    spec = MutationSpec(seed=5, n0=60, n_ops=8, k=4)
+    f = run_mutation_case(spec, bank_dir=str(tmp_path), max_probes=12)
+    assert f is not None and f.kind == "mismatch"
+    assert f.banked and os.path.exists(f.banked)
+    assert f.minimized_ops is not None and f.minimized_ops < f.original_ops
+    b = load_mutation_case(f.banked)
+    assert b["spec"] == spec and len(b["ops"]) == f.minimized_ops
+    monkeypatch.delenv("KNTPU_MUT_FAULT")
+    # without the fault the banked repro replays CLEAN (regression-pin
+    # semantics: the corpus pins fixes, not failures)
+    assert replay_ops(b["spec"], b["ops"]) is None
+
+
+def test_mutation_faulted_run_never_banks_into_real_corpus(monkeypatch):
+    """Same diversion rule as the point campaign: synthetic KNTPU_MUT_FAULT
+    repros must not pollute tests/corpus."""
+    from cuda_knearests_tpu.fuzz import CORPUS_DIR
+    from cuda_knearests_tpu.fuzz.mutation import _safe_bank_dir
+
+    monkeypatch.setenv("KNTPU_MUT_FAULT", "perturb-d2")
+    diverted = _safe_bank_dir(CORPUS_DIR)
+    assert os.path.abspath(diverted) != os.path.abspath(CORPUS_DIR)
+    assert _safe_bank_dir("/tmp/explicit") == "/tmp/explicit"
+    monkeypatch.delenv("KNTPU_MUT_FAULT")
+    assert _safe_bank_dir(CORPUS_DIR) == CORPUS_DIR
+
+
+def test_mutation_campaign_manifest(tmp_path):
+    from cuda_knearests_tpu.fuzz.mutation import run_mutation_campaign
+
+    manifest = run_mutation_campaign(n_cases=2, seed=1,
+                                     bank_dir=str(tmp_path), log=None)
+    assert manifest["ok"] and manifest["completed_cases"] == 2
+    assert manifest["flavor"] == "mutation-stream"
+    for key in ("requested_cases", "truncated_after", "seed", "elapsed_s",
+                "failures", "corpus_size"):
+        assert key in manifest

@@ -85,3 +85,51 @@ def test_clustered_generator_contract():
     vc = _occupancy_var(c)
     vu = _occupancy_var(generate_uniform(n, seed=3))
     assert vc > 5.0 * vu, (vc, vu)
+
+
+# -- request-stream front door (ISSUE 6 satellite: io.validate_request) -------
+
+def test_validate_request_query_ok():
+    from cuda_knearests_tpu.io import validate_request
+
+    q = generate_uniform(5, seed=1)
+    out = validate_request("query", q, k=3, k_max=10, max_batch=64)
+    assert out.shape == (5, 3) and out.dtype == np.float32
+
+
+def test_validate_request_typed_refusals():
+    from cuda_knearests_tpu.io import validate_request
+    from cuda_knearests_tpu.utils.memory import (InputContractError,
+                                                 InvalidKError,
+                                                 InvalidRequestError)
+
+    q = generate_uniform(4, seed=2)
+    with pytest.raises(InvalidRequestError, match="unknown request kind"):
+        validate_request("solve", q)
+    with pytest.raises(InvalidKError, match="serving k"):
+        validate_request("query", q, k=20, k_max=10)
+    with pytest.raises(InvalidRequestError, match="max_batch"):
+        validate_request("query", generate_uniform(9, seed=3), max_batch=8)
+    with pytest.raises(InputContractError):  # domain bounds via points path
+        validate_request("insert", q - 500.0)
+    # every refusal carries the 'invalid-input' kind the rc-5 path keys on
+    try:
+        validate_request("delete", np.array([3, 3]), n_current=10)
+    except InputContractError as e:
+        assert e.kind == "invalid-input"
+    else:
+        raise AssertionError("duplicate delete ids must refuse")
+
+
+def test_validate_request_delete_contract():
+    from cuda_knearests_tpu.io import validate_request
+    from cuda_knearests_tpu.utils.memory import InvalidRequestError
+
+    out = validate_request("delete", np.array([1, 4, 2]), n_current=10)
+    assert out.tolist() == [1, 4, 2]
+    with pytest.raises(InvalidRequestError, match="integer"):
+        validate_request("delete", np.array([0.5]), n_current=10)
+    with pytest.raises(InvalidRequestError, match="current cloud"):
+        validate_request("delete", np.array([10]), n_current=10)
+    with pytest.raises(InvalidRequestError, match="current cloud"):
+        validate_request("delete", np.array([-1]), n_current=10)

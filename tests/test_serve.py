@@ -1,0 +1,544 @@
+"""Serving subsystem acceptance: batching law, delta-overlay byte-identity,
+zero-recompile steady state, typed failure containment.
+
+The ISSUE 6 gates pinned here:
+
+  * the steady-state serving loop performs ZERO recompiles after warmup,
+    asserted via the ExecutableCache counters on the 20k fixture;
+  * incremental insert/delete + query results are byte-identical to a full
+    re-prepare on the mutated cloud, for both the delta-overlay and the
+    post-compaction states;
+  * a crashed or refused request costs one batch (typed failure mapped
+    onto FAILURE_KINDS), never the daemon.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.config import ServeConfig
+from cuda_knearests_tpu.io import generate_uniform
+from cuda_knearests_tpu.runtime import dispatch
+from cuda_knearests_tpu.runtime.supervisor import FAILURE_KINDS
+from cuda_knearests_tpu.serve import (DeltaOverlay, DynamicBatcher, LoadSpec,
+                                      Request, ServeDaemon, build_schedule,
+                                      run_session)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def served_20k(pts20k):
+    """One legacy-route problem over the 20k fixture (the serving pin:
+    its query launches ride the executable cache)."""
+    return KnnProblem.prepare(pts20k, KnnConfig(k=10, adaptive=False))
+
+
+# -- ServeConfig: the bucket ladder -------------------------------------------
+
+def test_bucket_ladder():
+    cfg = ServeConfig(max_batch=100, min_bucket=8)
+    assert cfg.buckets() == (8, 16, 32, 64, 128)
+    assert cfg.bucket_for(1) == 8
+    assert cfg.bucket_for(9) == 16
+    assert cfg.bucket_for(100) == 128
+
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError):
+        ServeConfig(max_batch=4, min_bucket=8)
+    with pytest.raises(ValueError):
+        ServeConfig(max_delay_s=-1.0)
+
+
+# -- DynamicBatcher: the flush law (synthetic time) ---------------------------
+
+def _req(i, m, t, k=10):
+    return Request(req_id=i, queries=np.zeros((m, 3), np.float32), k=k,
+                   arrived_at=t)
+
+
+def test_batcher_size_trigger():
+    b = DynamicBatcher(ServeConfig(max_batch=32, max_delay_s=100.0))
+    assert b.admit(_req(1, 20, 0.0), 0.0) == []
+    out = b.admit(_req(2, 20, 0.1), 0.1)   # 40 > 32: flush the first alone
+    assert len(out) == 1 and out[0].total == 20 and out[0].reason == "size"
+    assert b.pending_queries == 20
+    out = b.admit(_req(3, 12, 0.2), 0.2)   # exactly full: eager flush
+    assert len(out) == 1 and out[0].total == 32
+    assert out[0].capacity == 32 and out[0].occupancy == 1.0
+
+
+def test_batcher_deadline_trigger():
+    b = DynamicBatcher(ServeConfig(max_batch=64, max_delay_s=0.5))
+    assert b.admit(_req(1, 4, 10.0), 10.0) == []
+    assert b.poll(10.2) is None            # not due yet
+    assert b.next_deadline() == 10.5
+    flushed = b.poll(10.6)
+    assert flushed is not None and flushed.reason == "deadline"
+    assert flushed.total == 4 and flushed.capacity == 8  # min bucket pad
+
+
+def test_batcher_barrier_and_drain():
+    b = DynamicBatcher(ServeConfig(max_batch=64, max_delay_s=100.0))
+    b.admit(_req(1, 4, 0.0), 0.0)
+    flushed = b.flush("barrier", 0.1)
+    assert flushed.reason == "barrier" and flushed.total == 4
+    assert b.flush("drain", 0.2) is None   # empty: nothing to drain
+    assert b.flushes == {"size": 0, "deadline": 0, "barrier": 1, "drain": 0}
+
+
+# -- delta overlay: byte-identity vs rebuild-from-scratch (acceptance) --------
+
+def test_overlay_byte_identical_to_rebuild(served_20k, rng):
+    """THE incremental-update gate: after interleaved deletes and inserts,
+    overlay answers are byte-identical to a full re-prepare of the mutated
+    cloud -- in the delta-overlay state AND after compaction."""
+    ov = DeltaOverlay(served_20k, compact_threshold=10 ** 6)
+    n0 = served_20k.grid.n_points
+    ov.delete(np.sort(rng.choice(n0, 60, replace=False)))
+    ov.insert((rng.random((90, 3)) * 990 + 5).astype(np.float32))
+    ov.delete(np.sort(rng.choice(ov.n_points, 10, replace=False)))
+    queries = generate_uniform(400, seed=77)
+    got_i, got_d = ov.query(queries, 10)
+
+    rebuild = served_20k.with_points(ov.mutated_points())
+    ref_i, ref_d = rebuild.query(queries, 10)
+    np.testing.assert_array_equal(got_i, ref_i)
+    np.testing.assert_array_equal(got_d, ref_d)
+    assert ov.stats.resolved_rows > 0      # tombstones actually exercised
+    assert ov.stats.delta_launches > 0     # and the delta merge
+
+    ov.compact()                            # fold into a re-prepare
+    assert ov.mutations_pending == 0
+    got2_i, got2_d = ov.query(queries, 10)
+    np.testing.assert_array_equal(got2_i, ref_i)
+    np.testing.assert_array_equal(got2_d, ref_d)
+
+
+def test_overlay_compaction_threshold_triggers(uniform_10k):
+    p = KnnProblem.prepare(uniform_10k, KnnConfig(k=8, adaptive=False))
+    ov = DeltaOverlay(p, compact_threshold=16)
+    ov.insert((np.random.default_rng(3).random((16, 3)) * 990 + 5)
+              .astype(np.float32))
+    assert ov.stats.compactions == 1 and ov.mutations_pending == 0
+    assert ov.n_points == 10_016
+
+
+def test_overlay_degraded_small_cloud():
+    """k > n_alive: pad contract (-1/inf) must match the rebuild's."""
+    pts = generate_uniform(6, seed=2)
+    p = KnnProblem.prepare(pts, KnnConfig(k=5, adaptive=False))
+    ov = DeltaOverlay(p, compact_threshold=10 ** 6)
+    ov.delete(np.array([0, 1, 2, 3]))
+    queries = generate_uniform(7, seed=3)
+    got_i, got_d = ov.query(queries, 5)
+    ref_i, ref_d = p.with_points(ov.mutated_points()).query(queries, 5)
+    np.testing.assert_array_equal(got_i, ref_i)
+    np.testing.assert_array_equal(got_d, ref_d)
+    assert (got_i[:, 2:] == -1).all() and np.isinf(got_d[:, 2:]).all()
+
+
+def test_overlay_dirty_cell_skip(uniform_10k):
+    """A mutation far from every query is pruned by the dirty-cell bound:
+    the delta launch is skipped outright, and results are still exact."""
+    p = KnnProblem.prepare(uniform_10k, KnnConfig(k=4, adaptive=False))
+    ov = DeltaOverlay(p, compact_threshold=10 ** 6)
+    ov.insert(np.full((4, 3), 995.0, np.float32))   # one far corner
+    queries = (np.random.default_rng(9).random((64, 3)) * 40.0
+               ).astype(np.float32)                  # opposite corner
+    got_i, got_d = ov.query(queries, 4)
+    assert ov.stats.delta_skips == 1 and ov.stats.delta_launches == 0
+    ref_i, ref_d = p.with_points(ov.mutated_points()).query(queries, 4)
+    np.testing.assert_array_equal(got_i, ref_i)
+    np.testing.assert_array_equal(got_d, ref_d)
+
+
+# -- zero recompiles in steady state (acceptance, 20k fixture) ----------------
+
+def test_steady_state_zero_recompiles(served_20k):
+    """After the daemon's warmup pass over the capacity-bucket ladder, a
+    whole open-loop session must hit only cached executables: the
+    ExecutableCache miss counter may not move."""
+    dispatch.EXEC_CACHE.clear()
+    daemon = ServeDaemon(served_20k, ServeConfig(max_batch=128,
+                                                 max_delay_s=0.003))
+    if not dispatch.EXEC_CACHE.enabled:
+        pytest.fail("executable cache disabled on CPU -- the serving "
+                    "zero-recompile law has no counter to assert against: "
+                    f"{dispatch.EXEC_CACHE.disabled_by}")
+    warm = dispatch.EXEC_CACHE.stats_dict()
+    assert warm["exec_cache_misses"] >= len(daemon.config.buckets())
+    summary = run_session(daemon, LoadSpec(rate=600.0, requests=150, seed=6))
+    assert summary["batches"] >= 1
+    assert summary["recompiles"] == 0, summary
+    assert summary["failed_requests"] == 0 and summary["refused"] == 0
+    assert summary["exec_cache_hits"] > warm["exec_cache_hits"]
+    assert summary["completed_queries"] > 0
+    assert summary["p50_ms"] is not None and summary["p99_ms"] is not None
+    assert summary["sustained_qps"] > 0
+
+
+# -- containment: a crashed/refused request costs one batch, not the daemon --
+
+def test_batch_fault_contained_typed(served_20k, monkeypatch):
+    monkeypatch.setenv("KNTPU_SERVE_FAULT", "batch:0")
+    daemon = ServeDaemon(served_20k, ServeConfig(max_batch=64,
+                                                 max_delay_s=0.001))
+    queries = generate_uniform(8, seed=11)
+    out = daemon.submit(1, "query", queries)
+    out += daemon.drain()
+    assert len(out) == 1 and not out[0].ok
+    assert out[0].failure_kind in FAILURE_KINDS
+    assert out[0].failure_kind == "crash"
+    assert daemon.failed_batches == 1
+    # the daemon SURVIVES: the next batch executes normally
+    out2 = daemon.submit(2, "query", queries)
+    out2 += daemon.drain()
+    assert len(out2) == 1 and out2[0].ok
+    assert out2[0].ids.shape == (8, 10)
+
+
+def test_batch_fault_oom_kind(served_20k, monkeypatch):
+    monkeypatch.setenv("KNTPU_SERVE_FAULT", "batch:0:oom")
+    daemon = ServeDaemon(served_20k, ServeConfig(max_batch=64,
+                                                 max_delay_s=0.001))
+    out = daemon.submit(1, "query", generate_uniform(4, seed=12))
+    out += daemon.drain()
+    assert not out[0].ok and out[0].failure_kind == "oom"
+    assert daemon.failure_kinds == {"oom": 1}
+
+
+def test_refusal_typed_and_isolated(served_20k):
+    """A malformed request refuses typed (kind 'invalid-input') at
+    admission and costs nothing else -- pending work still completes."""
+    daemon = ServeDaemon(served_20k, ServeConfig(max_batch=64,
+                                                 max_delay_s=0.001))
+    good = generate_uniform(4, seed=13)
+    daemon.submit(1, "query", good)                       # pending
+    bad = np.full((3, 3), -42.0, np.float32)              # out of domain
+    refusals = daemon.submit(2, "query", bad)
+    assert len(refusals) == 1 and not refusals[0].ok
+    assert refusals[0].failure_kind == "invalid-input"
+    assert "domain" in refusals[0].error.lower()
+    assert daemon.refused == 1
+    done = daemon.drain()
+    assert len(done) == 1 and done[0].ok and done[0].req_id == 1
+
+
+def test_refusal_matrix(served_20k):
+    daemon = ServeDaemon(served_20k, ServeConfig(max_batch=32,
+                                                 max_delay_s=0.001))
+    cases = [
+        ("query", np.zeros((4, 2), np.float32), None),        # bad shape
+        ("query", generate_uniform(4, seed=1), 99),           # k > serving k
+        ("query", generate_uniform(64, seed=1), None),        # > max_batch
+        ("insert", np.full((2, 3), np.nan, np.float32), None),  # non-finite
+        ("delete", np.array([0.5, 1.5]), None),               # float ids
+        ("delete", np.array([10 ** 9]), None),                # out of range
+        ("delete", np.array([1, 1]), None),                   # duplicates
+        ("frobnicate", np.zeros((1, 3), np.float32), None),   # unknown kind
+    ]
+    for i, (kind, payload, k) in enumerate(cases):
+        out = daemon.submit(i, kind, payload, k=k)
+        assert len(out) == 1 and not out[0].ok, (kind, payload)
+        assert out[0].failure_kind == "invalid-input"
+    assert daemon.refused == len(cases)
+    assert daemon.failed_batches == 0
+
+
+# -- mutation barriers + per-request k ----------------------------------------
+
+def test_mutation_is_barrier(served_20k):
+    """Queries pending at a mutation's arrival flush FIRST (they answer
+    against the pre-mutation cloud)."""
+    daemon = ServeDaemon(served_20k, ServeConfig(max_batch=64,
+                                                 max_delay_s=100.0))
+    daemon.submit(1, "query", generate_uniform(4, seed=14))
+    n_before = daemon.overlay.n_points
+    out = daemon.submit(2, "insert",
+                        (np.random.default_rng(5).random((6, 3)) * 990 + 5)
+                        .astype(np.float32))
+    assert [r.req_id for r in out] == [1, 2]
+    assert out[0].ok and out[1].ok
+    assert out[1].n_points == n_before + 6
+    assert daemon.batcher.flushes["barrier"] == 1
+    # the flushed query's neighbor ids predate the insert: all < n_before
+    assert (out[0].ids < n_before).all()
+
+
+def test_per_request_k_truncates(served_20k):
+    daemon = ServeDaemon(served_20k, ServeConfig(max_batch=64,
+                                                 max_delay_s=0.001))
+    queries = generate_uniform(5, seed=15)
+    full = daemon.submit(1, "query", queries) + daemon.drain()
+    small = daemon.submit(2, "query", queries, k=3) + daemon.drain()
+    assert full[0].ids.shape == (5, 10) and small[0].ids.shape == (5, 3)
+    np.testing.assert_array_equal(small[0].ids, full[0].ids[:, :3])
+    np.testing.assert_array_equal(small[0].d2, full[0].d2[:, :3])
+
+
+# -- open-loop load generator -------------------------------------------------
+
+def test_schedule_is_seeded_and_open_loop():
+    spec = LoadSpec(rate=100.0, requests=40, mutation_ratio=0.3, seed=9)
+    s1 = build_schedule(spec, n_current=1000)
+    s2 = build_schedule(spec, n_current=1000)
+    assert len(s1) == 40
+    times = [item["t"] for item in s1]
+    assert times == sorted(times)           # arrivals pre-scheduled, ordered
+    assert [i["kind"] for i in s1] == [i["kind"] for i in s2]
+    for a, b in zip(s1, s2):
+        np.testing.assert_array_equal(a["payload"], b["payload"])
+    kinds = {i["kind"] for i in s1}
+    assert "query" in kinds and kinds & {"insert", "delete"}
+
+
+def test_mutating_session_end_to_end(uniform_10k):
+    """Mutations ride the live loop: inserts/deletes apply as barriers,
+    every response lands, and the overlay's cloud tracks the net size."""
+    p = KnnProblem.prepare(uniform_10k, KnnConfig(k=8, adaptive=False))
+    daemon = ServeDaemon(p, ServeConfig(max_batch=64, max_delay_s=0.002))
+    spec = LoadSpec(rate=500.0, requests=60, mutation_ratio=0.3, seed=10)
+    summary = run_session(daemon, spec)
+    assert summary["responses"] == summary["requests"]
+    assert summary["failed_requests"] == 0 and summary["refused"] == 0
+    net = (summary["overlay_inserts"] - summary["overlay_deletes"])
+    assert summary["n_points"] == 10_000 + net
+
+
+# -- the daemon front door ----------------------------------------------------
+
+def test_stdio_daemon_roundtrip():
+    """The JSON-lines wire surface end to end in a subprocess: queries
+    answer, mutations apply, malformed requests refuse typed."""
+    reqs = [
+        {"id": 1, "op": "query",
+         "data": (generate_uniform(3, seed=21) * 1.0).tolist(), "k": 4},
+        {"id": 2, "op": "insert",
+         "data": (generate_uniform(2, seed=22) * 1.0).tolist()},
+        {"id": 3, "op": "delete", "data": [0, 5]},
+        {"id": 4, "op": "query", "data": [[-1.0, 0.0, 0.0]]},  # refusal
+    ]
+    payload = "\n".join(json.dumps(r) for r in reqs) + "\n"
+    proc = subprocess.run(
+        [sys.executable, "-m", "cuda_knearests_tpu.serve",
+         "--points", "uniform:600", "--k", "6", "--max-delay-ms", "1"],
+        input=payload, capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines() if ln.strip()]
+    by_id = {ln["id"]: ln for ln in lines}
+    assert by_id[1]["ok"] and len(by_id[1]["ids"]) == 3
+    assert len(by_id[1]["ids"][0]) == 4
+    assert by_id[2]["ok"] and by_id[2]["n_points"] == 602
+    assert by_id[3]["ok"] and by_id[3]["n_points"] == 600
+    assert not by_id[4]["ok"]
+    assert by_id[4]["failure_kind"] == "invalid-input"
+
+
+def test_loadgen_cli_assert_steady():
+    """The check.sh smoke's exact invocation: rc 0, >= 1 batch, zero
+    steady-state recompiles."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "cuda_knearests_tpu.serve", "--loadgen",
+         "--points", "uniform:2000", "--requests", "30", "--rate", "300",
+         "--seed", "0", "--assert-steady"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.splitlines()[-1])
+    assert summary["recompiles"] == 0 and summary["batches"] >= 1
+
+
+# -- bench rows (ISSUE 6 acceptance: --serve emits QPS + latency rows) --------
+
+def test_bench_serve_contained_fault_row():
+    """The bench row that demonstrates the containment law: the injected
+    batch fault costs exactly one typed batch, the malformed request
+    refuses typed, and the session still completes with QPS + latency."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    row = bench.serve_scenario("serve_20k_contained_fault")
+    assert row["unit"] == "queries/sec" and row["value"] > 0
+    assert row["p50_ms"] is not None and row["p99_ms"] is not None
+    assert row["failed_batches"] == 1
+    assert row["failure_kinds"] == {"oom": 1}
+    assert row["failed_requests"] >= 1       # the fault batch's riders
+    assert row["refusal_typed"] and row["containment_ok"]
+    assert row["completed_queries"] > 0      # the daemon kept serving
+    assert "host_syncs" in row and "recompiles" in row
+
+
+def test_cli_serve_mode(capsys):
+    """`python -m cuda_knearests_tpu.cli <pts> --serve RATE` runs the load
+    harness against the prepared cloud and emits the serving summary."""
+    from cuda_knearests_tpu import cli
+
+    rc = cli.main(["pts20K.xyz", "--k", "6", "--serve", "400",
+                   "--serve-requests", "40"])
+    out = capsys.readouterr().out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert rc == 0
+    assert summary["mode"] == "serve" and summary["k"] == 6
+    assert summary["sustained_qps"] > 0 and summary["batches"] >= 1
+    assert summary["failed_requests"] == 0
+
+
+def test_mutation_apply_failure_contained(served_20k, monkeypatch):
+    """A mutation whose apply dies (e.g. compaction's re-prepare raising)
+    costs THAT request one typed failure; the daemon keeps serving."""
+    daemon = ServeDaemon(served_20k, ServeConfig(max_batch=64,
+                                                 max_delay_s=0.001))
+
+    def boom(points):
+        raise RuntimeError("synthetic re-prepare death")
+
+    monkeypatch.setattr(daemon.overlay, "insert", boom)
+    out = daemon.submit(1, "insert", generate_uniform(2, seed=30))
+    assert len(out) == 1 and not out[0].ok
+    assert out[0].failure_kind == "crash"
+    assert daemon.failed_mutations == 1
+    # daemon survives: queries and real mutations still work
+    ok = daemon.submit(2, "query", generate_uniform(3, seed=31)) \
+        + daemon.drain()
+    assert ok[-1].ok and ok[-1].ids.shape == (3, 10)
+
+
+def test_wire_is_strict_json():
+    """Pad slots (k > n neighbors) must serialize as null, never the
+    non-RFC Infinity token -- strict parsers consume the wire."""
+    from cuda_knearests_tpu.serve.daemon import Response
+
+    r = Response(req_id=7, ok=True,
+                 ids=np.array([[3, -1]], np.int32),
+                 d2=np.array([[1.5, np.inf]], np.float32))
+    text = json.dumps(r.to_wire())
+    assert "Infinity" not in text
+
+    def _reject(tok):
+        raise AssertionError(f"non-RFC token on the wire: {tok}")
+
+    wire = json.loads(text, parse_constant=_reject)  # strict-parser stand-in
+    assert wire["d2"] == [[1.5, None]] and wire["ids"] == [[3, -1]]
+
+
+def test_delta_csr_gathers_only_surviving_cells(uniform_10k):
+    """The pruned delta launch scores only CSR-gathered rows from cells
+    some query's bound could not drop: a far-corner insert contributes
+    zero candidates to near-corner queries even when a co-located insert
+    forces a launch."""
+    p = KnnProblem.prepare(uniform_10k, KnnConfig(k=4, adaptive=False))
+    ov = DeltaOverlay(p, compact_threshold=10 ** 6)
+    ov.insert(np.full((32, 3), 995.0, np.float32))   # far corner
+    ov.insert(np.full((2, 3), 20.0, np.float32))     # among the queries
+    queries = (np.random.default_rng(9).random((64, 3)) * 40.0
+               ).astype(np.float32)
+    got_i, got_d = ov.query(queries, 4)
+    assert ov.stats.delta_launches == 1
+    assert ov.stats.delta_candidates == 2            # far corner pruned
+    ref_i, ref_d = p.with_points(ov.mutated_points()).query(queries, 4)
+    np.testing.assert_array_equal(got_i, ref_i)
+    np.testing.assert_array_equal(got_d, ref_d)
+
+
+def test_stdio_burst_on_held_open_pipe():
+    """Requests written in ONE burst on a pipe that stays open must all be
+    answered (the select-vs-buffered-readline stranding bug): responses
+    arrive without the client sending more bytes or closing stdin."""
+    import select as _select
+
+    reqs = [{"id": i, "op": "query",
+             "data": generate_uniform(2, seed=40 + i).tolist(), "k": 4}
+            for i in range(3)]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cuda_knearests_tpu.serve",
+         "--points", "uniform:500", "--k", "4", "--max-delay-ms", "2"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    try:
+        proc.stdin.write("".join(json.dumps(r) + "\n" for r in reqs))
+        proc.stdin.flush()                       # pipe stays OPEN
+        got = {}
+        # read the RAW fd (select + buffered readline would strand
+        # coalesced responses in the client's buffer -- the mirror image
+        # of the daemon-side bug this test pins)
+        fd = proc.stdout.fileno()
+        buf = b""
+        deadline = 180.0
+        import time as _time
+        t0 = _time.monotonic()
+        while len(got) < 3 and _time.monotonic() - t0 < deadline:
+            while b"\n" in buf:
+                raw, buf = buf.split(b"\n", 1)
+                if raw.strip():
+                    r = json.loads(raw)
+                    got[r["id"]] = r
+            if len(got) >= 3:
+                break
+            if _select.select([fd], [], [], 1.0)[0]:
+                chunk = os.read(fd, 1 << 16)
+                if not chunk:
+                    break
+                buf += chunk
+        assert sorted(got) == [0, 1, 2], \
+            f"only {sorted(got)} answered before stdin closed"
+        assert all(r["ok"] for r in got.values())
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=60)
+    assert proc.returncode == 0
+
+
+def test_insert_preserves_alive_caches(uniform_10k):
+    """Inserts must not invalidate the O(n) alive-set caches (only the
+    tombstone mask feeds them); deletes must."""
+    p = KnnProblem.prepare(uniform_10k, KnnConfig(k=4, adaptive=False))
+    ov = DeltaOverlay(p, compact_threshold=10 ** 6)
+    sentinel_cache, sentinel_map = ("pts", "ids"), np.arange(3)
+    ov._alive_cache = sentinel_cache
+    ov._old2new = sentinel_map
+    ov.insert(np.full((2, 3), 500.0, np.float32))
+    assert ov._alive_cache is sentinel_cache and ov._old2new is sentinel_map
+    ov.delete(np.array([0]))
+    assert ov._alive_cache is None and ov._old2new is None
+
+
+def test_serve_config_rejects_k_zero():
+    with pytest.raises(ValueError, match="serving k"):
+        ServeConfig(k=0)
+    assert ServeConfig(k=None).k is None   # None still means "prepared k"
+
+
+def test_mutation_fuzz_duplicate_flavor_hits_base_points():
+    """The tie-hazard insert flavor must produce exact copies of an
+    initial-cloud point (bit-identical f32 coords), across campaign
+    seeds."""
+    from cuda_knearests_tpu.fuzz.mutation import (MutationSpec,
+                                                  generate_ops,
+                                                  initial_points)
+
+    found = False
+    for seed in range(40):
+        spec = MutationSpec(seed=seed, n0=50, n_ops=12, k=4)
+        pts0 = initial_points(spec)
+        for op in generate_ops(spec):
+            if op["op"] != "insert":
+                continue
+            pts = op["points"]
+            if pts.shape[0] and (pts == pts[0]).all() and \
+                    (pts0 == pts[0]).all(axis=1).any():
+                found = True
+                break
+        if found:
+            break
+    assert found, "no seed in 0..39 produced a base-point duplicate insert"
