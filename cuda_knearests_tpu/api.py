@@ -83,6 +83,13 @@ class KnnProblem:
     config: KnnConfig
     plan: Optional[SolvePlan] = None
     result: Optional[KnnResult] = None
+    # Host-resident original-order input points (the validated array
+    # prepare() staged from).  Kept by reference, never copied: the plane
+    # feed (cluster/planes.py) and other host epilogues read coordinates
+    # from here at zero device round trips.  None on problems resumed from
+    # a checkpoint -- _host_original() then reconstructs via one counted
+    # fetch and caches the result here.
+    host_points: Optional[np.ndarray] = None
     pack: Optional[object] = None  # cached PallasPack (pallas backend only)
     aplan: Optional[object] = None  # cached AdaptivePlan (adaptive solve)
     _oracle: Optional[object] = None  # KdTreeOracle (oracle backend only)
@@ -111,7 +118,9 @@ class KnnProblem:
         points = (validate_or_raise(points, k=config.k) if validate
                   else np.asarray(points, np.float32))
         grid = build_grid(points, dim=dim, density=config.density)
-        problem = cls(grid=grid, config=config)
+        problem = cls(grid=grid, config=config,
+                      host_points=points if isinstance(points, np.ndarray)
+                      else None)
         if grid.n_points == 0:
             # empty cloud: nothing to plan -- solve()/query() short-circuit
             # to empty / all-invalid results (degraded mode, DESIGN.md s11)
@@ -182,7 +191,7 @@ class KnnProblem:
                 neighbors=np.empty((0, k), np.int32),
                 dists_sq=np.empty((0, k), np.float32),
                 certified=np.empty((0,), bool))
-            return self.result
+            return self._with_plane_feed()
         if self.config.backend == "oracle":
             ids, d2 = self._oracle.knn_all_points(self.config.k) \
                 if self.config.exclude_self else self._oracle.knn(
@@ -195,7 +204,7 @@ class KnnProblem:
                 dists_sq=np.asarray(d2, np.float32),
                 certified=np.ones((self.grid.n_points,), bool),
                 uncert_count=np.int32(0))
-            return self.result
+            return self._with_plane_feed()
         if self._adaptive_eligible():
             from .ops.adaptive import build_adaptive_plan, solve_adaptive
 
@@ -211,7 +220,7 @@ class KnnProblem:
                 self.pack = prepare_pack(self.grid, self.config, self.plan)
             res = solve(self.grid, self.config, self.plan, self.pack)
         self.result = self._finalize(res)
-        return self.result
+        return self._with_plane_feed()
 
     def _finalize(self, res: KnnResult) -> KnnResult:
         """One-sync completion (DESIGN.md section 12): a single batched D2H
@@ -253,7 +262,50 @@ class KnnProblem:
         return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
                          uncert_count=np.int32(int(n_unc)))
 
-    def query(self, queries, k: int | None = None):
+    def _with_plane_feed(self) -> KnnResult:
+        """solve()'s one exit: when ``config.plane_feed`` is on, attach the
+        Voronoi plane feed (cluster/planes.py) to the finalized result --
+        a pure-host f64 epilogue over the already-fetched rows, zero extra
+        device syncs (DESIGN.md section 14)."""
+        if self.config.plane_feed and self.result.planes is None:
+            self.result = dataclasses.replace(
+                self.result, planes=self._compute_planes())
+        return self.result
+
+    def _host_original(self) -> np.ndarray:
+        """Original-order host coordinates of the stored cloud.  Free on
+        prepared problems (the validated input array is kept by
+        reference); checkpoint-resumed problems pay one counted fetch and
+        cache it."""
+        if self.host_points is None:
+            pts, perm = _dispatch.fetch(self.grid.points,
+                                        self.grid.permutation)
+            out = np.empty_like(np.asarray(pts))
+            out[np.asarray(perm)] = np.asarray(pts)
+            self.host_points = out
+        return self.host_points
+
+    def _compute_planes(self) -> np.ndarray:
+        from .cluster.planes import bisector_planes
+
+        pts = self._host_original()
+        return bisector_planes(pts, pts, self.get_knearests_original())
+
+    def get_planes(self) -> np.ndarray:
+        """(n, k, 4) f32 bisector-plane feed of the solved all-points kNN:
+        rows in ORIGINAL point order, ``[nx, ny, nz, d]`` per neighbor
+        with the half-space ``n . x <= d`` containing the site (pad slots
+        are the trivially-true ``n=0, d=inf``).  The explicit form of what
+        the reference's DEFAULT_NB_PLANES k feeds its clipping pipeline
+        (params.h:4); see cluster/planes.py for the precision contract.
+        Computed once and cached on the result."""
+        self._require_solved()
+        if self.result.planes is None:
+            self.result = dataclasses.replace(
+                self.result, planes=self._compute_planes())
+        return self.result.planes
+
+    def query(self, queries, k: int | None = None, planes: bool = False):
         """Exact kNN of arbitrary query coordinates against the stored points.
 
         The reference's GPU engine only answers the all-points self-query; its
@@ -264,7 +316,11 @@ class KnnProblem:
         the candidate dilation the completeness certificate relies on.
 
         Returns ((m, k) neighbor ids in original indexing, ascending by
-        distance; (m, k) squared distances).
+        distance; (m, k) squared distances) -- plus, with ``planes=True``,
+        the (m, k, 4) Voronoi bisector-plane feed of the rows
+        (cluster/planes.py: ``[nx, ny, nz, d]``, half-space ``n . x <= d``
+        containing the query; a pure-host f64 epilogue over the fetched
+        rows, zero extra device syncs).
         """
         from .io import validate_or_raise
 
@@ -275,6 +331,16 @@ class KnnProblem:
             raise InvalidKError(
                 f"k={k} exceeds the prepared k={self.config.k}; re-prepare "
                 f"with a larger config.k (it sizes the candidate dilation)")
+        ids, d2 = self._query_ids(queries, k)
+        if not planes:
+            return ids, d2
+        from .cluster.planes import bisector_planes
+
+        return ids, d2, bisector_planes(queries, self._host_original(), ids)
+
+    def _query_ids(self, queries: np.ndarray, k: int):
+        """query()'s route dispatch (validated inputs): ((m, k) ids in
+        original indexing, (m, k) d2)."""
         if self.grid.n_points == 0:
             # degraded mode: no stored points -> every row is all -1/inf
             return (np.full((queries.shape[0], k), -1, np.int32),

@@ -1,0 +1,34 @@
+"""Clustering subsystem: the third query family on the shared grid core.
+
+The engine's first two query families (kNN, fixed-radius) answer per-query
+rows; this package adds workloads whose output is a GLOBAL graph property
+of the point cloud, computed on the same grid machinery:
+
+* :mod:`fof` -- friends-of-friends connected components over fixed-radius
+  pairs (the cosmology "FoF halo finder" primitive, JZ-Tree arXiv
+  2604.05885): pair enumeration rides the existing grid-hash CSR + the
+  27-cell ring schedule (``ops/rings.ring_schedule(2)``), and the
+  connected-components labeling is an on-device iterative union-find
+  (min-label propagation + pointer jumping) whose only host traffic is a
+  counted convergence flag per round through ``runtime.dispatch.fetch``.
+* :mod:`planes` -- the Voronoi/power-diagram plane feed the reference's
+  own ``DEFAULT_NB_PLANES`` naming promises (params.h:4): the per-neighbor
+  bisector-plane representation ``(n, d) = (p - q, (|p|^2 - |q|^2) / 2)``
+  emitted as an optional epilogue of every kNN surface.
+* :mod:`compare` -- the tie-aware differential check for FoF labels
+  against the CPU union-find oracle (``oracle.fof_oracle``): pairs within
+  the f32 rounding band of the linking radius may legally link either
+  way, so the engine partition is checked against the oracle's
+  mandatory/allowed partition pair instead of naive label equality.
+
+``python -m cuda_knearests_tpu.cluster`` runs the CPU smoke (FoF vs the
+union-find oracle + the plane-feed bit-identity pin) -- wired into
+``scripts/check.sh``.  See DESIGN.md section 14.
+"""
+
+from __future__ import annotations
+
+from .fof import FofResult, fof_labels  # noqa: F401
+from .planes import bisector_planes  # noqa: F401
+
+__all__ = ["FofResult", "fof_labels", "bisector_planes"]

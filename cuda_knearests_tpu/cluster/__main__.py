@@ -1,0 +1,91 @@
+"""``python -m cuda_knearests_tpu.cluster`` -- the clustering CPU smoke.
+
+Two fixed-seed checks in bounded time (wired into scripts/check.sh):
+
+1. **FoF vs the union-find oracle**: friends-of-friends labels on a small
+   uniform cloud at three linking regimes (sparse / percolating / dense)
+   must pass the tie-aware partition check (cluster/compare.py), and the
+   solve's sync accounting must match the rounds+1 contract.
+2. **Plane-feed pin**: the bisector planes emitted by the solve epilogue
+   and the query surface must be bit-identical to an independent f64
+   recompute from the returned neighbor ids (DESIGN.md section 14).
+
+Exit code 0 = both clean, 1 = any violation (one JSON line per check).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _smoke(n: int = 2500) -> int:
+    import numpy as np
+
+    from .. import KnnConfig, KnnProblem
+    from ..config import DOMAIN_SIZE
+    from ..io import generate_uniform
+    from .compare import check_fof_result
+    from .fof import fof_labels
+    from .planes import bisector_planes
+
+    rc = 0
+    points = generate_uniform(n, seed=11)
+    spacing = DOMAIN_SIZE / float(n) ** (1.0 / 3.0)
+
+    for regime, scale in (("sparse", 0.4), ("percolating", 1.0),
+                          ("dense", 2.2)):
+        res = fof_labels(points, scale * spacing)
+        bad = check_fof_result(points, res.linking_length, res.labels,
+                               res.sizes)
+        sync_ok = res.host_syncs == res.rounds + 1
+        ok = bad is None and sync_ok
+        rc |= 0 if ok else 1
+        print(json.dumps({
+            "check": f"fof-vs-oracle[{regime}]", "ok": ok,
+            "n": n, "b": round(res.linking_length, 3),
+            "clusters": res.n_clusters, "rounds": res.rounds,
+            "host_syncs": res.host_syncs,
+            **({} if bad is None else {"mismatch": bad.render()})}),
+            flush=True)
+
+    # plane-feed pin: solve epilogue + query surface vs f64 recompute
+    k = 8
+    problem = KnnProblem.prepare(points, KnnConfig(k=k, plane_feed=True))
+    problem.solve()
+    queries = generate_uniform(256, seed=12)
+    ids_q, _d2, planes_q = problem.query(queries, planes=True)
+
+    def ref_planes(sites, ids):
+        q = sites.astype(np.float64)[:, None, :]  # kntpu-ok: wide-dtype -- the independent f64 recompute the pin compares against, host-only
+        p = points[np.clip(ids, 0, None)].astype(np.float64)  # kntpu-ok: wide-dtype -- the independent f64 recompute the pin compares against, host-only
+        nn = (p - q).astype(np.float32)
+        d = (((p * p).sum(-1) - (q * q).sum(-1)) / 2.0).astype(np.float32)
+        ok = ids >= 0
+        out = np.concatenate(
+            [np.where(ok[..., None], nn, np.float32(0.0)),
+             np.where(ok, d, np.float32(np.inf))[..., None]], axis=-1)
+        return out
+
+    got = problem.get_planes()
+    solve_ok = np.array_equal(
+        got, ref_planes(points, problem.get_knearests_original()))
+    query_ok = np.array_equal(planes_q, ref_planes(queries, ids_q))
+    shared = np.array_equal(
+        got, bisector_planes(points, points,
+                             problem.get_knearests_original()))
+    ok = solve_ok and query_ok and shared
+    rc |= 0 if ok else 1
+    print(json.dumps({"check": "plane-feed-bit-identity", "ok": ok,
+                      "solve_ok": bool(solve_ok),
+                      "query_ok": bool(query_ok)}), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    # run the canonical module instance (same -m hygiene as
+    # runtime.dispatch.__main__): counters and caches must be the ones the
+    # engine increments
+    from cuda_knearests_tpu.cluster.__main__ import _smoke as _canonical
+
+    sys.exit(_canonical())

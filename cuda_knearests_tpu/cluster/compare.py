@@ -1,0 +1,129 @@
+"""Tie-aware differential comparison for FoF labels vs the union-find oracle.
+
+Label equality is the WRONG check at the linking radius: the engine scores
+pairs in f32 (with whatever fusion the backend picked) while the oracle
+scores in f64, so a pair whose true distance sits within the f32 rounding
+band of ``b`` may legally link in one and not the other -- and ONE such
+edge can merge two components, relabeling arbitrarily many points.  What
+is exactly checkable:
+
+  1. well-formedness: labels are (n,) integers in [0, n), sizes (when
+     given) count label multiplicity exactly;
+  2. canonicalization: every cluster's label IS its minimum member id;
+  3. mandatory links: pairs provably inside the radius (f64 distance below
+     the band) must share an engine label -- every oracle *mandatory*
+     component carries one engine label;
+  4. allowed links: the engine must not link beyond pairs possibly inside
+     the radius -- every engine component lies inside one oracle *allowed*
+     component.
+
+3 + 4 say the engine partition sits between the oracle's bracketing
+partitions in the refinement lattice; with no pairs in the band the two
+brackets coincide and the check degenerates to exact partition equality.
+Together with 2 this pins the full FoF contract without ever comparing
+labels across the f32/f64 boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..fuzz.compare import Mismatch
+
+
+def fof_band(b: float) -> float:
+    """Absolute squared-distance slack bracketing the engine's f32 edge
+    predicate around ``b^2``.
+
+    Two error sources: the engine thresholds at ``f32(b)^2`` computed in
+    f32 (relative ~2^-23 of b^2, doubled), and the f32 diff-square-sum
+    distance itself (for pairs near the radius the per-axis subtraction is
+    exact or near-exact -- Sterbenz for nearby coordinates -- leaving the
+    squaring/summation rounding, relative ~2^-21 of d2, plus the
+    subtraction rounding cross term ~ulp(coord) * b).  A 1e-4 relative
+    band plus a coordinate-ulp cross term covers both with two orders of
+    magnitude to spare while staying far below any real inter-point
+    spacing gap."""
+    b2 = float(np.float64(b) ** 2)  # kntpu-ok: wide-dtype -- host threshold arithmetic, never staged
+    return 1e-4 * b2 + 4e-3 * float(b) + 1e-9
+
+
+def _groups_share_one_value(group_of: np.ndarray, value_of: np.ndarray
+                            ) -> Optional[int]:
+    """First index whose ``value_of`` differs from its group's first
+    member's, or None when every group carries one value."""
+    order = np.argsort(group_of, kind="stable")
+    g = group_of[order]
+    v = value_of[order]
+    starts = np.concatenate([[True], g[1:] != g[:-1]])
+    first_of_group = np.maximum.accumulate(
+        np.where(starts, np.arange(g.size), 0))
+    bad = v != v[first_of_group]
+    if bad.any():
+        return int(order[np.nonzero(bad)[0][0]])
+    return None
+
+
+def check_fof_result(points: np.ndarray, b: float, labels: np.ndarray,
+                     sizes: Optional[np.ndarray] = None,
+                     band: Optional[float] = None) -> Optional[Mismatch]:
+    """First tie-aware disagreement between an engine FoF labeling and the
+    CPU union-find oracle, or None when the labeling is exact.
+
+    ``band`` overrides the default f32 rounding band (squared-distance
+    units); the oracle runs once with it to produce the bracketing
+    mandatory/allowed partitions (oracle.fof_oracle)."""
+    from ..oracle import fof_oracle
+
+    points = np.asarray(points, np.float32)
+    n = points.shape[0]
+    labels = np.asarray(labels)
+    if labels.shape != (n,) or not np.issubdtype(labels.dtype, np.integer):
+        return Mismatch(-1, "shape",
+                        f"labels {labels.shape} {labels.dtype}, want ({n},) "
+                        f"integer")
+    if n == 0:
+        return None
+    if labels.min() < 0 or labels.max() >= n:
+        r = int(np.nonzero((labels < 0) | (labels >= n))[0][0])
+        return Mismatch(r, "label-range",
+                        f"label {int(labels[r])} outside [0, {n})")
+    # canonicalization: each cluster's label is its minimum member id
+    mins = np.full(n, n, dtype=np.int64)  # kntpu-ok: wide-dtype -- host index arithmetic, never staged
+    np.minimum.at(mins, labels, np.arange(n))
+    uniq = np.unique(labels)
+    bad = uniq[mins[uniq] != uniq]
+    if bad.size:
+        lab = int(bad[0])
+        return Mismatch(lab, "not-canonical",
+                        f"cluster labeled {lab} but its minimum member id "
+                        f"is {int(mins[lab])}")
+    if sizes is not None:
+        sizes = np.asarray(sizes)
+        counts = np.bincount(labels, minlength=n)
+        if sizes.shape != (n,) or (sizes != counts[labels]).any():
+            r = 0 if sizes.shape != (n,) else \
+                int(np.nonzero(sizes != counts[labels])[0][0])
+            return Mismatch(r, "size-mismatch",
+                            f"sizes disagree with label multiplicity at "
+                            f"row {r}")
+    band = fof_band(b) if band is None else float(band)
+    mand, allowed = fof_oracle(points, b, band=band)
+    # (3) every mandatory component carries exactly one engine label
+    r = _groups_share_one_value(mand, labels)
+    if r is not None:
+        return Mismatch(r, "mandatory-split",
+                        f"point {r} (engine label {int(labels[r])}) is "
+                        f"mandatorily linked to oracle component "
+                        f"{int(mand[r])} whose members carry another "
+                        f"engine label")
+    # (4) every engine component lies inside one allowed oracle component
+    r = _groups_share_one_value(labels, allowed)
+    if r is not None:
+        return Mismatch(r, "forbidden-merge",
+                        f"engine cluster {int(labels[r])} spans distinct "
+                        f"allowed-oracle components (a link beyond the "
+                        f"radius band merged them)")
+    return None

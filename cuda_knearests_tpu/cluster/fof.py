@@ -1,0 +1,282 @@
+"""Friends-of-friends clustering on the grid-hash core.
+
+FoF links every pair of points within a fixed *linking length* ``b`` and
+returns the connected components of that graph -- the third query family
+on the engine's one spatial index (ROADMAP item 4; JZ-Tree, arXiv
+2604.05885, shows neighbour search and FoF share one traversal core).
+
+Structure (all device work, no data-dependent host loops):
+
+1. **Grid reuse.**  The cloud is hashed with the standard CSR build
+   (``ops/gridhash.build_grid``) at a dim chosen so the cell width stays
+   >= ``b`` (:func:`fof_grid_dim`): then every link is contained in the
+   27-cell neighborhood, which is exactly ``ops/rings.ring_schedule(2)``
+   -- the same ring schedule the kNN traversal walks, truncated at ring 1.
+2. **Pair enumeration on the fly.**  Each propagation round walks the 27
+   neighbor-cell segments per point (scalar CSR gathers, candidates
+   re-scored in f32 'diff' arithmetic like every other route) instead of
+   materializing an (n, pairs) edge table -- peak memory is O(n * m) for
+   m = the densest cell, not O(edges).
+3. **Iterative union-find.**  Labels start as each point's own sorted
+   index; every round takes the min label over the closed linked
+   neighborhood (hooking) and then pointer-jumps twice (``L <- L[L]``,
+   path doubling).  Labels are monotone non-increasing and always index a
+   member of their own component, so the fixed point is the component's
+   minimum sorted index, reached in O(log n) rounds (each round at least
+   quadruples the distance a minimum has propagated along a chain).
+4. **Counted convergence.**  The per-round ``changed`` flag is the ONLY
+   mid-solve host traffic, read through ``runtime.dispatch.fetch`` -- one
+   counted sync per round, plus one final batched fetch of labels + sizes:
+   a whole FoF solve costs ``rounds + 1`` host round trips, stamped on the
+   result (and on ``bench.py`` FoF rows) as ``host_syncs``.
+5. **Canonical labels.**  Each component's label is the MINIMUM ORIGINAL
+   point id among its members (translated through ``grid.permutation`` in
+   the same jitted finalize that scatters results back to input order), so
+   labels are stable under any storage reordering and directly comparable
+   with the CPU union-find oracle (``oracle.fof_oracle``).
+
+Per-round launches ride the AOT :data:`~..runtime.dispatch.EXEC_CACHE`
+keyed by the standard signature census, with the densest-cell occupancy
+padded to a power of two -- so a serving daemon answering repeated ``fof``
+requests (serve/daemon.py) dispatches already-compiled programs in steady
+state.  See DESIGN.md section 14.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import DEFAULT_CELL_DENSITY, DOMAIN_SIZE, grid_dim_for
+from ..ops.gridhash import build_grid, cell_coords_host
+from ..ops.rings import ring_schedule
+from ..runtime import dispatch as _dispatch
+from ..utils.memory import LaunchBudgetError
+
+# Convergence guard: pointer jumping converges in O(log n) rounds (module
+# docstring); 64 rounds covers any n the i32 index space can address many
+# times over, so hitting the cap indicates a bug, not a big input.
+MAX_ROUNDS = 64
+
+# Candidate-matrix preflight cap: one round materializes O(n * m27) f32/i32
+# temporaries (m27 = padded densest-cell occupancy x the unrolled offset
+# sweep).  Refusing beyond this bound is the FoF analog of the kNN HBM
+# preflight -- a degenerate cloud (everything coincident at scale) fails
+# fast with a typed oom-kind error instead of wedging the host allocator.
+MAX_PAIR_SLOTS = 1 << 28
+
+
+@dataclasses.dataclass(frozen=True)
+class FofResult:
+    """One FoF solve's output, host-resident, rows in INPUT order.
+
+    Attributes:
+      labels: (n,) i32 canonical cluster label per point = the minimum
+        original point id in its component (two points share a cluster iff
+        they share a label; each label names one of its own members).
+      sizes: (n,) i32 component size per point (``sizes[i]`` = how many
+        points share ``labels[i]``).
+      n_clusters: number of distinct components.
+      rounds: propagation rounds to convergence (the iteration counter
+        bench rows stamp as ``fof_rounds``).
+      host_syncs: blocking host round trips the solve consumed (the
+        counted convergence reads + the one final batched fetch).
+      linking_length: the b this solve linked at.
+      dim: grid cells per axis actually used (cell width >= b).
+      cell_max: densest-cell occupancy (the m the round kernel padded).
+    """
+
+    labels: np.ndarray
+    sizes: np.ndarray
+    n_clusters: int
+    rounds: int
+    host_syncs: int
+    linking_length: float
+    dim: int
+    cell_max: int
+
+    def cluster_sizes(self) -> "tuple[np.ndarray, np.ndarray]":
+        """(labels, sizes) of each distinct cluster, labels ascending."""
+        if self.labels.size == 0:
+            return (np.empty((0,), np.int32), np.empty((0,), np.int64))  # kntpu-ok: wide-dtype -- np.unique's native count dtype, host-only
+        lab, cnt = np.unique(self.labels, return_counts=True)
+        return lab.astype(np.int32), cnt
+
+
+def fof_grid_dim(n: int, b: float, domain: float = DOMAIN_SIZE,
+                 density: float = DEFAULT_CELL_DENSITY) -> int:
+    """Cells per axis for a FoF solve: the standard density-targeted dim,
+    capped so the cell width stays >= ``b`` -- the invariant that makes
+    the 27-cell neighborhood (ring schedule rings 0..1) sufficient for
+    pair enumeration.  A linking length wider than the domain simply
+    collapses to one cell per axis."""
+    dim = grid_dim_for(n, density)
+    if b > 0.0:
+        dim = max(1, min(dim, int(domain / b)))
+    while dim > 1 and domain / dim < b:  # float-division guard
+        dim -= 1
+    return dim
+
+
+def _round_pow2(x: int, minimum: int = 8) -> int:
+    return max(minimum, 1 << max(0, int(x) - 1).bit_length())
+
+
+@functools.partial(jax.jit, static_argnames=("m",))
+def _fof_round(labels, px, py, pz, starts, counts, nbr_cells, nbr_ok, b2,
+               m: int):
+    """One propagation round over the 27-cell neighborhoods.
+
+    labels (n,) i32 sorted-index labels; px/py/pz (n,) f32 sorted
+    coordinates; starts/counts (ncells,) i32 CSR; nbr_cells/nbr_ok (n, 27)
+    the per-point neighbor-cell ids and in-grid mask (host-precomputed from
+    the bit-identical coordinate twin); b2 0-d f32 = linking length
+    squared.  Returns (new labels, changed flag)."""
+    n = labels.shape[0]
+    acc = labels
+    slot = jnp.arange(m, dtype=jnp.int32)
+    for o in range(27):  # static unroll of the ring schedule (rings 0..1)
+        cid = nbr_cells[:, o]
+        ok_c = nbr_ok[:, o]
+        st = jnp.where(ok_c, starts[cid], 0)
+        ct = jnp.where(ok_c, counts[cid], 0)
+        idx = st[:, None] + slot[None, :]
+        valid = slot[None, :] < ct[:, None]
+        safe = jnp.where(valid, idx, 0)
+        d2 = ((px[:, None] - px[safe]) ** 2
+              + (py[:, None] - py[safe]) ** 2
+              + (pz[:, None] - pz[safe]) ** 2)
+        link = valid & (d2 <= b2)
+        cand = jnp.where(link, labels[safe], n)
+        acc = jnp.minimum(acc, jnp.min(cand, axis=1))
+    # pointer jumping (path doubling): labels always satisfy L[i] <= i, so
+    # the label graph is a forest and two hops at least quadruple how far
+    # a component minimum has propagated per round
+    acc = acc[acc]
+    acc = acc[acc]
+    return acc, jnp.any(acc != labels)
+
+
+_I32_MAX = np.iinfo(np.int32).max  # trace-static (hoisted per lint policy)
+
+
+@jax.jit
+def _fof_finalize(labels, perm):
+    """Sorted-index root labels -> canonical min-ORIGINAL-id labels plus
+    per-point component sizes, scattered back to input order."""
+    n = labels.shape[0]
+    big = jnp.full((n,), _I32_MAX, jnp.int32)
+    canon = big.at[labels].min(perm)          # root -> min original id
+    root_sizes = jnp.zeros((n,), jnp.int32).at[labels].add(1)
+    out_l = jnp.zeros((n,), jnp.int32).at[perm].set(canon[labels])
+    out_s = jnp.zeros((n,), jnp.int32).at[perm].set(root_sizes[labels])
+    return out_l, out_s
+
+
+def _launch_round(args, m: int):
+    """One round through the AOT executable cache (the launch_brute idiom:
+    same signature census as the recompile-key checker, plain jitted
+    fallback when the backend cannot AOT-lower)."""
+    key = (("cluster.fof._fof_round",) + _dispatch.signature(args, m))
+    exe = _dispatch.EXEC_CACHE.get_or_build(
+        key, lambda: _fof_round.lower(*args, m=m).compile())
+    if exe is not None:
+        return exe(*args)
+    return _fof_round(*args, m=m)
+
+
+def _neighbor_cells_host(points: np.ndarray, order: np.ndarray, dim: int,
+                         domain: float):
+    """(n, 27) neighbor-cell ids + in-grid mask per SORTED row, pure host
+    numpy (cell_coords_host is the bit-identical twin of the device
+    mapping, so this costs zero device round trips)."""
+    coords = cell_coords_host(points, dim, domain)[order]  # sorted order
+    offs = ring_schedule(2).offsets  # rings 0..1 == the 27-cell block
+    nc = coords[:, None, :] + offs[None, :, :]             # (n, 27, 3)
+    ok = ((nc >= 0) & (nc < dim)).all(axis=2)
+    ncc = np.clip(nc, 0, dim - 1)
+    cids = ncc[:, :, 0] + dim * (ncc[:, :, 1] + dim * ncc[:, :, 2])
+    return cids.astype(np.int32), ok
+
+
+def fof_labels(points, linking_length: float, *,
+               density: float = DEFAULT_CELL_DENSITY,
+               domain: float = DOMAIN_SIZE,
+               validate: bool = True,
+               max_rounds: int = MAX_ROUNDS) -> FofResult:
+    """Friends-of-friends connected components of ``points`` at linking
+    length ``linking_length``.
+
+    Input goes through the standard front door (``io.validate_or_raise``
+    for the points contract, ``io.validate_linking_length`` for ``b``);
+    n = 0 and n = 1 are legal degraded modes (empty / singleton labeling).
+    Returns a :class:`FofResult` with canonical min-original-id labels.
+
+    Two points at squared distance exactly ``b^2`` in the engine's f32
+    arithmetic ARE linked (``<=``); the differential check treats pairs
+    within the f32 rounding band of the radius as legally ambiguous
+    (cluster/compare.py).
+    """
+    from ..io import validate_linking_length, validate_or_raise
+
+    b = validate_linking_length(linking_length)
+    points = (validate_or_raise(points, domain=domain) if validate
+              else np.ascontiguousarray(points, np.float32))
+    n = points.shape[0]
+    s0 = _dispatch.stats()
+    if n == 0:
+        return FofResult(labels=np.empty((0,), np.int32),
+                         sizes=np.empty((0,), np.int32), n_clusters=0,
+                         rounds=0, host_syncs=0, linking_length=b,
+                         dim=1, cell_max=0)
+    dim = fof_grid_dim(n, b, domain, density)
+    grid = build_grid(points, dim=dim, domain=domain)
+    # host twins: the stable argsort over the bit-identical host cell ids
+    # reproduces the device build's sorted order with no readback
+    cids = cell_coords_host(points, dim, domain)
+    cids = cids[:, 0] + dim * (cids[:, 1] + dim * cids[:, 2])
+    order = np.argsort(cids, kind="stable").astype(np.int32)
+    cell_max = int(np.bincount(cids, minlength=dim ** 3).max())
+    m = _round_pow2(cell_max, minimum=8)
+    if n * m * 27 > MAX_PAIR_SLOTS:
+        raise LaunchBudgetError(
+            f"FoF round would materialize {n}x{m} candidate slots per "
+            f"offset (densest cell holds {cell_max} of {n} points at "
+            f"dim={dim}); beyond the {MAX_PAIR_SLOTS} pair-slot budget",
+            requested=n * m * 27 * 4, budget=MAX_PAIR_SLOTS * 4,
+            site="cluster.fof")
+    nbr_cells, nbr_ok = _neighbor_cells_host(points, order, dim, domain)
+    b2 = np.float32(b) * np.float32(b)
+    args = (
+        _dispatch.stage(np.arange(n, dtype=np.int32)),
+        grid.points[:, 0], grid.points[:, 1], grid.points[:, 2],
+        grid.cell_starts, grid.cell_counts,
+        _dispatch.stage(nbr_cells), _dispatch.stage(nbr_ok),
+        _dispatch.stage(np.float32(b2)),
+    )
+    labels = args[0]
+    rounds = 0
+    changed = n > 1
+    while changed and rounds < max_rounds:
+        labels, chg = _launch_round((labels,) + args[1:], m)
+        rounds += 1
+        # the counted convergence read: ONE flag per round through the
+        # sanctioned batched-fetch primitive (DESIGN.md sections 12/14)
+        changed = bool(_dispatch.fetch(chg))
+    if changed:
+        raise AssertionError(
+            f"FoF propagation failed to converge in {max_rounds} rounds "
+            f"(n={n}); pointer jumping guarantees O(log n) -- this is a "
+            f"bug, not a large input")
+    out_l, out_s = _dispatch.fetch(*_fof_finalize(labels, grid.permutation))
+    out_l = np.asarray(out_l)
+    out_s = np.asarray(out_s)
+    syncs = _dispatch.stats().host_syncs - s0.host_syncs
+    return FofResult(labels=out_l, sizes=out_s,
+                     n_clusters=int(np.unique(out_l).size),
+                     rounds=rounds, host_syncs=syncs, linking_length=b,
+                     dim=dim, cell_max=cell_max)

@@ -1,0 +1,68 @@
+"""The Voronoi/power-diagram plane feed: per-neighbor bisector planes.
+
+The reference names its k ``DEFAULT_NB_PLANES`` (/root/reference/params.h:4)
+because its neighbor tables exist to feed a Voronoi-cell clipping pipeline:
+each neighbor q of a site p contributes the half-space of points closer to
+p than to q.  This module emits that representation directly from the kNN
+result epilogue, so a clipping consumer gets planes WITH the neighbor rows
+instead of re-deriving them in a second pass:
+
+    n = p_neighbor - p_site                 (the plane normal)
+    d = (|p_neighbor|^2 - |p_site|^2) / 2   (the offset)
+
+and the site's cell is the intersection of half-spaces ``n . x <= d``
+(x closer to the site than to the neighbor  <=>  2 x . (p - q) <= |p|^2 -
+|q|^2, with p the neighbor and q the site).
+
+Precision contract (the reason this epilogue is HOST-side f64, not another
+device pass): the offset ``d`` subtracts two squared norms of magnitude up
+to ``3 * domain^2`` that agree in nearly every bit for near neighbors --
+exactly the pairs kNN returns -- so f32 arithmetic loses the plane to
+catastrophic cancellation, and the engine's own static gate (kntpu-check
+trace-dtype) forbids f64 inside device programs.  The feed therefore runs
+in f64 on the already-fetched host rows (zero extra device syncs -- every
+input is host-resident after the route's one batched fetch) and rounds to
+f32 once.  The normal ``n`` is exact either way: the f64 difference of two
+f32 values is exact, so its f32 rounding equals the f32 subtraction.
+tests/test_cluster.py pins the emitted planes bit-identical to an
+independent f64 recompute from the returned neighbor ids on all four solve
+routes (DESIGN.md section 14).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def bisector_planes(sites: np.ndarray, points: np.ndarray,
+                    neighbor_ids: np.ndarray) -> np.ndarray:
+    """(m, k, 4) f32 plane feed ``[nx, ny, nz, d]`` for each (site,
+    neighbor) pair of a kNN result.
+
+    ``sites`` (m, 3): the query coordinates (for the all-points self-solve,
+    the points themselves in original order).  ``points`` (n, 3): the
+    stored cloud in ORIGINAL indexing.  ``neighbor_ids`` (m, k): the
+    result's neighbor table in original indexing, ``-1`` beyond the
+    available neighbors.
+
+    Invalid slots (id < 0) emit the trivially-true half-space ``n = 0,
+    d = +inf`` -- a missing neighbor constrains nothing, so a clipping
+    consumer can intersect all k rows unconditionally.
+    """
+    sites = np.asarray(sites, np.float32)
+    ids = np.asarray(neighbor_ids)
+    points = np.asarray(points, np.float32)
+    m, k = ids.shape
+    out = np.zeros((m, k, 4), np.float32)
+    out[:, :, 3] = np.inf
+    if m == 0 or k == 0 or points.shape[0] == 0:
+        return out
+    valid = ids >= 0
+    safe = np.clip(ids, 0, points.shape[0] - 1)
+    p = points[safe].astype(np.float64)      # kntpu-ok: wide-dtype -- the plane offset cancels catastrophically in f32 (module docstring); host-only, rounded to f32 once, never staged
+    q = sites.astype(np.float64)[:, None, :]  # kntpu-ok: wide-dtype -- same f64 plane-feed contract as above
+    normal = (p - q).astype(np.float32)
+    d = (((p * p).sum(-1) - (q * q).sum(-1)) / 2.0).astype(np.float32)
+    out[:, :, :3] = np.where(valid[:, :, None], normal, np.float32(0.0))
+    out[:, :, 3] = np.where(valid, d, np.float32(np.inf))
+    return out

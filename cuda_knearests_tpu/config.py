@@ -184,6 +184,14 @@ class KnnConfig:
     kernel: str = "kpass"  # solvers read effective_kernel(), not this field
     epilogue: str = "auto"  # solvers read resolved_epilogue(), not this field
     query_chunk: Optional[int] = None  # solvers read resolved_query_chunk()
+    # Voronoi plane feed (cluster/planes.py, DESIGN.md section 14): when
+    # True, solve() emits the per-neighbor bisector-plane representation
+    # (n, d) = (p - q, (|p|^2 - |q|^2)/2) as result.planes -- the clipping
+    # input the reference's DEFAULT_NB_PLANES naming promises (params.h:4)
+    # -- with no second kNN pass and no extra host sync (the f64 host
+    # epilogue runs on the already-fetched rows; f32 would lose the offset
+    # to catastrophic cancellation and device traces forbid f64).
+    plane_feed: bool = False
 
     def resolved_ring_radius(self) -> int:
         if self.ring_radius is not None:

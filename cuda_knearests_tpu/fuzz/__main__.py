@@ -40,6 +40,14 @@ def main(argv=None) -> int:
                          "the serving delta overlay vs the rebuild-from-"
                          "scratch oracle; failures minimized and banked "
                          "like point cases -- see fuzz/mutation.py)")
+    ap.add_argument("--fof", action="store_true",
+                    help="run the FoF campaign instead: --cases clustering "
+                         "cases (the same adversarial zoo + seeded linking "
+                         "lengths, incl. exact-tie radii) through "
+                         "cluster.fof vs the CPU union-find oracle with "
+                         "the tie-aware partition check; failures "
+                         "minimized and banked as *-fof.npz -- see "
+                         "fuzz/fof.py")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--routes", default=None,
                     help="comma-separated subset of "
@@ -79,6 +87,34 @@ def main(argv=None) -> int:
         os.environ["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count="
             f"{max(1, args.devices)}").strip()
+
+    if args.fof and args.mutations is not None:
+        ap.error("--fof and --mutations are mutually exclusive campaigns")
+    if args.fof and args.routes:
+        ap.error("--routes applies to the point-case campaign only; the "
+                 "FoF campaign has a single (grid) route")
+    if args.fof and args.isolation != "auto":
+        ap.error("--isolation applies to the point-case campaign only; "
+                 "FoF cases run in-process")
+
+    if args.fof:
+        from .fof import run_fof_campaign
+
+        kwargs = {} if args.bank_dir is None else {"bank_dir": args.bank_dir}
+        manifest = run_fof_campaign(
+            n_cases=args.cases, seed=args.seed, budget_s=budget,
+            minimize=not args.no_minimize, **kwargs)
+        if args.manifest:
+            os.makedirs(os.path.dirname(os.path.abspath(args.manifest)),
+                        exist_ok=True)
+            with open(args.manifest, "w") as f:
+                json.dump(manifest, f, indent=2)
+        print(json.dumps(manifest))
+        if not manifest["ok"]:
+            print(f"FOF FUZZ FAILED: {len(manifest['failures'])} "
+                  f"failure(s); minimized repros banked", file=sys.stderr)
+            return 1
+        return 0
 
     if args.mutations is not None:
         from .mutation import run_mutation_campaign

@@ -21,9 +21,9 @@ import numpy as np
 
 from .config import DOMAIN_SIZE
 from .utils.memory import (CorruptInputError, DegenerateExtentError,
-                           DomainBoundsError, InvalidKError,
-                           InvalidRequestError, InvalidShapeError,
-                           NonFiniteInputError)
+                           DomainBoundsError, InvalidConfigError,
+                           InvalidKError, InvalidRequestError,
+                           InvalidShapeError, NonFiniteInputError)
 
 
 def load_xyz(path: str) -> np.ndarray:
@@ -148,8 +148,37 @@ def validate_or_raise(points: np.ndarray, k: Optional[int] = None,
     return np.ascontiguousarray(points)
 
 
+def validate_linking_length(b) -> float:
+    """The linking-length half of the FoF front door (cluster/fof.py, the
+    serving daemon's ``fof`` requests, and the fuzz --fof campaign all
+    funnel through here): ``b`` must be a finite positive real.
+    Deliberately domain-independent: a ``b`` wider than the domain
+    diagonal is legal degraded mode -- every point links into one
+    cluster -- not an error.  Returns float(b)."""
+    if isinstance(b, bool) or isinstance(b, (str, bytes)):
+        # bool is an int subclass and float('12') would "work": neither is
+        # ever an intended linking length
+        raise InvalidConfigError(
+            f"linking length must be a positive real number, got {b!r} "
+            f"(FoF input contract, DESIGN.md section 14)")
+    try:
+        out = float(b)
+    except (TypeError, ValueError) as e:
+        raise InvalidConfigError(
+            f"linking length must be a positive real number, got {b!r} "
+            f"(FoF input contract)") from e
+    if not np.isfinite(out) or out <= 0.0:
+        raise InvalidConfigError(
+            f"linking length must be finite and > 0, got {out!r} (FoF "
+            f"input contract; note b > the domain diagonal is legal: "
+            f"everything joins one cluster)")
+    return out
+
+
 # Legal request-stream operation kinds (the serving daemon's wire surface).
-REQUEST_KINDS = ("query", "insert", "delete")
+# 'fof' is the clustering query family (DESIGN.md section 14): payload =
+# the linking length, answered against the CURRENT mutated cloud.
+REQUEST_KINDS = ("query", "insert", "delete", "fof")
 
 
 def validate_request(kind: str, payload, *, k=None, k_max: Optional[int] = None,
@@ -173,15 +202,21 @@ def validate_request(kind: str, payload, *, k=None, k_max: Optional[int] = None,
         need normalization are the CALLER's job, exactly as at prepare).
       * ``('delete', (m,) integer ids)`` -- ids must index the CURRENT
         mutated cloud: integer dtype, unique, within [0, n_current).
+      * ``('fof', linking_length)`` -- the clustering query family: the
+        payload is one finite positive real (validate_linking_length);
+        labels are computed over the current mutated cloud.
 
     Raises InvalidRequestError (unknown kind / oversized / bad ids),
-    InvalidKError, or the points-contract taxonomy.  Returns the validated
-    payload array (f32 (m, 3) for query/insert, i64->i32-safe (m,) int
-    array for delete)."""
+    InvalidKError, InvalidConfigError (bad linking length), or the
+    points-contract taxonomy.  Returns the validated payload (f32 (m, 3)
+    for query/insert, i64->i32-safe (m,) int array for delete, float for
+    fof)."""
     if kind not in REQUEST_KINDS:
         raise InvalidRequestError(
             f"unknown request kind {kind!r}: expected one of "
             f"{REQUEST_KINDS} (request contract)")
+    if kind == "fof":
+        return validate_linking_length(payload)
     if kind in ("query", "insert"):
         what = "request queries" if kind == "query" else "request inserts"
         out = validate_or_raise(payload, k=k if kind == "query" else None,

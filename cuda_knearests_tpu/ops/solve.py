@@ -84,6 +84,13 @@ class KnnResult:
     # count (rows the exact fallback had to resolve; certified is all-True
     # afterwards).  None = a raw solver result whose caller computes it.
     uncert_count: np.ndarray | jax.Array | None = None
+    # Optional Voronoi plane feed (cluster/planes.py, DESIGN.md section
+    # 14): (n, k, 4) f32 bisector planes [(nx, ny, nz), d] per neighbor,
+    # rows in ORIGINAL point order (matching get_knearests_original), pad
+    # slots the trivially-true half-space (n=0, d=inf).  Populated by
+    # api._finalize when config.plane_feed is on (or lazily by
+    # KnnProblem.get_planes()); None otherwise.
+    planes: np.ndarray | None = None
 
 
 def _boxes_grid(n_sc: int) -> np.ndarray:

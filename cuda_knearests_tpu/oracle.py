@@ -158,3 +158,104 @@ class KdTreeOracle:
             out_ids[s:e, :kk] = np.where(good, ids, -1)
             out_d2[s:e, :kk] = np.where(good, d2s, np.inf)
         return out_ids, out_d2
+
+
+# -- friends-of-friends oracle (cluster/, DESIGN.md section 14) ---------------
+#
+# The CPU reference the FoF differential tests and the fuzz --fof campaign
+# compare the grid engine against: a classic path-compressed union-find over
+# exact f64 fixed-radius pairs.  Because the engine scores pairs in f32, a
+# pair whose true distance sits within the f32 rounding band of the linking
+# radius may legally link either way -- so the oracle exposes TWO partitions
+# (mandatory = pairs provably inside the radius, allowed = pairs possibly
+# inside), and the tie-aware check (cluster/compare.py) requires the engine
+# partition to lie between them in the refinement lattice.
+
+
+class UnionFind:
+    """Array union-find with path compression + union by size (host)."""
+
+    def __init__(self, n: int):
+        self.parent = np.arange(n, dtype=np.int64)  # kntpu-ok: wide-dtype -- host index arithmetic, never staged
+        self.size = np.ones(n, dtype=np.int64)      # kntpu-ok: wide-dtype -- host index arithmetic, never staged
+
+    def find(self, i: int) -> int:
+        p = self.parent
+        root = i
+        while p[root] != root:
+            root = p[root]
+        while p[i] != root:  # path compression
+            p[i], i = root, p[i]
+        return int(root)
+
+    def union(self, i: int, j: int) -> None:
+        ri, rj = self.find(i), self.find(j)
+        if ri == rj:
+            return
+        if self.size[ri] < self.size[rj]:
+            ri, rj = rj, ri
+        self.parent[rj] = ri
+        self.size[ri] += self.size[rj]
+
+    def canonical_labels(self) -> np.ndarray:
+        """(n,) i32 labels: every member carries the MINIMUM member id of
+        its component (the engine's canonicalization contract)."""
+        n = self.parent.shape[0]
+        roots = np.fromiter((self.find(i) for i in range(n)),
+                            dtype=np.int64, count=n)  # kntpu-ok: wide-dtype -- host index arithmetic, never staged
+        mins = np.full(n, n, dtype=np.int64)          # kntpu-ok: wide-dtype -- host index arithmetic, never staged
+        np.minimum.at(mins, roots, np.arange(n))
+        return mins[roots].astype(np.int32)
+
+
+def _fof_thresholds(b: float, band: float):
+    """(lo, hi) squared-distance thresholds bracketing the engine's f32
+    edge predicate ``d2_f32 <= f32(b)^2``: below ``lo`` a pair MUST link,
+    above ``hi`` it MUST NOT, in between it may do either.  ``band`` is
+    the absolute slack in squared-distance units (callers derive it from
+    the f32 rounding model; 0.0 = the exact radius)."""
+    b2 = float(np.float64(b) ** 2)  # kntpu-ok: wide-dtype -- exact host threshold arithmetic, never staged
+    return max(b2 - band, 0.0), b2 + band
+
+
+def _pairs_within(points: np.ndarray, hi: float, chunk: int = 1024):
+    """All unique pairs (i < j) with f64 squared distance <= ``hi``.
+    Returns (pairs (E, 2) i64, d2 (E,) f64).  Chunked O(n^2) host brute
+    force -- the oracle is exact, not fast (fuzz cases are small)."""
+    pts = np.asarray(points, np.float64)  # kntpu-ok: wide-dtype -- exact oracle distances, host-only, never staged
+    n = pts.shape[0]
+    out_p, out_d = [], []
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        d2 = ((pts[s:e, None, :] - pts[None, :, :]) ** 2).sum(-1)
+        ii, jj = np.nonzero(d2 <= hi)
+        keep = (ii + s) < jj  # unique pairs, no self-pairs
+        out_p.append(np.stack([ii[keep] + s, jj[keep]], axis=1))
+        out_d.append(d2[ii[keep], jj[keep]])
+    if not out_p:
+        return (np.empty((0, 2), np.int64), np.empty((0,), np.float64))  # kntpu-ok: wide-dtype -- exact oracle distances, host-only, never staged
+    return np.concatenate(out_p), np.concatenate(out_d)
+
+
+def fof_oracle(points: np.ndarray, b: float, band: float = 0.0):
+    """(mandatory_labels, allowed_labels): canonical min-id FoF labelings
+    under the two bracketing edge sets (see _fof_thresholds).  With
+    ``band=0`` the two coincide: the exact-f64 FoF partition at radius b.
+
+    ``allowed`` unions EVERY pair the f32 engine could have linked, so any
+    engine component must lie inside one allowed component; ``mandatory``
+    unions only pairs the engine must have linked, so every mandatory
+    component must carry one engine label.  cluster/compare.py checks both
+    inclusions plus the canonicalization contract."""
+    points = np.asarray(points, np.float32)
+    n = points.shape[0]
+    uf_m, uf_a = UnionFind(n), UnionFind(n)
+    if n == 0:
+        return (np.empty((0,), np.int32), np.empty((0,), np.int32))
+    lo, hi = _fof_thresholds(b, band)
+    pairs, d2 = _pairs_within(points, hi)
+    for (i, j), d in zip(pairs, d2):
+        uf_a.union(int(i), int(j))
+        if d <= lo:
+            uf_m.union(int(i), int(j))
+    return uf_m.canonical_labels(), uf_a.canonical_labels()

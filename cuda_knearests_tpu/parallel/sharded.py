@@ -780,7 +780,21 @@ class ShardedKnnProblem:
         self._device_out_cache = outs
         return outs
 
-    def query(self, queries, k: Optional[int] = None
+    def get_planes(self, solved=None, device_out=None) -> np.ndarray:
+        """(n, k, 4) f32 Voronoi bisector-plane feed of the sharded
+        all-points solve -- the multi-chip twin of
+        api.KnnProblem.get_planes() (cluster/planes.py has the [n, d]
+        contract and the f64 precision rationale).  Pass ``solved`` (a
+        previous ``solve()`` tuple) or ``device_out`` to reuse results;
+        single-controller, like solve()."""
+        from ..cluster.planes import bisector_planes
+
+        neighbors = (solved[0] if solved is not None
+                     else self.solve(device_out=device_out)[0])
+        return bisector_planes(self._points_host, self._points_host,
+                               neighbors)
+
+    def query(self, queries, k: Optional[int] = None, planes: bool = False
               ) -> Tuple[np.ndarray, np.ndarray]:
         """Exact kNN of arbitrary query coordinates against the sharded set.
 
@@ -792,7 +806,9 @@ class ShardedKnnProblem:
         exactly against the host oracle.  Single-controller, like solve().
 
         Returns ((m, k) ids in ORIGINAL indexing, ascending; (m, k) squared
-        distances), rows in query order.
+        distances), rows in query order -- plus, with ``planes=True``, the
+        (m, k, 4) Voronoi bisector-plane feed (cluster/planes.py), same
+        contract as api.KnnProblem.query(planes=True).
         """
         from ..ops.adaptive import launch_class_query
 
@@ -814,7 +830,11 @@ class ShardedKnnProblem:
         queries = np.ascontiguousarray(queries, np.float32)
         m = queries.shape[0]
         if m == 0:
-            return (np.empty((0, k), np.int32), np.empty((0, k), np.float32))
+            empty = (np.empty((0, k), np.int32),
+                     np.empty((0, k), np.float32))
+            if planes:
+                return empty + (np.zeros((0, k, 4), np.float32),)
+            return empty
         dim, s = meta.dim, cfg.supercell
         # i64 coords: the per-chip scidx linearization below multiplies by
         # n_sc_xy^2 (same wrap-before-cast headroom as _measured_halo_depth)
@@ -872,6 +892,11 @@ class ShardedKnnProblem:
             b_i, b_d = self._oracle().knn(queries[bad], k)  # no self-exclusion
             out_i[bad] = b_i
             out_d[bad] = b_d
+        if planes:
+            from ..cluster.planes import bisector_planes
+
+            return out_i, out_d, bisector_planes(queries, self._points_host,
+                                                 out_i)
         return out_i, out_d
 
     def query_radius(self, queries, radius: float,

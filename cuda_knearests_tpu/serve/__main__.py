@@ -8,8 +8,10 @@ Two modes over one ServeDaemon:
   batch with ZERO steady-state recompiles -- the scripts/check.sh CPU
   smoke's acceptance gate.
 * default (stdio): JSON-lines requests on stdin, JSON-lines responses on
-  stdout.  Request: ``{"id": 1, "op": "query"|"insert"|"delete",
-  "data": [[x,y,z],...] | [id,...], "k": 8}``.  Responses carry ``ok``
+  stdout.  Request: ``{"id": 1, "op": "query"|"insert"|"delete"|"fof",
+  "data": [[x,y,z],...] | [id,...] | linking_length, "k": 8}`` (``fof``
+  answers friends-of-friends cluster labels over the current mutated
+  cloud, DESIGN.md section 14).  Responses carry ``ok``
   plus results (pad slots -- fewer than k neighbors -- are id -1 with d2
   null; the wire is strict RFC 8259, never an Infinity token), or the
   typed refusal (``failure_kind`` from the engine taxonomy).  Batching is

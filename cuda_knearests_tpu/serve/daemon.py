@@ -61,6 +61,10 @@ class Response:
     failure_kind: Optional[str] = None    # FAILURE_KINDS member when not ok
     arrived_at: float = 0.0
     completed_at: float = 0.0
+    # fof requests (DESIGN.md section 14): canonical per-point cluster
+    # labels over the CURRENT mutated cloud + the distinct-cluster count
+    labels: Optional[np.ndarray] = None
+    n_clusters: Optional[int] = None
 
     @property
     def latency_s(self) -> float:
@@ -77,6 +81,9 @@ class Response:
                           for v in row] for row in self.d2]
         if self.n_points is not None:
             out["n_points"] = self.n_points
+        if self.labels is not None:
+            out["labels"] = np.asarray(self.labels).tolist()
+            out["n_clusters"] = self.n_clusters
         if not self.ok:
             out["error"] = self.error
             out["failure_kind"] = self.failure_kind
@@ -118,6 +125,8 @@ class ServeDaemon:
         self.batches_executed = 0
         self.failed_batches = 0
         self.failed_mutations = 0
+        self.fof_requests = 0
+        self._fof_cache: Optional[tuple] = None  # (version key, FofResult)
         self.refused = 0
         self.failure_kinds: Dict[str, int] = {}
         self.occupancies: List[float] = []
@@ -165,6 +174,34 @@ class ServeDaemon:
             out = []
             for batch in self.batcher.admit(req, now):
                 out.extend(self._execute(batch))
+            return out
+        if kind == "fof":
+            # clustering query family (DESIGN.md section 14): flush the
+            # pending batch first (stream-order consistency with the
+            # mutation barrier), then label the CURRENT mutated cloud.
+            # Same containment law as batches: a FoF death costs THIS
+            # request a typed failure, never the daemon.
+            out = []
+            barrier = self.batcher.flush("barrier", now)
+            if barrier is not None:
+                out.extend(self._execute(barrier))
+            self.fof_requests += 1
+            try:
+                res = self._run_fof(float(payload))
+            except Exception as e:  # noqa: BLE001 -- containment IS the contract: a FoF solve death becomes one typed failure response, the daemon survives
+                fkind = self._classify(e)
+                self.failure_kinds[fkind] = \
+                    self.failure_kinds.get(fkind, 0) + 1
+                out.append(Response(
+                    req_id=req_id, ok=False,
+                    error=f"fof failed: {type(e).__name__}: {e}",
+                    failure_kind=fkind, arrived_at=now,
+                    completed_at=self.clock()))
+                return out
+            out.append(Response(
+                req_id=req_id, ok=True, n_points=self.overlay.n_points,
+                labels=res.labels, n_clusters=res.n_clusters,
+                arrived_at=now, completed_at=self.clock()))
             return out
         # mutation barrier: queries already pending answer against the
         # pre-mutation cloud (their batch formed first)
@@ -232,6 +269,24 @@ class ServeDaemon:
             return kind
         return classify_fault_text(f"{type(e).__name__}: {e}") or "crash"
 
+    def _run_fof(self, b: float):
+        """FoF labels of the CURRENT mutated cloud (cluster/fof.py),
+        memoized until the next mutation: repeated fof requests at the
+        same linking length between mutations answer from cache, and the
+        per-round launches behind a cache miss dispatch through the same
+        AOT executable cache as the batched queries."""
+        from ..cluster.fof import fof_labels
+
+        st = self.overlay.stats
+        version = (b, st.inserts, st.deletes, st.compactions)
+        if self._fof_cache is not None and self._fof_cache[0] == version:
+            return self._fof_cache[1]
+        # overlay points are already inside the prepared domain (inserts
+        # were validated at admission): skip the O(n) re-scan
+        res = fof_labels(self.overlay.mutated_points(), b, validate=False)
+        self._fof_cache = (version, res)
+        return res
+
     def _run_batch(self, batch: Batch, idx: int):
         """One padded bucket-capacity launch at the serving k."""
         if self._fault is not None and idx == self._fault[0]:
@@ -286,6 +341,7 @@ class ServeDaemon:
             "batches": self.batches_executed,
             "failed_batches": self.failed_batches,
             "failed_mutations": self.failed_mutations,
+            "fof_requests": self.fof_requests,
             "refused": self.refused,
             "failure_kinds": dict(self.failure_kinds),
             "flushes": dict(self.batcher.flushes),

@@ -55,6 +55,20 @@ JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.serve --loadgen \
     --points uniform:4000 --requests 60 --rate 300 --seed 0 \
     --assert-steady || rc=1
 
+# FoF fuzz smoke (DESIGN.md section 14): a fixed-seed clustering campaign
+# (the same adversarial zoo + seeded linking lengths, incl. exact-tie
+# radii) through cluster.fof vs the CPU union-find oracle with the
+# tie-aware partition check.  KNTPU_FOF_CASES deepens it for nightly runs.
+echo "== FoF fuzz smoke (clustering vs union-find oracle, ${KNTPU_FOF_CASES:-32} cases, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
+    --fof --cases "${KNTPU_FOF_CASES:-32}" --seed 0 --budget 60s || rc=1
+
+# Clustering smoke (DESIGN.md section 14): FoF vs the oracle at three
+# linking regimes on a fixed cloud + the plane-feed bit-identity pin
+# (bisector planes from the epilogue == independent f64 recompute).
+echo "== clustering smoke (FoF regimes + plane-feed pin, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.cluster || rc=1
+
 # Mutation-stream fuzz smoke (DESIGN.md section 13): seeded insert/delete/
 # query interleavings through the serving delta overlay, differentially
 # checked against the rebuild-from-scratch oracle; failures are minimized

@@ -102,9 +102,42 @@ def _artifact_good(path: str, allow_partial: bool = False) -> bool:
     if any(ln.get("platform") in (None, "", "cpu", "unknown")
            for ln in lines):
         return False
+    # the bench's own self-assessment (ISSUE 7 satellite): a line that
+    # stamps north_star=false recorded a fallback capture -- the r5 607k
+    # q/s CPU row must never be banked as the record again, even if some
+    # platform stamp were to slip through
+    if any(ln.get("north_star") is False for ln in lines):
+        return False
     if allow_partial:
         return any("error" not in ln for ln in lines)
     return all("error" not in ln for ln in lines)
+
+
+def flag_stale_artifacts(paths: "list[str]", max_age_days: float
+                         ) -> "list[str]":
+    """Names of previously-banked GOOD artifacts older than
+    ``max_age_days`` (by their own utc stamp).  A stale north-star
+    artifact short-circuits collection forever (run_and_record never
+    re-runs a captured-good step), so an operator watching a re-tuned
+    tree must know the banked record predates it -- the watcher prints
+    the flag at startup and the caller can delete/rename to re-capture."""
+    stale = []
+    now = datetime.datetime.now(datetime.timezone.utc)
+    for path in paths:
+        if not _artifact_good(path):
+            continue
+        try:
+            with open(path) as f:
+                utc = json.load(f).get("utc")
+            age = (now - datetime.datetime.fromisoformat(utc)).days
+        except (OSError, ValueError, TypeError):
+            continue
+        if age > max_age_days:
+            stale.append(os.path.basename(path))
+            print(f"[tpu_watch] STALE artifact {os.path.basename(path)}: "
+                  f"captured {age} days ago -- treat as historical; delete "
+                  f"it to force a fresh capture", flush=True)
+    return stale
 
 
 def write_bench_snapshot(outdir: str, tag: str, ns_path: str,
@@ -144,7 +177,18 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-timeout", type=float, default=150.0)
     ap.add_argument("--outdir", default="bench_runs")
     ap.add_argument("--tag", default="r4")
+    ap.add_argument("--stale-days", type=float, default=7.0,
+                    help="flag banked-good north-star artifacts older than "
+                         "this many days at startup (they short-circuit "
+                         "collection; delete to re-capture)")
     args = ap.parse_args(argv)
+
+    outdir0 = (args.outdir if os.path.isabs(args.outdir)
+               else os.path.join(REPO, args.outdir))
+    flag_stale_artifacts(
+        [os.path.join(outdir0, f"{args.tag}_{s}.json")
+         for s in ("tpu_north_star", "tpu_smoke", "BENCH_snapshot")],
+        args.stale_days)
 
     deadline = time.time() + args.max_hours * 3600
     attempt = 0

@@ -1,0 +1,550 @@
+"""Clustering subsystem tests (ISSUE 7): friends-of-friends on the shared
+grid core, the Voronoi plane feed, the FoF fuzz flavor, the serving `fof`
+request type, and the bench/watcher provenance satellites.
+
+Correctness model: FoF labels are differentially checked against the CPU
+union-find oracle through the tie-aware partition comparison
+(cluster/compare.py -- pairs within the f32 rounding band of the linking
+radius may legally link either way); the plane feed is pinned BIT-IDENTICAL
+to an independent f64 recompute from the returned neighbor ids on all four
+solve routes.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.cluster.compare import check_fof_result, fof_band
+from cuda_knearests_tpu.cluster.fof import (MAX_PAIR_SLOTS, FofResult,
+                                            fof_grid_dim, fof_labels)
+from cuda_knearests_tpu.cluster.planes import bisector_planes
+from cuda_knearests_tpu.config import DOMAIN_SIZE
+from cuda_knearests_tpu.io import generate_uniform, validate_linking_length
+from cuda_knearests_tpu.oracle import UnionFind, fof_oracle
+from cuda_knearests_tpu.utils.memory import (InputContractError,
+                                             InvalidConfigError,
+                                             LaunchBudgetError)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(REPO, "tests", "corpus")
+
+
+def _ref_planes(sites, points, ids):
+    """Independent f64 recompute of the plane feed from returned ids --
+    the satellite's pin: emitted (n, d) must be bit-identical to this."""
+    q = sites.astype(np.float64)[:, None, :]
+    p = points[np.clip(ids, 0, None)].astype(np.float64)
+    nn = (p - q).astype(np.float32)
+    d = (((p * p).sum(-1) - (q * q).sum(-1)) / 2.0).astype(np.float32)
+    ok = ids >= 0
+    return np.concatenate(
+        [np.where(ok[..., None], nn, np.float32(0.0)),
+         np.where(ok, d, np.float32(np.inf))[..., None]], axis=-1)
+
+
+# -- FoF core -----------------------------------------------------------------
+
+def test_fof_two_separated_blobs():
+    rng = np.random.default_rng(0)
+    a = rng.normal([200, 200, 200], 5, (60, 3))
+    b = rng.normal([800, 800, 800], 5, (40, 3))
+    pts = np.clip(np.concatenate([a, b]), 0, 999.9).astype(np.float32)
+    res = fof_labels(pts, 40.0)
+    assert isinstance(res, FofResult)
+    assert res.n_clusters == 2
+    # canonical labels: min original id of each blob (0 and 60)
+    assert set(np.unique(res.labels)) == {0, 60}
+    assert (res.labels[:60] == 0).all() and (res.labels[60:] == 60).all()
+    assert (res.sizes[:60] == 60).all() and (res.sizes[60:] == 40).all()
+    assert check_fof_result(pts, 40.0, res.labels, res.sizes) is None
+
+
+def test_fof_chain_pointer_jumping():
+    """A 300-link chain: worst case for naive label propagation (O(n)
+    sweeps); pointer jumping must converge in O(log n) rounds."""
+    n = 300
+    chain = np.stack([np.linspace(5, 995, n), np.full(n, 500.0),
+                      np.full(n, 500.0)], 1).astype(np.float32)
+    spacing = (995.0 - 5.0) / (n - 1)
+    res = fof_labels(chain, spacing * 1.01)
+    assert res.n_clusters == 1
+    assert (res.labels == 0).all() and (res.sizes == n).all()
+    assert res.rounds <= 16, f"{res.rounds} rounds for a {n}-chain"
+    assert check_fof_result(chain, spacing * 1.01, res.labels,
+                            res.sizes) is None
+
+
+def test_fof_chain_below_linking_length_is_singletons():
+    n = 50
+    chain = np.stack([np.linspace(5, 995, n), np.full(n, 500.0),
+                      np.full(n, 500.0)], 1).astype(np.float32)
+    spacing = (995.0 - 5.0) / (n - 1)
+    res = fof_labels(chain, spacing * 0.5)
+    assert res.n_clusters == n
+    assert np.array_equal(res.labels, np.arange(n))
+    assert (res.sizes == 1).all()
+
+
+def test_fof_all_coincident():
+    pts = np.tile(np.float32([500, 500, 500]), (70, 1))
+    res = fof_labels(pts, 1e-3)
+    assert res.n_clusters == 1 and (res.labels == 0).all()
+    assert (res.sizes == 70).all()
+    assert check_fof_result(pts, 1e-3, res.labels, res.sizes) is None
+
+
+def test_fof_degenerate_sizes():
+    empty = fof_labels(np.empty((0, 3), np.float32), 5.0)
+    assert empty.labels.shape == (0,) and empty.n_clusters == 0
+    assert empty.rounds == 0 and empty.host_syncs == 0
+    one = fof_labels(np.float32([[1, 2, 3]]), 5.0)
+    assert np.array_equal(one.labels, [0]) and one.n_clusters == 1
+
+
+def test_fof_sync_budget_is_rounds_plus_one():
+    """The counted-sync contract (DESIGN.md section 14): one convergence
+    flag per round + one final batched fetch of labels and sizes."""
+    pts = generate_uniform(3000, seed=4)
+    res = fof_labels(pts, 60.0)
+    assert res.host_syncs == res.rounds + 1
+    assert 1 <= res.rounds <= 64
+
+
+def test_fof_grid_dim_cell_width_invariant():
+    """27-cell sufficiency: the chosen dim always keeps cell width >= b."""
+    for n, b in ((100, 1.0), (100, 33.3), (5000, 7.7), (10, 999.0),
+                 (10, 5000.0), (257, 0.123)):
+        dim = fof_grid_dim(n, b)
+        assert dim >= 1
+        assert dim == 1 or DOMAIN_SIZE / dim >= b, (n, b, dim)
+
+
+def test_fof_linking_length_front_door():
+    pts = generate_uniform(10, seed=1)
+    for bad in (0.0, -1.0, float("nan"), float("inf"), "12", True, None,
+                [1.0]):
+        with pytest.raises(InputContractError):
+            fof_labels(pts, bad)
+    with pytest.raises(InvalidConfigError):
+        validate_linking_length(-3)
+    assert validate_linking_length(2) == 2.0
+    # huge b is legal degraded mode: one cluster
+    res = fof_labels(pts, 1e6)
+    assert res.n_clusters == 1
+
+
+def test_fof_points_front_door():
+    with pytest.raises(InputContractError):
+        fof_labels(np.float32([[1, 2]]), 5.0)  # wrong shape
+    with pytest.raises(InputContractError):
+        fof_labels(np.float32([[np.nan, 0, 0]]), 5.0)
+
+
+def test_fof_pair_budget_preflight(monkeypatch):
+    """A degenerate cloud whose densest 27-neighborhood would blow the
+    pair budget is REFUSED with the typed oom-kind error, not left to
+    wedge the allocator."""
+    import cuda_knearests_tpu.cluster.fof as fof_mod
+
+    monkeypatch.setattr(fof_mod, "MAX_PAIR_SLOTS", 1000)
+    pts = np.tile(np.float32([500, 500, 500]), (200, 1))
+    with pytest.raises(LaunchBudgetError) as ei:
+        fof_labels(pts, 1.0)
+    assert ei.value.kind == "oom"
+    assert MAX_PAIR_SLOTS > 1000  # the real module constant is untouched
+
+
+@pytest.mark.parametrize("seed,b_scale", [(1, 0.4), (2, 1.0), (3, 2.2)])
+def test_fof_differential_uniform(seed, b_scale):
+    pts = generate_uniform(400, seed=seed)
+    b = b_scale * DOMAIN_SIZE / 400.0 ** (1.0 / 3.0)
+    res = fof_labels(pts, b)
+    assert check_fof_result(pts, b, res.labels, res.sizes) is None
+
+
+def test_fof_exact_tie_at_radius():
+    """Points on a lattice with b EXACTLY the lattice step: every nearest
+    pair sits on the radius.  The tie-aware check must accept the engine's
+    f32 decision either way."""
+    step = 100.0
+    g = np.arange(1, 9, dtype=np.float32) * step  # interior lattice
+    xx, yy, zz = np.meshgrid(g, g, g, indexing="ij")
+    pts = np.stack([xx.ravel(), yy.ravel(), zz.ravel()], 1)[:343]
+    res = fof_labels(pts, step)
+    assert check_fof_result(pts, step, res.labels, res.sizes) is None
+    # well inside the band nothing is ambiguous: strict equality to oracle
+    res2 = fof_labels(pts, step * 1.5)
+    mand, allowed = fof_oracle(pts, step * 1.5, band=fof_band(step * 1.5))
+    assert np.array_equal(mand, allowed)
+    assert np.array_equal(res2.labels, mand)
+
+
+def test_union_find_oracle_basics():
+    uf = UnionFind(6)
+    uf.union(0, 3)
+    uf.union(3, 5)
+    uf.union(1, 2)
+    labels = uf.canonical_labels()
+    assert np.array_equal(labels, [0, 1, 1, 0, 4, 0])
+
+
+def test_check_fof_catches_corruptions():
+    pts = generate_uniform(120, seed=7)
+    b = 1.2 * DOMAIN_SIZE / 120.0 ** (1.0 / 3.0)
+    res = fof_labels(pts, b)
+    assert res.n_clusters >= 2 and (res.sizes > 1).any()
+    # non-canonical label
+    bad = res.labels.copy()
+    lab = np.unique(bad[np.nonzero(res.sizes > 1)[0]])[0]
+    members = np.nonzero(bad == lab)[0]
+    bad[members] = members[-1]
+    got = check_fof_result(pts, b, bad)
+    assert got is not None and got.reason == "not-canonical"
+    # forbidden merge of everything
+    merged = np.zeros_like(res.labels)
+    got = check_fof_result(pts, b, merged)
+    assert got is not None
+    # mandatory split: singleton-ize a member of a real cluster
+    split = res.labels.copy()
+    members = np.nonzero(split == lab)[0]
+    split[members[-1]] = members[-1]
+    got = check_fof_result(pts, b, split)
+    assert got is not None and got.reason == "mandatory-split"
+    # out-of-range labels
+    got = check_fof_result(pts, b, np.full(120, 120, np.int32))
+    assert got is not None and got.reason == "label-range"
+
+
+# -- plane feed ---------------------------------------------------------------
+
+def test_planes_bit_identical_across_all_four_routes():
+    """The satellite pin: (n, d) emitted by every route's plane surface ==
+    the independent f64 recompute from that route's returned ids."""
+    import jax
+
+    from cuda_knearests_tpu.parallel.sharded import ShardedKnnProblem
+
+    pts = generate_uniform(600, seed=20)
+    k = 6
+    # route 1: adaptive self-solve (config flag epilogue)
+    pa = KnnProblem.prepare(pts, KnnConfig(k=k, plane_feed=True))
+    res = pa.solve()
+    assert res.planes is not None
+    assert np.array_equal(
+        res.planes, _ref_planes(pts, pts, pa.get_knearests_original()))
+    # route 2: legacy pack self-solve (lazy get_planes)
+    pl = KnnProblem.prepare(pts, KnnConfig(k=k, adaptive=False))
+    pl.solve()
+    assert np.array_equal(
+        pl.get_planes(), _ref_planes(pts, pts, pl.get_knearests_original()))
+    # route 3: external queries (kwarg epilogue)
+    queries = generate_uniform(100, seed=21)
+    ids, _d2, planes = pa.query(queries, planes=True)
+    assert planes.shape == (100, k, 4)
+    assert np.array_equal(planes, _ref_planes(queries, pts, ids))
+    # route 4: sharded solve + query
+    sp = ShardedKnnProblem.prepare(
+        pts, n_devices=min(2, len(jax.devices())), config=KnnConfig(k=k))
+    solved = sp.solve()
+    assert np.array_equal(sp.get_planes(solved=solved),
+                          _ref_planes(pts, pts, solved[0]))
+    s_ids, _sd2, s_planes = sp.query(queries, planes=True)
+    assert np.array_equal(s_planes, _ref_planes(queries, pts, s_ids))
+
+
+def test_planes_pad_slots_and_degenerate():
+    """k > n rows: pad slots carry the trivially-true half-space."""
+    pts = generate_uniform(3, seed=5)
+    p = KnnProblem.prepare(pts, KnnConfig(k=8, plane_feed=True))
+    res = p.solve()
+    ids = p.get_knearests_original()
+    assert (ids < 0).any()
+    pad = ids < 0
+    assert (res.planes[pad][:, :3] == 0.0).all()
+    assert np.isinf(res.planes[pad][:, 3]).all()
+    assert np.array_equal(res.planes, _ref_planes(pts, pts, ids))
+    # empty problem: (0, k, 4), no crash
+    pe = KnnProblem.prepare(np.empty((0, 3), np.float32),
+                            KnnConfig(k=4, plane_feed=True))
+    assert pe.solve().planes.shape == (0, 4, 4)
+
+
+def test_planes_halfspace_semantics():
+    """The geometric contract: each site satisfies its own half-space
+    n . x <= d strictly unless coincident with the neighbor."""
+    pts = generate_uniform(200, seed=9)
+    p = KnnProblem.prepare(pts, KnnConfig(k=4, plane_feed=True))
+    p.solve()
+    planes = p.get_planes()
+    ids = p.get_knearests_original()
+    lhs = (planes[:, :, :3] * pts[:, None, :]).sum(-1)
+    ok = ids >= 0
+    assert (lhs[ok] < planes[:, :, 3][ok]).all()
+    # and the NEIGHBOR sits on the far side (n . q > d)
+    nb = pts[np.clip(ids, 0, None)]
+    rhs = (planes[:, :, :3] * nb).sum(-1)
+    assert (rhs[ok] > planes[:, :, 3][ok]).all()
+
+
+def test_planes_shared_helper_matches_surfaces():
+    pts = generate_uniform(50, seed=2)
+    p = KnnProblem.prepare(pts, KnnConfig(k=3))
+    p.solve()
+    ids = p.get_knearests_original()
+    assert np.array_equal(p.get_planes(), bisector_planes(pts, pts, ids))
+
+
+# -- serving `fof` request type ----------------------------------------------
+
+def _daemon(pts, k=6, **cfg):
+    from cuda_knearests_tpu.config import ServeConfig
+    from cuda_knearests_tpu.serve.daemon import ServeDaemon
+
+    problem = KnnProblem.prepare(pts, KnnConfig(k=k, adaptive=False))
+    return ServeDaemon(problem, ServeConfig(max_batch=32, warmup=False,
+                                            **cfg))
+
+
+def test_serve_fof_request_against_mutated_cloud():
+    pts = generate_uniform(500, seed=31)
+    d = _daemon(pts)
+    b = 80.0
+    r = d.submit(1, "fof", b)
+    assert r[-1].ok
+    assert np.array_equal(r[-1].labels, fof_labels(pts, b).labels)
+    assert r[-1].n_clusters == int(np.unique(r[-1].labels).size)
+    # cache: identical request answers identically (and counts)
+    r2 = d.submit(2, "fof", b)
+    assert np.array_equal(r2[-1].labels, r[-1].labels)
+    assert d.fof_requests == 2
+    # a mutation invalidates: labels must reflect the overlay cloud
+    extra = generate_uniform(7, seed=32)
+    d.submit(3, "insert", extra)
+    r3 = d.submit(4, "fof", b)
+    assert r3[-1].labels.shape == (507,)
+    mutated = d.overlay.mutated_points()
+    assert np.array_equal(r3[-1].labels, fof_labels(mutated, b).labels)
+    # delete then fof: canonical CURRENT indexing
+    d.submit(5, "delete", np.arange(10))
+    r4 = d.submit(6, "fof", b)
+    assert r4[-1].labels.shape == (497,)
+    assert np.array_equal(r4[-1].labels,
+                          fof_labels(d.overlay.mutated_points(), b).labels)
+    # wire form carries labels + n_clusters
+    wire = r4[-1].to_wire()
+    assert wire["ok"] and len(wire["labels"]) == 497
+    assert wire["n_clusters"] == r4[-1].n_clusters
+
+
+def test_serve_fof_refusal_and_containment(monkeypatch):
+    pts = generate_uniform(200, seed=33)
+    d = _daemon(pts)
+    # refusal: bad linking length is a typed invalid-input, nothing else
+    r = d.submit(1, "fof", -5.0)
+    assert not r[0].ok and r[0].failure_kind == "invalid-input"
+    assert d.refused == 1
+    # containment: a FoF solve death costs THIS request a typed failure,
+    # the daemon keeps serving
+    monkeypatch.setattr(d, "_run_fof",
+                        lambda b: (_ for _ in ()).throw(
+                            RuntimeError("synthetic fof death")))
+    r2 = d.submit(2, "fof", 50.0)
+    assert not r2[-1].ok and r2[-1].failure_kind == "crash"
+    assert d.failure_kinds.get("crash") == 1
+    monkeypatch.undo()
+    r3 = d.submit(3, "fof", 50.0)
+    assert r3[-1].ok  # the daemon survived and answers again
+    # unknown kind still refused typed
+    r4 = d.submit(4, "cluster", 1.0)
+    assert not r4[0].ok and r4[0].failure_kind == "invalid-input"
+
+
+def test_validate_request_fof_kind():
+    from cuda_knearests_tpu.io import validate_request
+
+    assert validate_request("fof", 12.5) == 12.5
+    with pytest.raises(InputContractError):
+        validate_request("fof", 0.0)
+    with pytest.raises(InputContractError):
+        validate_request("fof", [1.0, 2.0])
+
+
+# -- FoF fuzz flavor ----------------------------------------------------------
+
+def test_fof_fuzz_case_clean_and_regenerable():
+    from cuda_knearests_tpu.fuzz.fof import (FofCaseSpec, case_linking_length,
+                                             case_points, run_fof_case)
+
+    spec = FofCaseSpec(generator="uniform", seed=5, n=96, b_mode="scaled",
+                       b_scale=1.0)
+    pts1, pts2 = case_points(spec), case_points(spec)
+    assert np.array_equal(pts1, pts2)  # regenerable from the spec alone
+    assert case_linking_length(spec, pts1) == case_linking_length(spec, pts2)
+    assert run_fof_case(spec, bank_dir=None) is None
+
+
+def test_fof_fuzz_tie_mode_radius_is_a_real_distance():
+    from cuda_knearests_tpu.fuzz.fof import (FofCaseSpec, case_linking_length,
+                                             case_points)
+
+    spec = FofCaseSpec(generator="uniform", seed=8, n=33, b_mode="tie",
+                       b_scale=1.0)
+    pts = case_points(spec)
+    b = case_linking_length(spec, pts)
+    d = np.sqrt(((pts.astype(np.float64)[1:] - pts.astype(np.float64)[0])
+                 ** 2).sum(-1))
+    assert np.isclose(b, d.min(), rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("fault", ["split", "merge"])
+def test_fof_seeded_fault_banks_minimized_repro(tmp_path, monkeypatch,
+                                                fault):
+    """The detector liveness self-test: each seeded corruption must yield
+    a minimized, banked, reloadable repro."""
+    from cuda_knearests_tpu.fuzz.fof import (FofCaseSpec, load_fof_case,
+                                             run_fof_case)
+
+    monkeypatch.setenv("KNTPU_FOF_FAULT", fault)
+    # percolation-regime uniform case (measured: 10 clusters, 8 of them
+    # multi-member): both faults have the structure they need to bite
+    spec = FofCaseSpec(generator="uniform", seed=3, n=96, b_mode="scaled",
+                       b_scale=1.0)
+    f = run_fof_case(spec, bank_dir=str(tmp_path), max_probes=12)
+    assert f is not None and f.kind == "mismatch"
+    assert f.banked and os.path.exists(f.banked)
+    assert f.minimized_n <= f.original_n
+    banked = load_fof_case(f.banked)
+    assert banked["spec"] == spec
+    assert banked["linking_length"] == f.linking_length
+    # replaying the banked points WITHOUT the fault is clean (the corpus
+    # pins fixes, not failures)
+    monkeypatch.delenv("KNTPU_FOF_FAULT")
+    res = fof_labels(banked["points"], banked["linking_length"])
+    assert check_fof_result(banked["points"], banked["linking_length"],
+                            res.labels, res.sizes) is None
+
+
+def test_fof_faulted_run_never_banks_into_real_corpus(monkeypatch):
+    from cuda_knearests_tpu.fuzz import CORPUS_DIR
+    from cuda_knearests_tpu.fuzz.fof import _safe_bank_dir
+
+    monkeypatch.setenv("KNTPU_FOF_FAULT", "merge")
+    diverted = _safe_bank_dir(CORPUS_DIR)
+    assert os.path.abspath(diverted) != os.path.abspath(CORPUS_DIR)
+    monkeypatch.delenv("KNTPU_FOF_FAULT")
+    assert _safe_bank_dir(CORPUS_DIR) == CORPUS_DIR
+
+
+def test_fof_campaign_manifest(tmp_path):
+    from cuda_knearests_tpu.fuzz.fof import run_fof_campaign
+
+    manifest = run_fof_campaign(n_cases=3, seed=2, bank_dir=str(tmp_path),
+                                log=None)
+    assert manifest["ok"] is True and manifest["flavor"] == "fof"
+    for key in ("requested_cases", "completed_cases", "seed", "elapsed_s",
+                "failures", "corpus_size", "truncated_after"):
+        assert key in manifest
+
+
+def _fof_corpus_entries():
+    return sorted(glob.glob(os.path.join(CORPUS, "*-fof.npz")))
+
+
+@pytest.mark.parametrize("path", _fof_corpus_entries() or ["<empty>"],
+                         ids=[os.path.basename(p)
+                              for p in _fof_corpus_entries()] or ["none"])
+def test_fof_corpus_replays_clean(path):
+    """Every banked FoF repro must stay fixed on the current tree (the
+    same regression-pin policy as the point-case corpus)."""
+    if path == "<empty>":
+        pytest.skip("no banked FoF repros (none found yet)")
+    from cuda_knearests_tpu.fuzz.fof import load_fof_case
+
+    b = load_fof_case(path)
+    res = fof_labels(b["points"], b["linking_length"])
+    bad = check_fof_result(b["points"], b["linking_length"], res.labels,
+                           res.sizes)
+    assert bad is None, f"{os.path.basename(path)} regressed: {bad.render()}"
+
+
+# -- bench / watcher satellites ----------------------------------------------
+
+def test_bench_fof_row_fields(monkeypatch):
+    monkeypatch.setenv("BENCH_FOF_N", "4000")
+    monkeypatch.setenv("BENCH_MAX_SECONDS", "20")
+    import bench
+
+    row = bench.bench_config("fof_300k")
+    assert row["unit"] == "points/sec" and row["value"] > 0
+    assert row["backend"] == "grid"  # provenance stamp (ISSUE 7 satellite)
+    assert row["fof_rounds"] >= 1               # propagation iterations
+    assert row["host_syncs"] == row["fof_rounds"] + 1
+    assert row["n_clusters"] >= 1 and row["largest_cluster"] >= 1
+    assert row["scaled_down_from"] == 300_000
+
+
+def test_bench_north_star_refuses_cpu_fallback_label(monkeypatch):
+    """The r5 regression guard: a CPU-fallback capture must stamp itself
+    north_star=false with the reason -- never pose as the record."""
+    monkeypatch.setenv("BENCH_NORTH_N", "2000")
+    monkeypatch.setenv("BENCH_ORACLE_SAMPLE", "500")
+    monkeypatch.setenv("BENCH_MAX_SECONDS", "20")
+    import bench
+
+    out = bench.bench_north_star()
+    assert out["north_star"] is False  # this test runs on CPU
+    assert "north_star_note" in out and "NOT a north-star" in \
+        out["north_star_note"]
+    assert "backend" in out
+
+
+def test_bench_all_rows_carry_backend_provenance():
+    """Every config row constructor stamps `backend` (the satellite: no
+    anonymous rows that a CPU capture could hide behind).  Static check
+    over the row builders via tiny fixtures where cheap."""
+    monkey_rows = []
+    import bench
+
+    # cheap rows only; heavyweight ones are covered by their own tests
+    os.environ["BENCH_MAX_SECONDS"] = "20"
+    try:
+        row = bench.bench_config("kdtree_cpu_20k")
+        monkey_rows.append(row)
+    finally:
+        os.environ.pop("BENCH_MAX_SECONDS", None)
+    for row in monkey_rows:
+        assert "backend" in row, row.get("config")
+
+
+def test_tpu_watch_rejects_non_north_star_artifacts(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch", os.path.join(REPO, "scripts", "tpu_watch.py"))
+    watch = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(watch)
+
+    import json
+
+    good = {"rc": 0, "utc": "2026-08-01T00:00:00+00:00",
+            "lines": [{"platform": "tpu", "value": 1.0,
+                       "north_star": True}]}
+    p = tmp_path / "good.json"
+    p.write_text(json.dumps(good))
+    assert watch._artifact_good(str(p))
+    # a line that self-stamps north_star=false is NOT bankable as record
+    bad = dict(good, lines=[{"platform": "tpu", "value": 1.0,
+                             "north_star": False}])
+    p2 = tmp_path / "bad.json"
+    p2.write_text(json.dumps(bad))
+    assert not watch._artifact_good(str(p2))
+    # stale flagging: an old good artifact is reported
+    old = dict(good, utc="2020-01-01T00:00:00+00:00")
+    p3 = tmp_path / "old.json"
+    p3.write_text(json.dumps(old))
+    assert watch.flag_stale_artifacts([str(p3)], max_age_days=7) == \
+        ["old.json"]
+    assert watch.flag_stale_artifacts([str(p)], max_age_days=10 ** 6) == []

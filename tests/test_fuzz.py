@@ -187,10 +187,11 @@ def test_k_gt_n_keeps_certificates_intact():
 # -- corpus replay ------------------------------------------------------------
 
 def _corpus_entries():
-    # point-case repros only: mutation-stream repros (*-mutation.npz) have
-    # their own schema and replay via their own loader below
+    # point-case repros only: mutation-stream (*-mutation.npz) and FoF
+    # (*-fof.npz) repros have their own schemas and replay via their own
+    # loaders (below / tests/test_cluster.py)
     return sorted(p for p in glob.glob(os.path.join(CORPUS, "*.npz"))
-                  if not p.endswith("-mutation.npz"))
+                  if not p.endswith(("-mutation.npz", "-fof.npz")))
 
 
 def _mutation_corpus_entries():
@@ -199,7 +200,7 @@ def _mutation_corpus_entries():
 
 def _all_corpus_entries():
     # what fuzz.corpus_size() counts (and bench stamps as
-    # fuzz_corpus_size): every banked repro of BOTH flavors
+    # fuzz_corpus_size): every banked repro of EVERY flavor
     return sorted(glob.glob(os.path.join(CORPUS, "*.npz")))
 
 
