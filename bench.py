@@ -151,7 +151,29 @@ def _solve_qps(points, cfg, iters: int = 3, oracle_swap: bool = True,
     run()  # compile + warmup
     _watchdog.heartbeat()
     s = _steady_state(run, iters, max_seconds=_budget_s())
+    sync_fields.update(_sync_proof_fields("adaptive-solve", sync_fields))
     return points.shape[0] / s, s, problem, dict(sync_fields)
+
+
+def _sync_proof_fields(route: str, measured: dict,
+                       env: dict | None = None) -> dict:
+    """kntpu-verify provenance (ISSUE 8): the statically-proven host-sync
+    bound for this row's solve window (analysis/syncflow.py) and whether
+    the measured counters respect it -- a row that violates its own proof
+    is flagged in the artifact, not silently banked.  Pure model lookup:
+    no tracing, no device involvement."""
+    try:
+        from cuda_knearests_tpu.analysis import syncflow
+
+        win = syncflow.WINDOWS[syncflow.ROUTE_WINDOWS[route]]
+        bound = syncflow.evaluate(
+            win.syncs, {**syncflow.worst_case_env(), **(env or {})})
+        out = {"sync_bound_proved": bound, "sync_bound_expr": win.syncs}
+        if measured.get("host_syncs") is not None:
+            out["sync_bound_ok"] = int(measured["host_syncs"]) <= bound
+        return out
+    except Exception:  # noqa: BLE001 -- never let the stamp kill the output
+        return {}
 
 
 def _oracle_qps(points, k: int, sample_idx=None):
@@ -505,6 +527,7 @@ def bench_config(name: str) -> dict:
         _dispatch.reset_stats()
         neighbors, _, _ = sp.solve(device_out=outs)
         sync_fields = _dispatch.stats_dict()
+        sync_fields.update(_sync_proof_fields("sharded-solve", sync_fields))
         n = points.shape[0]
         sample, sample_n = _sampled_oracle_ref(points, k)
         if sample is None:  # tiny run: the sampled path needs explicit ids
@@ -567,6 +590,8 @@ def bench_config(name: str) -> dict:
                 "linking_length": round(b, 4),
                 "fof_rounds": res.rounds,       # propagation iterations
                 "host_syncs": res.host_syncs,   # rounds + 1 by contract
+                **_sync_proof_fields("fof", {"host_syncs": res.host_syncs},
+                                     env={"rounds": res.rounds}),
                 "n_clusters": res.n_clusters,
                 "largest_cluster": int(res.sizes.max()) if n else 0,
                 "fof_dim": res.dim, "fof_cell_max": res.cell_max,

@@ -1,6 +1,6 @@
-"""kntpu-check: static contract checker + TPU-hazard lint.
+"""kntpu-check: static contracts + TPU-hazard lint + dataflow verifier.
 
-Two engines gate every solve route before it ever touches a chip:
+Three engines gate every solve route before it ever touches a chip:
 
 * :mod:`.contracts` -- abstract contract checker: traces the adaptive,
   legacy-pack, external-query, and sharded per-chip solve routes with
@@ -10,28 +10,39 @@ Two engines gate every solve route before it ever touches a chip:
 * :mod:`.lint` + :mod:`.rules` -- AST-based TPU-hazard lint (pluggable
   rule registry): tracer leaks, silent dtype widening, host syncs and jnp
   construction in host loops, unmarked broad excepts.
+* :mod:`.verify` (+ :mod:`.syncflow`, :mod:`.equiv`) -- kntpu-verify, the
+  jaxpr-level dataflow verifier: proves each route's host-sync/transfer
+  budget symbolically from a discovered host-boundary dataflow graph,
+  flags recompile keys that depend on data values rather than the
+  class x capacity x k lattice, and certifies cross-route jaxpr
+  equivalence (the committed ``equivalence.json``, which collapses the
+  contract engine's route matrix -- ROADMAP item 5's precondition).
 
-One command runs both: ``python -m cuda_knearests_tpu.analysis`` (CPU-only
-by construction; see :mod:`.cli`).  The gate is zero-findings-vs-baseline
-(:mod:`.findings`); tests/test_analysis.py keeps it tier-1.
+One command runs all three: ``python -m cuda_knearests_tpu.analysis``
+(CPU-only by construction; see :mod:`.cli`).  The gate is
+zero-findings-vs-baseline (:mod:`.findings`); tests/test_analysis.py and
+tests/test_verify.py keep it tier-1.
 
 NOTE: this package deliberately does NOT import jax at import time -- the
 lint half must stay usable (and fast) in tooling contexts with no jax.
 """
 
-from .findings import (ANALYSIS_VERSION, Finding, analysis_stamp,
-                       baseline_hash, diff_vs_baseline, load_baseline,
-                       save_baseline)
+from .findings import (ANALYSIS_VERSION, BASELINE_SCHEMA, Finding,
+                       analysis_stamp, baseline_hash, diff_vs_baseline,
+                       equivalence_hash, load_baseline, save_baseline)
 
 __all__ = [
     "ANALYSIS_VERSION",
+    "BASELINE_SCHEMA",
     "Finding",
     "analysis_stamp",
     "baseline_hash",
     "diff_vs_baseline",
+    "equivalence_hash",
     "load_baseline",
     "run_contracts",
     "run_lint",
+    "run_verify",
     "save_baseline",
 ]
 
@@ -46,3 +57,9 @@ def run_contracts(fault=None):
     from .contracts import run_contracts as _rc
 
     return _rc(fault=fault)
+
+
+def run_verify(fault=None):
+    from .verify import run_verify as _rv
+
+    return _rv(fault=fault)

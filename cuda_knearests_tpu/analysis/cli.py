@@ -1,15 +1,21 @@
 """``python -m cuda_knearests_tpu.analysis`` -- the one-command gate.
 
-Runs both engines (abstract contract checker + TPU-hazard lint), compares
-against the committed baseline, and exits non-zero on any new finding.
-The whole run is chip-free: main() pins JAX_PLATFORMS=cpu (env + jax
-config, before any backend initializes) and the contract engine refuses
-any other backend.  The pin lives in main(), never at import time, so
-programmatic importers (bench stamping) keep their environment untouched.
+Runs all three engines (abstract contract checker + TPU-hazard lint +
+the kntpu-verify dataflow verifier), compares against the committed
+baseline, and exits non-zero on any new finding.  The whole run is
+chip-free: main() pins JAX_PLATFORMS=cpu (env + jax config, before any
+backend initializes) and the contract engine refuses any other backend.
+The pin lives in main(), never at import time, so programmatic importers
+(bench stamping) keep their environment untouched.
 
-Exit codes: 0 clean; 1 contract violation(s); 2 new lint finding(s);
-3 both.  ``--write-baseline`` re-blesses the current findings (a reviewed
-action, never automatic).
+Exit codes: 0 clean; 1 contract/verifier violation(s) or a stale-schema
+baseline; 2 new lint finding(s); 3 both.  ``--write-baseline`` re-blesses
+the current findings, ``--write-equivalence`` the cross-route
+certificates (both reviewed actions, never automatic).
+
+``--json`` emits one machine-readable document on stdout (stable schema
+:data:`JSON_SCHEMA`; tests/test_analysis.py pins the keys) so CI can
+render findings as annotations.
 """
 
 from __future__ import annotations
@@ -20,10 +26,17 @@ import os
 import sys
 from typing import List, Optional
 
-from .contracts import FAULTS
+from .contracts import FAULTS as CONTRACT_FAULTS
 from .findings import (ANALYSIS_VERSION, Finding, analysis_stamp,
                        baseline_hash, diff_vs_baseline, load_baseline,
-                       save_baseline)
+                       save_baseline, schema_finding)
+from .verify import FAULTS as VERIFY_FAULTS
+
+FAULTS = CONTRACT_FAULTS + VERIFY_FAULTS
+
+# Schema version of the --json output document.  Bump on any key change:
+# the CI annotation renderer keys off this.
+JSON_SCHEMA = 1
 
 
 def _pin_cpu_backend() -> None:
@@ -57,6 +70,10 @@ def _run(engine: str, paths: Optional[List[str]],
         from .contracts import run_contracts
 
         findings.extend(run_contracts(fault=fault))
+    if engine in ("verify", "all") and paths is None:
+        from .verify import run_verify
+
+        findings.extend(run_verify(fault=fault))
     return findings
 
 
@@ -64,7 +81,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m cuda_knearests_tpu.analysis",
         description=__doc__.splitlines()[0])
-    ap.add_argument("--engine", choices=("contracts", "lint", "all"),
+    ap.add_argument("--engine",
+                    choices=("contracts", "lint", "verify", "all"),
                     default="all", help="which engine(s) to run")
     ap.add_argument("--paths", nargs="+", default=None, metavar="PATH",
                     help="lint these files/dirs instead of the default "
@@ -77,6 +95,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="re-bless the current findings as the baseline "
                          "and exit 0 (review the diff before committing)")
+    ap.add_argument("--write-equivalence", action="store_true",
+                    help="regenerate and commit the cross-route "
+                         "equivalence certificates "
+                         "(analysis/equivalence.json); review which pairs "
+                         "changed before committing")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as one JSON object on stdout")
     ap.add_argument("--fault", choices=FAULTS, default=None,
@@ -99,18 +122,45 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         if not _iter_py_files(args.paths):
             ap.error(f"--paths matched no .py files: {args.paths}")
-    contracts_run = args.engine in ("contracts", "all") and args.paths is None
-    if args.fault and not contracts_run:
-        # a seeded self-test whose fault is never injected would report a
-        # false 'detector fired / tree clean'
-        ap.error("--fault seeds the contract engine, which this invocation "
-                 "does not run (drop --paths / use --engine contracts|all)")
-    if os.environ.get("KNTPU_ANALYSIS_FAULT") and not contracts_run:
-        print("warning: KNTPU_ANALYSIS_FAULT is set but the contract engine "
-              "is not running in this invocation; no fault was seeded",
+    # a seeded self-test whose fault is never injected would report a
+    # false 'detector fired / tree clean' -- so the check is per ENGINE:
+    # each fault seeds exactly one engine (contracts or verify), and THAT
+    # engine must be part of this invocation, not just any seedable one
+    # (a contracts-only run with a verify fault would otherwise pass
+    # clean with the fault silently ignored)
+    running = set()
+    if args.paths is None:
+        if args.engine in ("contracts", "all"):
+            running.add("contracts")
+        if args.engine in ("verify", "all"):
+            running.add("verify")
+
+    def _fault_engine(fault: str) -> str:
+        return "contracts" if fault in CONTRACT_FAULTS else "verify"
+
+    if args.fault and _fault_engine(args.fault) not in running:
+        ap.error(f"--fault {args.fault} seeds the "
+                 f"{_fault_engine(args.fault)} engine, which this "
+                 f"invocation does not run (drop --paths / use --engine "
+                 f"{_fault_engine(args.fault)}|all)")
+    env_fault = os.environ.get("KNTPU_ANALYSIS_FAULT")
+    if env_fault and env_fault in FAULTS \
+            and _fault_engine(env_fault) not in running:
+        print(f"warning: KNTPU_ANALYSIS_FAULT={env_fault} seeds the "
+              f"{_fault_engine(env_fault)} engine, which is not running "
+              f"in this invocation; no fault was seeded", file=sys.stderr)
+    elif env_fault and env_fault not in FAULTS and not running:
+        print("warning: KNTPU_ANALYSIS_FAULT is set but no seedable engine "
+              "is running in this invocation; no fault was seeded",
               file=sys.stderr)
 
     _pin_cpu_backend()
+    if args.write_equivalence:
+        from . import equiv
+
+        path = equiv.save_certificates(equiv.build_certificates())
+        print(f"equivalence certificates written: {path}")
+        return 0
     findings = _run(args.engine, args.paths, args.fault)
 
     if args.write_baseline:
@@ -121,17 +171,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     baseline = load_baseline(args.baseline)
+    stale_schema = schema_finding(baseline, args.baseline)
+    if stale_schema is not None:
+        # a stale-schema baseline cannot gate: refuse (typed finding, rc 1)
+        # instead of silently diffing against fingerprints written under a
+        # different law
+        findings = findings + [stale_schema]
+        baseline = {"fingerprints": []}
     new, stale = diff_vs_baseline(findings, baseline)
-    contract_fail = any(f.path.startswith("route:") for f in new)
-    lint_fail = any(not f.path.startswith("route:") for f in new)
+    contract_fail = any(f.path.startswith("route:") for f in new) \
+        or stale_schema is not None
+    lint_fail = any(not f.path.startswith("route:") for f in new
+                    if f.rule != "baseline-schema")
 
     if args.as_json:
         print(json.dumps({
+            "schema": JSON_SCHEMA,
             **analysis_stamp(),
             "engine": args.engine,
-            "findings": [f.to_json() for f in findings],
+            "findings": [{**f.to_json(), "fingerprint": f.fingerprint}
+                         for f in findings],
             "new": [f.fingerprint for f in new],
             "stale_baseline": stale,
+            "counts": {
+                "error": sum(1 for f in findings if f.severity == "error"),
+                "warning": sum(1 for f in findings
+                               if f.severity == "warning"),
+                "info": sum(1 for f in findings if f.severity == "info"),
+                "new": len(new),
+            },
             "ok": not (contract_fail or lint_fail),
         }, indent=2))
     else:

@@ -405,7 +405,7 @@ def _corrupt_scatter_map(plan):
 
 
 def _check_adaptive(ck: _Checker, points: np.ndarray, k: int,
-                    supercell: int) -> None:
+                    supercell: int, skip_eps: Tuple[str, ...] = ()) -> None:
     import jax
 
     from ..ops.adaptive import _solve_adaptive
@@ -421,6 +421,11 @@ def _check_adaptive(ck: _Checker, points: np.ndarray, k: int,
     counts = _abstract(grid.cell_counts)
     outs = {}
     for ep in ("gather", "scatter"):
+        if ep in skip_eps and ck.fault != "scatter-map":
+            # certified equivalent to the legacy core at this plan shape:
+            # the duplicate trace is collapsed (equivalence.json) -- except
+            # under a seeded fault, where the detector must still fire
+            continue
         fn = functools.partial(_solve_adaptive, n=n, k=k, exclude_self=True,
                                domain=grid.domain, interpret=False,
                                tile=cfg.stream_tile, kernel="kpass",
@@ -479,7 +484,7 @@ def _query_fixture(grid, plan, supercell: int, m: int = 96):
 
 
 def _check_query(ck: _Checker, points: np.ndarray, k: int,
-                 supercell: int) -> None:
+                 supercell: int, skip_eps: Tuple[str, ...] = ()) -> None:
     import jax
     import jax.numpy as jnp
 
@@ -496,6 +501,8 @@ def _check_query(ck: _Checker, points: np.ndarray, k: int,
             _abstract(inv_sc), pack, plan, _abstract(grid.permutation))
     outs = {}
     for ep in ("gather", "scatter"):
+        if ep in skip_eps:
+            continue
         fn = functools.partial(_query_packed, q2cap=q2cap, k=k,
                                exclude_hint=False, domain=grid.domain,
                                interpret=False, epilogue=ep)
@@ -516,18 +523,19 @@ def _check_query(ck: _Checker, points: np.ndarray, k: int,
     _check_tiles(ck, route, label, qcap=q2cap, ccap=pack.ccap, k=k)
 
 
-def _check_sharded(ck: _Checker, points: np.ndarray, k: int,
-                   supercell: int) -> None:
+def _sharded_fixture(points: np.ndarray, k: int, supercell: int):
+    """(cfg, abstract chip-ready state, chip plan) for the sharded per-chip
+    route -- the fixture both this engine and the equivalence engine
+    (analysis/equiv.py) trace ``_chip_solve`` against, with no jitted
+    program executed."""
     import jax
     import jax.numpy as jnp
 
     from ..config import DOMAIN_SIZE, KnnConfig
-    from ..parallel.sharded import (ShardMeta, _chip_ready_state, _chip_solve,
+    from ..parallel.sharded import (ShardMeta, _chip_ready_state,
                                     _measured_halo_depth, _partition_host,
                                     _plan_chip, _slab_bounds)
 
-    route = "sharded-chip"
-    label = f"k={k},s={supercell}"
     cfg = KnnConfig(k=k, supercell=supercell, interpret=True)
     grid, counts = _host_grid(points, cfg.density)
     dim, ndev = grid.dim, 2
@@ -555,10 +563,22 @@ def _check_sharded(ck: _Checker, points: np.ndarray, k: int,
     args = (sd((pcap, 3), f32), sd((pcap,), i32), sd((ncell,), i32),
             sd((hcap, 3), f32), sd((hcap,), i32), sd((radius * A,), i32),
             sd((hcap, 3), f32), sd((hcap,), i32), sd((radius * A,), i32))
+    state = jax.eval_shape(functools.partial(
+        _chip_ready_state, hcap=hcap, k=k), *args, classes=chip.classes)
+    return cfg, state, chip, pcap
+
+
+def _check_sharded(ck: _Checker, points: np.ndarray, k: int,
+                   supercell: int, skip_eps: Tuple[str, ...] = ()) -> None:
+    import jax
+
+    from ..config import DOMAIN_SIZE
+    from ..parallel.sharded import _chip_solve
+
+    route = "sharded-chip"
+    label = f"k={k},s={supercell}"
     try:
-        state = jax.eval_shape(functools.partial(
-            _chip_ready_state, hcap=hcap, k=k), *args,
-            classes=chip.classes)
+        cfg, state, chip, pcap = _sharded_fixture(points, k, supercell)
     except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
         ck.fail("route-shape", route,
                 f"[{label}] ready-state trace failed: "
@@ -567,6 +587,8 @@ def _check_sharded(ck: _Checker, points: np.ndarray, k: int,
         return
     outs = {}
     for ep in ("gather", "scatter"):
+        if ep in skip_eps:
+            continue
         fn = functools.partial(_chip_solve, k=k, exclude_self=True,
                                domain=DOMAIN_SIZE, interpret=False,
                                tile=cfg.stream_tile, kernel="kpass",
@@ -634,13 +656,26 @@ def _census(ck: _Checker, k: int, supercell: int) -> None:
 def run_contracts(fault: Optional[str] = None) -> List[Finding]:
     """Run every contract over the config matrix.  ``fault`` (or the
     KNTPU_ANALYSIS_FAULT env knob) seeds one deliberate violation --
-    the self-test hook proving each detector actually fires."""
+    the self-test hook proving each detector actually fires.
+
+    The committed equivalence certificates (analysis/equivalence.json,
+    built by the verify engine) collapse the route matrix: a route whose
+    core is certified equivalent to the legacy pack core at a plan shape
+    skips its duplicate scatter-epilogue trace there -- one trace per
+    plan shape instead of one per route (ROADMAP item 5's precondition).
+    A missing or stale certificate file collapses nothing: checking can
+    only widen, never narrow, without a committed proof."""
     import jax
+
+    from .verify import FAULTS as VERIFY_FAULTS
 
     fault = fault if fault is not None else _fault()
     if fault is not None and fault not in FAULTS:
-        raise ValueError(f"unknown analysis fault {fault!r}: "
-                         f"expected one of {FAULTS}")
+        if fault in VERIFY_FAULTS:
+            fault = None  # seeded into the verify engine, not this one
+        else:
+            raise ValueError(f"unknown analysis fault {fault!r}: "
+                             f"expected one of {FAULTS + VERIFY_FAULTS}")
     ck = _Checker(fault=fault)
     if jax.default_backend() != "cpu":
         # the whole point is a chip-free gate; a non-cpu backend means a
@@ -654,13 +689,31 @@ def run_contracts(fault: Optional[str] = None) -> List[Finding]:
                 f"before jax initializes (the CLI does this itself)",
                 subject="env:backend")
         return ck.findings
+    from . import equiv
+
+    cert = equiv.load_certificates()
     pts = _points(_SEEDS[0])
+    traced = collapsed = 0
     for k in (8, 50):
         for supercell in (2, 3):
             _check_legacy(ck, pts, k, supercell)
-            _check_adaptive(ck, pts, k, supercell)
-            _check_query(ck, pts, k, supercell)
-            _check_sharded(ck, pts, k, supercell)
+            skips = {}
+            for route, checker in (("adaptive", _check_adaptive),
+                                   ("external-query", _check_query),
+                                   ("sharded-chip", _check_sharded)):
+                skip = ("scatter",) if equiv.covers(
+                    cert, k, supercell, route, "legacy-pack") else ()
+                skips[route] = skip
+                traced += 2 - len(skip)
+                collapsed += len(skip)
+                checker(ck, pts, k, supercell, skip_eps=skip)
+            traced += 2  # the legacy representative always traces both
+    if collapsed:
+        ck.info("matrix-collapse", "equivalence",
+                f"route matrix collapsed by certificate: {traced} epilogue "
+                f"traces ran, {collapsed} skipped as certified equivalent "
+                f"to the legacy core (analysis/equivalence.json)",
+                subject="matrix:collapse")
     _check_resolution(ck)
     _census(ck, 8, 3)
     return ck.findings

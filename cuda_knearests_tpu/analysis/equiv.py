@@ -1,0 +1,426 @@
+"""Cross-route jaxpr equivalence certificates (four routes, one core).
+
+ROADMAP item 5 wants the four kNN routes collapsed onto one plan ->
+dispatch IR; the refactor is safe exactly when the routes provably lower
+to the same compute core today.  This module produces that proof object:
+
+* :func:`canonical_hash` -- a canonical form for jaxprs: alpha-renaming
+  falls out of hash-consing (a var's identity is the hash of its
+  producing equation), CSE falls out of memoizing identical equations,
+  commutative primitives sort their operand ids, and (optionally) array
+  dimensions are renamed to symbols in order of first appearance so the
+  same program at two capacities normalizes identically.
+
+* :func:`route_cores` -- extracts each route's *compute cores*: the
+  ``pallas_call`` equations inside its abstractly-traced solve (kernel
+  name, block shapes, canonical hash of the inner kernel jaxpr).  The
+  gather epilogue launches ``_kernel`` (the (1, k, Q)-block top-k pass),
+  the scatter epilogue ``_kernel_rows`` (row-major blocks at
+  scalar-prefetched offsets) -- the *epilogue-permutation normalization*:
+  cores are grouped per epilogue family, because scatter's forward map
+  (``ClassPlan.tgt`` / ``pack.tgt``) and gather's row maps are mutually
+  inverse permutations whose agreement the contract engine's
+  ``epilogue-agree`` rule and the byte-identity tests already pin; the
+  certificate factors them out by comparing within a family.
+
+* :func:`build_certificates` -- per plan-shape cell (k x supercell), every
+  route is traced (zero execution, the contract engine's fixtures), its
+  cores are *bound* to the shared launch functions (the standalone
+  ``_pallas_topk`` / ``_topk_rows_or_transpose`` trace at the route's own
+  capacities must hash identically -- proving the route launches THE
+  shared core, not a lookalike), and route pairs whose normalized core
+  sets coincide are certified.  The result is written to the committed
+  ``analysis/equivalence.json``; the verify engine regenerates and diffs
+  it (a mismatch is a ``route-diverge`` finding), and the contract engine
+  collapses its route matrix across certified pairs (one epilogue trace
+  per plan shape instead of one per route).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+EQUIV_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "equivalence.json")
+EQUIV_SCHEMA = 1
+
+# The (k, supercell) plan-shape matrix -- matches contracts.run_contracts.
+MATRIX: Tuple[Tuple[int, int], ...] = ((8, 2), (8, 3), (50, 2), (50, 3))
+
+ROUTES = ("legacy-pack", "adaptive", "external-query", "sharded-chip")
+
+# Primitives whose operand order is semantically irrelevant: canonical
+# form sorts their input ids so `a + b` and `b + a` hash identically.
+_COMMUTATIVE = {"add", "mul", "max", "min", "and", "or", "xor", "eq", "ne"}
+
+_ADDR_RE = re.compile(r"0x[0-9a-f]+")
+
+
+def _sha(*parts: Any) -> str:
+    return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
+
+
+def _norm_scalar(v: Any, dims: Optional[Dict[int, str]]) -> Any:
+    """Normalize an int through the dim-symbol map when it matches an
+    observed array dimension (>= 8 filters out axis indices and small
+    structural constants, which must stay concrete)."""
+    if dims is not None and isinstance(v, (int, np.integer)) \
+            and not isinstance(v, bool) and int(v) >= 8 \
+            and int(v) in dims:
+        return dims[int(v)]
+    return v
+
+
+def _norm_param(v: Any, dims: Optional[Dict[int, str]]) -> Any:
+    from jax._src import core as jcore
+
+    if isinstance(v, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+        return ("jaxpr", canonical_hash(v, normalize_dims=dims is not None))
+    if isinstance(v, (list, tuple)):
+        return tuple(_norm_param(x, dims) for x in v)
+    if isinstance(v, dict):
+        # src-location params (file:line of the traced function) would flip
+        # every hash on unrelated line shifts -- the certificate is about
+        # program STRUCTURE, so they are excluded (route_cores reports the
+        # kernel name separately)
+        return tuple(sorted((k, _norm_param(x, dims)) for k, x in v.items()
+                            if k != "name_and_src_info"))
+    if isinstance(v, np.ndarray):
+        return ("ndarray", str(v.dtype), v.shape,
+                hashlib.sha256(np.ascontiguousarray(v).tobytes())
+                .hexdigest()[:16])
+    if callable(v):
+        return ("fn", getattr(v, "__name__", type(v).__name__))
+    if isinstance(v, (int, np.integer)):
+        return _norm_scalar(v, dims)
+    if isinstance(v, (str, float, bool, type(None), np.floating)):
+        return v
+    # opaque param objects (grid mappings, src info): strip memory
+    # addresses so the form is stable across processes
+    return _ADDR_RE.sub("0xX", str(v))
+
+
+def canonical_hash(jaxpr: Any, normalize_dims: bool = False) -> str:
+    """Canonical content hash of a jaxpr (see module docstring).
+
+    With ``normalize_dims`` every array dimension is renamed to a symbol
+    in order of first appearance (and integer params/literals matching an
+    observed dimension follow it), so the same program traced at two
+    capacities hashes identically as long as its *structure* agrees.
+    """
+    from jax._src import core as jcore
+
+    if isinstance(jaxpr, jcore.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    dims: Optional[Dict[int, str]] = {} if normalize_dims else None
+
+    def aval_key(v) -> Tuple:
+        aval = v.aval
+        shape = tuple(getattr(aval, "shape", ()))
+        if dims is not None:
+            shape = tuple(dims.setdefault(int(d), f"D{len(dims)}")
+                          if isinstance(d, (int, np.integer)) else str(d)
+                          for d in shape)
+        return (str(getattr(aval, "dtype", type(aval).__name__)), shape)
+
+    ids: Dict[Any, str] = {}
+    for i, v in enumerate(jaxpr.invars):
+        ids[v] = _sha("in", i, aval_key(v))
+    for i, v in enumerate(jaxpr.constvars):
+        ids[v] = _sha("const", i, aval_key(v))
+
+    def vid(v) -> str:
+        if isinstance(v, jcore.Literal):
+            val = v.val
+            if isinstance(val, np.ndarray):
+                return _sha("lit", _norm_param(val, dims))
+            return _sha("lit", _norm_scalar(val, dims), str(v.aval))
+        return ids[v]
+
+    memo: Dict[Tuple, str] = {}
+    seq: List[str] = []
+    for eqn in jaxpr.eqns:
+        ins = [vid(v) for v in eqn.invars]
+        if eqn.primitive.name in _COMMUTATIVE:
+            ins = sorted(ins)
+        key = (eqn.primitive.name, tuple(ins),
+               _norm_param(dict(eqn.params), dims),
+               tuple(aval_key(o) for o in eqn.outvars))
+        h = memo.get(key)
+        if h is None:
+            h = memo[key] = _sha(*key)
+        seq.append(h)
+        for j, o in enumerate(eqn.outvars):
+            ids[o] = f"{h}#{j}"
+    # the hash covers the FULL equation sequence, not just the output
+    # cone: kernel jaxprs write through ref side effects and have no
+    # outvars at all, so an output-cone hash would blindly equate every
+    # kernel (identical equations collapse through the CSE memo above)
+    return _sha("out", tuple(vid(v) for v in jaxpr.outvars), tuple(seq))
+
+
+# -- core extraction ----------------------------------------------------------
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for x in vs:
+                inner = getattr(x, "jaxpr", None)
+                if inner is not None:
+                    yield from _walk_eqns(inner)
+
+
+def route_cores(closed_jaxpr) -> List[Dict[str, Any]]:
+    """The ``pallas_call`` compute cores inside a traced route, each as
+    {kernel, in_shapes, out_shapes, hash (concrete), norm_hash
+    (dim-symbolized)} -- sorted for deterministic comparison."""
+    out = []
+    for eqn in _walk_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        inner = eqn.params["jaxpr"]
+        name = str(eqn.params.get("name_and_src_info", "kernel")).split()[0]
+        out.append({
+            "kernel": name,
+            "in_shapes": [list(getattr(v.aval, "shape", ()))
+                          for v in eqn.invars],
+            "out_shapes": [list(a.shape)
+                           for a in eqn.params.get("out_avals", ())],
+            "hash": canonical_hash(inner, normalize_dims=False),
+            "norm_hash": canonical_hash(inner, normalize_dims=True),
+        })
+    out.sort(key=lambda c: (c["kernel"], c["hash"]))
+    return out
+
+
+# -- route tracing (zero program execution) -----------------------------------
+
+def _trace_legacy(points: np.ndarray, k: int, supercell: int,
+                  epilogue: str):
+    import jax
+
+    from ..ops.pallas_solve import _solve_packed
+    from .contracts import _abstract, _legacy_fixture
+
+    cfg, grid, plan, pack = _legacy_fixture(points, k, supercell)
+    fn = functools.partial(_solve_packed, k=k, exclude_self=True,
+                           domain=grid.domain, interpret=False,
+                           kernel="kpass", epilogue=epilogue)
+    return jax.make_jaxpr(fn)(pack, _abstract(grid.points))
+
+
+def _trace_adaptive(points: np.ndarray, k: int, supercell: int,
+                    epilogue: str):
+    import jax
+
+    from ..ops.adaptive import _solve_adaptive
+    from .contracts import _abstract, _adaptive_fixture
+
+    cfg, grid, plan = _adaptive_fixture(points, k, supercell)
+    fn = functools.partial(_solve_adaptive, n=grid.n_points, k=k,
+                           exclude_self=True, domain=grid.domain,
+                           interpret=False, tile=cfg.stream_tile,
+                           kernel="kpass", epilogue=epilogue)
+    return jax.make_jaxpr(fn)(
+        _abstract(grid.points), _abstract(grid.cell_starts),
+        _abstract(grid.cell_counts), plan.classes, plan.inv_row,
+        plan.inv_box)
+
+
+def _trace_query(points: np.ndarray, k: int, supercell: int,
+                 epilogue: str):
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.query import _query_packed
+    from .contracts import _abstract, _legacy_fixture, _query_fixture
+
+    cfg, grid, plan, pack = _legacy_fixture(points, k, supercell)
+    queries, sc_counts, starts, q2cap, inv_flat, inv_sc = _query_fixture(
+        grid, plan, supercell)
+    args = (jax.ShapeDtypeStruct((queries.shape[0], 3), jnp.float32),
+            _abstract(starts), _abstract(sc_counts), _abstract(inv_flat),
+            _abstract(inv_sc), pack, plan, _abstract(grid.permutation))
+    fn = functools.partial(_query_packed, q2cap=q2cap, k=k,
+                           exclude_hint=False, domain=grid.domain,
+                           interpret=False, epilogue=epilogue)
+    return jax.make_jaxpr(fn)(*args)
+
+
+def _trace_sharded(points: np.ndarray, k: int, supercell: int,
+                   epilogue: str):
+    import jax
+
+    from ..config import DOMAIN_SIZE
+    from ..parallel.sharded import _chip_solve
+    from .contracts import _sharded_fixture
+
+    cfg, state, chip, _pcap = _sharded_fixture(points, k, supercell)
+    fn = functools.partial(_chip_solve, k=k, exclude_self=True,
+                           domain=DOMAIN_SIZE, interpret=False,
+                           tile=cfg.stream_tile, kernel="kpass",
+                           epilogue=epilogue)
+    return jax.make_jaxpr(fn)(*state)
+
+
+_TRACERS = {
+    "legacy-pack": _trace_legacy,
+    "adaptive": _trace_adaptive,
+    "external-query": _trace_query,
+    "sharded-chip": _trace_sharded,
+}
+
+
+def _shared_launch_cores(points: np.ndarray, k: int,
+                         supercell: int) -> Dict[str, List[str]]:
+    """Concrete core hashes of the SHARED launch functions traced
+    standalone at the legacy fixture's capacities -- the binding
+    reference: a route core matching one of these provably launches the
+    shared kernel, not a reimplementation."""
+    import jax
+
+    from ..ops.pallas_solve import (_pallas_topk, _topk_rows_or_transpose,
+                                    launch_row_out)
+    from .contracts import _abstract, _legacy_fixture
+
+    cfg, grid, plan, pack = _legacy_fixture(points, k, supercell)
+    blocks = tuple(_abstract(b) for b in
+                   (pack.qx, pack.qy, pack.qz, pack.cx, pack.cy, pack.cz,
+                    pack.qid3, pack.cid3))
+    out: Dict[str, List[str]] = {"gather": [], "scatter": []}
+    j = jax.make_jaxpr(functools.partial(
+        _pallas_topk, qcap=pack.qcap, ccap=pack.ccap, k=k,
+        exclude_self=True, interpret=False))(*blocks)
+    out["gather"] = [c["hash"] for c in route_cores(j)]
+    if launch_row_out(pack.qcap, pack.ccap, k, "kpass", "scatter"):
+        j = jax.make_jaxpr(functools.partial(
+            _topk_rows_or_transpose, qcap=pack.qcap, ccap=pack.ccap, k=k,
+            exclude_self=True, interpret=False, kernel="kpass"))(
+            *blocks, q_ok=_abstract(pack.q_ok))
+        out["scatter"] = [c["hash"] for c in route_cores(j)]
+    return out
+
+
+def build_certificates(fault: Optional[str] = None) -> Dict[str, Any]:
+    """The full certificate object (the content of equivalence.json).
+
+    Per (k, supercell) cell and epilogue family: each route's cores, the
+    shared-launch binding verdict for the routes whose capacities match
+    the reference trace, and the certified pairs (equal normalized core
+    sets).  ``fault='route-diverge'`` perturbs one route's cores -- the
+    self-test hook proving the divergence detector fires."""
+    from .contracts import _SEEDS, _points
+
+    points = _points(_SEEDS[0])
+    cells: List[Dict[str, Any]] = []
+    for k, supercell in MATRIX:
+        cell: Dict[str, Any] = {"k": k, "supercell": supercell,
+                                "families": {}}
+        shared = _shared_launch_cores(points, k, supercell)
+        for epilogue in ("gather", "scatter"):
+            routes: Dict[str, List[Dict[str, Any]]] = {}
+            trace_hashes: Dict[str, str] = {}
+            for route, tracer in _TRACERS.items():
+                jx = tracer(points, k, supercell, epilogue)
+                cores = route_cores(jx)
+                # the FULL-trace hash pins the route's entire abstract
+                # program -- epilogue placement, forward-map application,
+                # assembly -- not just the kernel cores.  This is what
+                # licenses the contract engine's matrix collapse: a
+                # certified route's skipped scatter trace is still diffed
+                # byte-for-byte against the blessed state on every verify
+                # run (an epilogue regression outside the kernel core
+                # flips this hash and gates as route-diverge)
+                trace_hashes[route] = canonical_hash(jx)
+                if fault == "route-diverge" and route == "adaptive":
+                    cores = [dict(c, hash=c["hash"] + "-faulted",
+                                  norm_hash=c["norm_hash"] + "-faulted")
+                             for c in cores]
+                    trace_hashes[route] += "-faulted"
+                routes[route] = cores
+            bound = sorted(
+                route for route, cores in routes.items()
+                if shared[epilogue]
+                and any(c["hash"] in shared[epilogue] for c in cores))
+            pairs = []
+            names = sorted(routes)
+            for i, a in enumerate(names):
+                for b in names[i + 1:]:
+                    ha = {c["norm_hash"] for c in routes[a]}
+                    hb = {c["norm_hash"] for c in routes[b]}
+                    if ha and ha == hb:
+                        pairs.append([a, b])
+            cell["families"][epilogue] = {
+                "cores": {r: [{kk: c[kk] for kk in
+                               ("kernel", "hash", "norm_hash")}
+                              for c in cs] for r, cs in routes.items()},
+                "trace_hashes": trace_hashes,
+                "shared_launch": shared[epilogue],
+                "bound_to_shared": bound,
+                "pairs": pairs,
+            }
+        cells.append(cell)
+    return {"schema": EQUIV_SCHEMA, "cells": cells}
+
+
+# -- certificate persistence + queries ----------------------------------------
+
+def save_certificates(cert: Dict[str, Any],
+                      path: Optional[str] = None) -> str:
+    path = path or EQUIV_PATH
+    with open(path, "w") as f:
+        json.dump(cert, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_certificates(path: Optional[str] = None) -> Optional[Dict]:
+    """The committed certificate object, or None when absent/stale-schema
+    (callers then run the FULL route matrix -- missing certificates can
+    only ever widen checking, never narrow it)."""
+    try:
+        with open(path or EQUIV_PATH) as f:
+            data = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+    if data.get("schema") != EQUIV_SCHEMA:
+        return None
+    return data
+
+
+def certified_pairs(cert: Optional[Dict], k: int, supercell: int,
+                    epilogue: str) -> List[Tuple[str, str]]:
+    """The certified route pairs of one plan-shape cell."""
+    if not cert:
+        return []
+    for cell in cert.get("cells", ()):
+        if cell.get("k") == k and cell.get("supercell") == supercell:
+            fam = cell.get("families", {}).get(epilogue, {})
+            return [tuple(p) for p in fam.get("pairs", ())]
+    return []
+
+
+def covers(cert: Optional[Dict], k: int, supercell: int, route_a: str,
+           route_b: str) -> bool:
+    """True when (route_a, route_b) is certified equivalent at this plan
+    shape for BOTH epilogue families that exist in the certificate --
+    the precondition for the contract engine to collapse the pair's
+    duplicate traces."""
+    if not cert:
+        return False
+    pair = tuple(sorted((route_a, route_b)))
+    for epilogue in ("gather", "scatter"):
+        ps = [tuple(sorted(p)) for p in
+              certified_pairs(cert, k, supercell, epilogue)]
+        if pair not in ps:
+            return False
+    return True

@@ -27,7 +27,14 @@ from typing import Iterable, List, Optional, Tuple
 # Version of the analysis subsystem: bump on any rule/contract change so
 # bench artifacts (which stamp it, see bench.py) are traceable to the
 # exact gate a tree passed.
-ANALYSIS_VERSION = "1.0.0"
+ANALYSIS_VERSION = "2.0.0"
+
+# Schema of the committed baseline file.  Bumped whenever the fingerprint
+# law changes (occurrence indexing, subject hashing, ...): a baseline
+# written under an older law could silently accept findings it never
+# reviewed, so the gate REFUSES stale-schema baselines with a typed
+# finding instead of diffing against them (see cli.py / schema_finding).
+BASELINE_SCHEMA = 2
 
 _BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "baseline.json")
@@ -108,11 +115,35 @@ def load_baseline(path: Optional[str] = None) -> dict:
     except FileNotFoundError:
         # a missing baseline means 'no accepted findings', not an error --
         # the gate is simply at its strictest
-        return {"version": ANALYSIS_VERSION, "fingerprints": []}
+        return {"version": ANALYSIS_VERSION, "schema": BASELINE_SCHEMA,
+                "fingerprints": []}
     if not isinstance(data.get("fingerprints"), list):
         raise ValueError(f"malformed baseline {path}: 'fingerprints' must "
                          f"be a list")
     return data
+
+
+def schema_finding(baseline: dict, path: Optional[str] = None
+                   ) -> Optional[Finding]:
+    """The typed refusal for a stale-schema baseline (None when current).
+
+    A baseline written under an older fingerprint law cannot be diffed
+    against -- its accepted set might silently cover findings it never
+    reviewed -- so the gate fails with THIS finding instead of passing."""
+    schema = baseline.get("schema")
+    if schema == BASELINE_SCHEMA:
+        return None
+    path = path or _BASELINE_PATH
+    return Finding(
+        rule="baseline-schema", severity="error",
+        path=os.path.relpath(path, os.getcwd()) if os.path.isabs(path)
+        else path, line=0,
+        message=f"baseline schema {schema!r} != current {BASELINE_SCHEMA}: "
+                f"its accepted fingerprints were written under a different "
+                f"fingerprint law and cannot gate this tree",
+        hint="re-bless with --write-baseline (review the diff: every "
+             "previously-accepted finding must be re-justified)",
+        subject=f"baseline-schema:{schema!r}")
 
 
 def save_baseline(findings: Iterable[Finding],
@@ -120,6 +151,7 @@ def save_baseline(findings: Iterable[Finding],
     path = path or _BASELINE_PATH
     data = {
         "version": ANALYSIS_VERSION,
+        "schema": BASELINE_SCHEMA,
         "fingerprints": sorted(fp for _, fp in
                                indexed_fingerprints(findings)),
     }
@@ -137,7 +169,8 @@ def analysis_stamp() -> dict:
     environment -- supervised workers inherit it verbatim).  Cheap: reads
     one file, runs nothing."""
     return {"analysis_version": ANALYSIS_VERSION,
-            "analysis_baseline": baseline_hash()}
+            "analysis_baseline": baseline_hash(),
+            "analysis_equivalence": equivalence_hash()}
 
 
 def baseline_hash(path: Optional[str] = None) -> str:
@@ -145,6 +178,20 @@ def baseline_hash(path: Optional[str] = None) -> str:
     artifacts so a measured row is traceable to the exact accepted-findings
     set of the tree it ran on."""
     path = path or _BASELINE_PATH
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()[:12]
+    except FileNotFoundError:
+        return "none"
+
+
+def equivalence_hash() -> str:
+    """Short content hash of the committed cross-route equivalence
+    certificates (analysis/equivalence.json) -- stamped into bench rows so
+    a measured row is traceable to the exact certified route matrix of
+    the tree it ran on.  Cheap: reads one file, runs nothing."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "equivalence.json")
     try:
         with open(path, "rb") as f:
             return hashlib.sha256(f.read()).hexdigest()[:12]

@@ -1,0 +1,512 @@
+"""Host-boundary dataflow model: the static half of the sync/transfer proof.
+
+"Memory Safe Computations with XLA" (arXiv 2206.14148, PAPERS.md) proves
+resource properties of an XLA program from its IR before execution; PR 5
+made the engine's host-boundary traffic *countable* at runtime
+(``runtime/dispatch.py``).  This module makes it *provable* before any
+program runs, in three layers:
+
+1. **Site discovery** (:func:`discover_sites`): an AST walk over the engine
+   package finds every call to the sanctioned transfer primitives
+   (``dispatch.fetch`` / ``dispatch.stage``) and every raw readback
+   (``jax.device_get`` / ``from_device``).  Each sanctioned site must carry
+   a ``# syncflow: <site-id>`` annotation naming it into the model's
+   vocabulary; each raw readback must be registered in :data:`KNOWN_RAW`
+   with a reason (they are all prepare-time or extraction surfaces --
+   *never* inside a solve window).  An unregistered transfer is a
+   ``sync-leak`` finding: a host sync the proof does not account for.
+
+2. **Host-boundary dataflow graph** (:data:`WINDOWS`): each solve window
+   (the adaptive / legacy-pack solve, the adaptive and chunked external
+   query, the sharded solve/query, FoF, and the serving batch path)
+   declares which sites it reaches, each with a symbolic *multiplicity*
+   and *byte volume* in the problem parameters (n, q, k, chunks, classes,
+   rounds, and the fallback/tombstone/delta indicators).  A static call
+   graph (:func:`build_call_graph`) walked from each window's entry point
+   proves the claim set complete: a dispatch site reachable from a
+   window's entry but absent from its model is a ``sync-leak``.
+
+3. **Symbolic bounds** (:meth:`Window.syncs_bound`): the proven per-window
+   ``host_syncs`` expression.  Every kNN window proves ``1 + fb`` (fb =
+   the 0/1 fallback-resolution indicator) <= ``SYNC_BUDGET`` = 2; FoF
+   proves exactly ``rounds + 1``; the serving batch path proves
+   ``(1 + fb) + tomb + delta <= 4``.  The bounds must *dominate* the
+   runtime counters everywhere and *equal* them on the 20k fixture --
+   tests/test_verify.py reconciles them per site against
+   ``dispatch.trace_sites()`` records.
+
+Everything here is host-only ``ast`` work: no jax import, no tracing, no
+program execution.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_NAME = os.path.basename(_PKG_ROOT)
+
+# Modules the dataflow model covers: every file whose code can run inside a
+# solve window.  analysis/ itself, the fuzz/bench harnesses, and the CLI
+# surfaces are out of scope (they *wrap* solve windows; their own fetches
+# would double-count the windows they measure).
+SCOPE = ("api.py", "ops", "parallel", "cluster", "serve", "runtime")
+
+_ANNOT_RE = re.compile(r"#\s*syncflow:\s*([A-Za-z0-9_-]+)")
+_DISPATCH_ALIASES = ("_dispatch", "dispatch")
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscoveredSite:
+    """One transfer call site found in the source tree."""
+
+    path: str        # repo-relative, forward slashes
+    line: int
+    qualname: str    # module-dotted, e.g. 'ops.query.query_knn'
+    kind: str        # 'fetch' | 'stage' | 'raw'
+    site_id: Optional[str]   # the `# syncflow:` annotation, if any
+    in_loop: bool    # lexically inside a for/while loop
+
+
+@dataclasses.dataclass(frozen=True)
+class SiteSpec:
+    """A window's claim on one site: how often it fires per window and how
+    many bytes ride it, symbolically in the window parameters."""
+
+    kind: str        # 'fetch' | 'stage'
+    mult: str        # symbolic count per window, e.g. '1', 'fb', 'rounds'
+    bytes: str       # symbolic byte volume per window
+
+
+@dataclasses.dataclass(frozen=True)
+class Window:
+    """One solve window's host-boundary dataflow graph."""
+
+    entries: Tuple[str, ...]          # call-graph roots (qualnames)
+    sites: Dict[str, SiteSpec]        # site_id -> claim
+    syncs: str                        # proven host_syncs expression
+    budget: str                       # the budget it must stay within
+    includes: Tuple[str, ...] = ()    # sub-windows reached through edges
+    # the call graph cannot resolve (documented attribute dispatch)
+    notes: str = ""
+
+    def all_site_ids(self, windows: Dict[str, "Window"]) -> Set[str]:
+        """This window's claimed site ids, includes-closure."""
+        out = set(self.sites)
+        for inc in self.includes:
+            out |= windows[inc].all_site_ids(windows)
+        return out
+
+    def syncs_bound(self, env: Dict[str, int]) -> int:
+        """The proven host_syncs count under ``env`` bindings."""
+        return int(evaluate(self.syncs, env))
+
+
+# Window parameters (the symbolic vocabulary of every expression below):
+#   n        stored points            q       external queries
+#   k        neighbors per row        chunks  query chunks (1 = single shot)
+#   classes  class launches issued    kern    1 when the kernel route ran
+#   fb       1 when the brute fallback resolved uncertified rows
+#   u_pad    fallback rows padded to a power of two (api._pad_pow2)
+#   u_q      fallback query rows (exact count, external-query routes)
+#   rounds   FoF pointer-jumping rounds until convergence
+#   tomb     1 when a serving row touched a deleted point
+#   delta    1 when the dirty-cell bound could not prune the delta launch
+PARAMS = ("n", "q", "k", "chunks", "classes", "kern", "fb", "u_pad", "u_q",
+          "rounds", "tomb", "delta")
+
+WINDOWS: Dict[str, Window] = {
+    # KnnProblem.solve() -- shared by the adaptive and legacy-pack routes:
+    # both assemble device-resident and read back through _finalize's one
+    # batched fetch, plus one more iff uncertified rows resolve.
+    "solve": Window(
+        entries=("api.KnnProblem.solve",),
+        sites={
+            "solve-final": SiteSpec("fetch", "1", "8*n*k + n + 4"),
+            "solve-fallback": SiteSpec("fetch", "fb", "8*u_pad*k"),
+            "solve-fallback-stage": SiteSpec("stage", "fb", "4*u_pad"),
+        },
+        syncs="1 + fb", budget="2"),
+    # query_adaptive: per-class launches scatter into device-resident
+    # (q, k) buffers; one batched readback, one optional fallback fetch.
+    "query-adaptive": Window(
+        entries=("ops.adaptive.query_adaptive",),
+        sites={
+            "adaptive-query-final": SiteSpec("fetch", "1", "8*q*k + q"),
+            "adaptive-query-fallback": SiteSpec("fetch", "fb", "8*u_q*k"),
+            "adaptive-query-fallback-stage": SiteSpec(
+                "stage", "fb", "12*u_q"),
+            "query-class-stage": SiteSpec("stage", "5*classes", "0"),
+            "adaptive-query-place-stage": SiteSpec("stage", "classes", "0"),
+        },
+        syncs="1 + fb", budget="2"),
+    # query_knn (single-shot and chunked): all chunks' results ride ONE
+    # batched fetch; kernel-route uncertified rows cost one more.
+    "query-chunked": Window(
+        entries=("ops.query.query_knn",),
+        sites={
+            "query-final": SiteSpec("fetch", "1", "8*q*k + kern*q"),
+            "query-fallback": SiteSpec("fetch", "fb", "8*u_q*k"),
+            "query-fallback-stage": SiteSpec("stage", "fb", "12*u_q"),
+            "query-launch-stage": SiteSpec("stage", "4*chunks*kern", "0"),
+            "query-chunk-stage": SiteSpec("stage", "chunks", "12*q"),
+        },
+        syncs="1 + fb", budget="2"),
+    # sharded solve: every chip slab collects in one batched fetch;
+    # uncertified rows resolve against the HOST kd-tree (zero syncs).
+    "sharded-solve": Window(
+        entries=("parallel.sharded.ShardedKnnProblem.solve",),
+        sites={"sharded-solve-final": SiteSpec("fetch", "1", "0")},
+        syncs="1", budget="2"),
+    # sharded query: per-chip per-class launches (launch_class_query, the
+    # shared front half -- its stage site is claimed here too) collect in
+    # one batched fetch; resolution is the host oracle (zero syncs).
+    "sharded-query": Window(
+        entries=("parallel.sharded.ShardedKnnProblem.query",),
+        sites={
+            "sharded-query-final": SiteSpec("fetch", "1", "0"),
+            "query-class-stage": SiteSpec("stage", "5*classes", "0"),
+        },
+        syncs="1", budget="2"),
+    # FoF: the per-round convergence flag is the ONLY mid-solve host
+    # traffic; the labels/sizes ride one final batched fetch.  The proven
+    # count is exact, not just a bound: rounds + 1.
+    "fof": Window(
+        entries=("cluster.fof.fof_labels",),
+        sites={
+            "fof-round": SiteSpec("fetch", "rounds", "rounds"),
+            "fof-final": SiteSpec("fetch", "1", "8*n"),
+            "fof-stage": SiteSpec("stage", "4", "0"),
+        },
+        syncs="rounds + 1", budget="rounds + 1"),
+    # Serving overlay query: the base problem's query window, plus one
+    # fetch iff a row touched a tombstone, plus one iff the dirty-cell
+    # bound could not prune the delta launch.
+    "serve-overlay-query": Window(
+        entries=("serve.delta.DeltaOverlay.query",),
+        includes=("query-chunked",),
+        sites={
+            "overlay-resolve": SiteSpec("fetch", "tomb", "8*q*k"),
+            "overlay-resolve-stage": SiteSpec("stage", "tomb", "0"),
+            "overlay-alive-stage": SiteSpec("stage", "2*tomb", "0"),
+            "overlay-delta-final": SiteSpec("fetch", "delta", "8*q*k"),
+            "overlay-delta-stage": SiteSpec("stage", "2*delta", "0"),
+            "overlay-delta-query-stage": SiteSpec("stage", "delta", "12*q"),
+        },
+        syncs="(1 + fb) + tomb + delta", budget="4",
+        notes="base.query resolves through an attribute the call graph "
+              "cannot follow; declared via includes and pinned by the "
+              "serve byte-identity tests"),
+    # One serving batch: exactly the overlay query window (sentinel-padded
+    # to the bucket capacity; padding changes bytes, never sync counts).
+    "serve-batch": Window(
+        entries=("serve.daemon.ServeDaemon._execute",),
+        includes=("serve-overlay-query",),
+        sites={},
+        syncs="(1 + fb) + tomb + delta", budget="4",
+        notes="_run_batch -> overlay.query is attribute dispatch; "
+              "declared via includes"),
+}
+
+# Which model window proves each runtime route's bound -- the route names
+# match bench.py rows and the dispatch smoke's labels.
+ROUTE_WINDOWS: Dict[str, str] = {
+    "adaptive-solve": "solve",
+    "legacy-pack-solve": "solve",
+    "external-query-adaptive": "query-adaptive",
+    "external-query-chunked": "query-chunked",
+    "sharded-solve": "sharded-solve",
+    "sharded-query": "sharded-query",
+    "fof": "fof",
+    "serve-batch": "serve-batch",
+}
+
+# Sanctioned dispatch sites that live OUTSIDE every solve window: lazy
+# reconstruction and post-solve extraction surfaces.  They are reachable
+# from window entries (solve() -> plane feed -> _host_original), so the
+# reachability check reports them as info, never as leaks.
+NONWINDOW: Dict[str, str] = {
+    "host-original": "checkpoint-resumed problems reconstruct original-"
+                     "order host points lazily, one counted fetch, cached; "
+                     "prepared problems keep the validated input by "
+                     "reference (zero syncs)",
+    "extract-original": "get_knearests_original(): post-solve extraction "
+                        "readback of the (host-resident) result plus the "
+                        "permutation -- outside the solve window by the "
+                        "timing contract",
+}
+
+# Raw readbacks (jax.device_get / from_device) the model accepts, by
+# enclosing qualname: all prepare-time planning reads or explicitly waived
+# diagnostics -- NEVER inside a solve window.  A raw readback in scope but
+# absent here is a sync-leak finding (an uncounted host sync).
+KNOWN_RAW: Dict[str, str] = {
+    "api.KnnProblem.prepare": "oracle backend: kd-tree build reads the "
+                              "staged points once at prepare time",
+    "api.KnnProblem._query_ids": "oracle backend: permutation readback on "
+                                 "the host-native kd-tree route (the grid "
+                                 "engine never takes this branch)",
+    "api.KnnProblem.get_points": "extraction surface (reference parity)",
+    "api.KnnProblem.get_permutation": "extraction surface",
+    "api.KnnProblem.get_knearests": "extraction surface",
+    "api.KnnProblem.get_dists_sq": "extraction surface",
+    "api.save_problem": "checkpointing reads the grid once",
+    "api.load_problem": "oracle backend resume: kd-tree rebuild",
+    "ops.adaptive.build_adaptive_plan": "prepare-time cell-count readback "
+                                        "when no host census is supplied",
+    "ops.solve.global_schedule": "prepare-time cell-count readback when "
+                                 "no host census is supplied",
+    "parallel.sharded.ShardedKnnProblem.prepare": "prepare-time partition "
+                                                  "census readback",
+    "parallel.sharded.ShardedKnnProblem.stats": "waived diagnostics "
+                                                "(kntpu-ok markers)",
+    "parallel.sharded.ShardedKnnProblem.permutation": "extraction surface "
+                                                      "(multi-chip "
+                                                      "kn_get_permutation)",
+}
+
+
+def evaluate(expr: str, env: Dict[str, int]) -> int:
+    """Evaluate a symbolic expression over integer bindings.  The grammar
+    is +, *, //, parentheses, max(), and :data:`PARAMS` names -- enforced
+    by eval'ing with empty builtins over exactly the declared vocabulary."""
+    scope = {p: int(env.get(p, 0)) for p in PARAMS}
+    scope["max"] = max
+    return int(eval(expr, {"__builtins__": {}}, scope))  # noqa: S307 -- closed grammar over PARAMS, no attribute access
+
+
+def worst_case_env(rounds: int = 64) -> Dict[str, int]:
+    """Indicator variables at their maxima -- what the budget proof binds."""
+    return dict(fb=1, tomb=1, delta=1, kern=1, rounds=rounds,
+                chunks=8, classes=8, n=1, q=1, k=1, u_pad=1, u_q=1)
+
+
+# -- discovery ----------------------------------------------------------------
+
+def _scope_files() -> List[str]:
+    out = []
+    for entry in SCOPE:
+        p = os.path.join(_PKG_ROOT, entry)
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames) if f.endswith(".py"))
+    return sorted(out)
+
+
+def _module_name(path: str) -> str:
+    rel = os.path.relpath(path, _PKG_ROOT)
+    return rel[:-3].replace(os.sep, ".").removesuffix(".__init__")
+
+
+class _SiteVisitor(ast.NodeVisitor):
+    def __init__(self, module: str, lines: Sequence[str]):
+        self.module = module
+        self.lines = lines
+        self.stack: List[str] = []
+        self.loops = 0
+        self.sites: List[DiscoveredSite] = []
+
+    def _qual(self) -> str:
+        return ".".join([self.module] + self.stack) if self.stack \
+            else self.module
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node.name)
+        outer_loops, self.loops = self.loops, 0
+        self.generic_visit(node)
+        self.loops = outer_loops
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _loopy(self, node):
+        self.loops += 1
+        self.generic_visit(node)
+        self.loops -= 1
+
+    visit_For = visit_While = _loopy
+
+    def _annotation(self, node) -> Optional[str]:
+        end = getattr(node, "end_lineno", node.lineno)
+        for ln in range(node.lineno, end + 1):
+            m = _ANNOT_RE.search(self.lines[ln - 1])
+            if m:
+                return m.group(1)
+        return None
+
+    def _add(self, node, kind):
+        self.sites.append(DiscoveredSite(
+            path=f"{_PKG_NAME}/{self.module.replace('.', '/')}.py",
+            line=node.lineno, qualname=self._qual(), kind=kind,
+            site_id=self._annotation(node), in_loop=self.loops > 0))
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name):
+                if base.id in _DISPATCH_ALIASES \
+                        and f.attr in ("fetch", "stage"):
+                    self._add(node, f.attr)
+                elif base.id == "jax" and f.attr == "device_get":
+                    self._add(node, "raw")
+        elif isinstance(f, ast.Name) and f.id in ("device_get",
+                                                  "from_device"):
+            self._add(node, "raw")
+        self.generic_visit(node)
+
+
+def discover_sites() -> List[DiscoveredSite]:
+    """Every transfer site in the model's scope.  ``runtime/dispatch.py``
+    itself (the primitives' definitions and smoke) is excluded."""
+    sites: List[DiscoveredSite] = []
+    for path in _scope_files():
+        mod = _module_name(path)
+        if mod == "runtime.dispatch":
+            continue
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        v = _SiteVisitor(mod, source.splitlines())
+        v.visit(ast.parse(source))
+        sites.extend(v.sites)
+    return sites
+
+
+# -- call graph ---------------------------------------------------------------
+
+def _resolve_relative(module: str, node: ast.ImportFrom) -> Optional[str]:
+    """'from ..ops.adaptive import x' inside parallel.sharded ->
+    'ops.adaptive' (package-relative dotted module), None if external."""
+    if node.level == 0:
+        name = node.module or ""
+        if name.startswith(_PKG_NAME):
+            return name[len(_PKG_NAME) + 1:] or None
+        return None
+    parts = module.split(".")[: -(node.level)] if node.level <= \
+        len(module.split(".")) else []
+    base = ".".join(parts)
+    tail = node.module or ""
+    return ".".join(x for x in (base, tail) if x) or None
+
+
+def build_call_graph() -> Tuple[Dict[str, Set[str]], Set[str]]:
+    """(edges: qualname -> callee qualnames, all defined qualnames).
+
+    Best-effort resolution (plain names in the defining module, ``self.x``
+    within the class, imported names, module-alias attributes); edges the
+    AST cannot resolve are simply absent -- windows compensate with
+    explicit ``includes`` declarations."""
+    defs: Set[str] = set()
+    modules: Dict[str, ast.Module] = {}
+    aliases: Dict[str, Dict[str, str]] = {}
+    for path in _scope_files():
+        mod = _module_name(path)
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+        modules[mod] = tree
+        amap: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                src = _resolve_relative(mod, node)
+                if src is None:
+                    continue
+                for a in node.names:
+                    amap[a.asname or a.name] = f"{src}.{a.name}"
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith(_PKG_NAME + "."):
+                        amap[a.asname or a.name.split(".")[-1]] = \
+                            a.name[len(_PKG_NAME) + 1:]
+        aliases[mod] = amap
+
+    qual_defs: Dict[str, List[Tuple[str, ast.AST]]] = {}
+    for mod, tree in modules.items():
+
+        def collect(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = ".".join([mod] + stack + [child.name])
+                    defs.add(q)
+                    qual_defs.setdefault(mod, []).append(
+                        (".".join(stack + [child.name]), child))
+                    collect(child, stack + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    collect(child, stack + [child.name])
+                else:
+                    collect(child, stack)
+
+        collect(tree, [])
+
+    edges: Dict[str, Set[str]] = {}
+    for mod, fns in qual_defs.items():
+        amap = aliases[mod]
+        local = {q.split(".")[-1]: f"{mod}.{q}" for q, _ in fns}
+        by_class: Dict[str, Dict[str, str]] = {}
+        for q, _ in fns:
+            parts = q.split(".")
+            if len(parts) == 2:
+                by_class.setdefault(parts[0], {})[parts[1]] = f"{mod}.{q}"
+        for q, fn in fns:
+            src = f"{mod}.{q}"
+            out = edges.setdefault(src, set())
+            cls = q.split(".")[0] if "." in q else None
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                target = None
+                if isinstance(f, ast.Name):
+                    target = (local.get(f.id) or amap.get(f.id))
+                elif isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name):
+                    if f.value.id == "self" and cls:
+                        target = by_class.get(cls, {}).get(f.attr)
+                    elif f.value.id in amap:
+                        target = f"{amap[f.value.id]}.{f.attr}"
+                    elif f.value.id[:1].isupper():
+                        # ClassName.method within this module
+                        target = by_class.get(f.value.id, {}).get(f.attr)
+                if target and target in defs:
+                    out.add(target)
+                elif target:
+                    # 'mod.func' where mod resolved but func is defined
+                    # under a class or re-exported: accept module-level
+                    # matches only
+                    tail = target.split(".")[-1]
+                    tmod = target.rsplit(".", 1)[0]
+                    cand = f"{tmod}.{tail}"
+                    if cand in defs:
+                        out.add(cand)
+    return edges, defs
+
+
+def reachable(entries: Iterable[str],
+              edges: Dict[str, Set[str]]) -> Set[str]:
+    seen: Set[str] = set()
+    todo = list(entries)
+    while todo:
+        q = todo.pop()
+        if q in seen:
+            continue
+        seen.add(q)
+        todo.extend(edges.get(q, ()))
+    return seen
+
+
+def proven_bounds() -> Dict[str, str]:
+    """route -> proven host_syncs expression (bench.py row provenance)."""
+    return {route: WINDOWS[w].syncs for route, w in ROUTE_WINDOWS.items()}

@@ -1,0 +1,376 @@
+"""Engine 3: the jaxpr-level dataflow verifier (kntpu-verify).
+
+Three static gates, all CPU-only with zero program execution, each with a
+seeded-fault self-test proving its detector fires
+(``KNTPU_ANALYSIS_FAULT=sync-leak|sig-data-dep|route-diverge`` -> rc 1):
+
+* ``sync-leak`` / ``sync-budget`` -- the static sync/transfer proof
+  (:mod:`.syncflow`): every host-boundary transfer site in the engine is
+  discovered by AST, must be annotated into the model's vocabulary, and
+  every solve window's claimed site set is proven complete against the
+  static call graph; the per-window symbolic ``host_syncs`` bound is then
+  proven within budget (kNN windows: ``1 + fb <= 2``; FoF: exactly
+  ``rounds + 1``; serving batch: ``<= 4``).  The bounds are reconciled
+  EXACTLY against the runtime dispatch counters on the 20k fixture by
+  tests/test_verify.py.
+
+* ``sig-data-dep`` -- recompile-stability: each route's executable
+  signature census is computed across two data seeds (same n, k,
+  supercell); signature atoms that vary may only be *capacity-lattice*
+  values (powers of two / 128-multiples -- the class x capacity x k
+  lattice the serving daemon's zero-recompile guarantee quantizes over)
+  or occupancy counts (prepare-time retraces, reported as info).  A raw
+  data value (float, string, arbitrary scalar) baked into a recompile
+  key is the recompile-storm precursor and gates as an error.
+
+* ``route-diverge`` -- cross-route equivalence (:mod:`.equiv`): the
+  certificates are regenerated from fresh traces and diffed against the
+  committed ``analysis/equivalence.json``; any drift (a route's core no
+  longer matching its certified twin, a missing/stale file, or a plan
+  shape losing its pair coverage) gates.  ``--write-equivalence``
+  re-blesses the artifact (a reviewed action, like ``--write-baseline``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import equiv, syncflow
+from .findings import Finding
+
+FAULTS = ("sync-leak", "sig-data-dep", "route-diverge")
+
+_FAULT_ENV = "KNTPU_ANALYSIS_FAULT"
+
+
+def _fault() -> Optional[str]:
+    return os.environ.get(_FAULT_ENV) or None
+
+
+def _fail(findings: List[Finding], rule: str, route: str, message: str,
+          hint: str = "", subject: str = "") -> None:
+    findings.append(Finding(rule=rule, severity="error",
+                            path=f"route:{route}", line=0, message=message,
+                            hint=hint, subject=subject or message))
+
+
+def _info(findings: List[Finding], rule: str, route: str, message: str,
+          subject: str = "") -> None:
+    findings.append(Finding(rule=rule, severity="info",
+                            path=f"route:{route}", line=0, message=message,
+                            subject=subject or message))
+
+
+# -- gate 1: static sync/transfer proof ---------------------------------------
+
+def check_syncflow(fault: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    sites = syncflow.discover_sites()
+    if fault == "sync-leak":
+        # seeded fault: a fetch added to the finalize path without an
+        # annotation -- the exact shape of a regression that would smuggle
+        # an uncounted host sync into a solve window
+        sites = sites + [syncflow.DiscoveredSite(
+            path="cuda_knearests_tpu/api.py", line=0,
+            qualname="api.KnnProblem._finalize", kind="fetch",
+            site_id=None, in_loop=True)]
+
+    registered = set(syncflow.NONWINDOW)
+    for win in syncflow.WINDOWS.values():
+        registered |= set(win.sites)
+
+    # 1a. every sanctioned transfer is annotated; every raw readback is in
+    # the registry with a reason
+    for s in sites:
+        if s.kind == "raw":
+            if s.qualname not in syncflow.KNOWN_RAW:
+                _fail(findings, "sync-leak", "discovery",
+                      f"raw readback at {s.path}:{s.line} ({s.qualname}) is "
+                      f"not registered in syncflow.KNOWN_RAW: an uncounted "
+                      f"host sync outside the dispatch accounting layer",
+                      hint="route it through runtime.dispatch.fetch (and "
+                           "annotate it), or register the qualname with a "
+                           "reason why it is prepare-time/extraction-only",
+                      subject=f"raw:{s.qualname}")
+        elif s.site_id is None:
+            _fail(findings, "sync-leak", "discovery",
+                  f"dispatch.{s.kind} at {s.path}:{s.line} ({s.qualname}) "
+                  f"carries no '# syncflow: <site-id>' annotation: the "
+                  f"dataflow proof cannot account for it"
+                  + (" -- and it sits inside a loop" if s.in_loop else ""),
+                  hint="name the site and claim it in a syncflow.WINDOWS "
+                       "entry (or NONWINDOW with a reason)",
+                  subject=f"unannotated:{s.qualname}:{s.kind}")
+        elif s.site_id not in registered:
+            _fail(findings, "sync-leak", "discovery",
+                  f"site '{s.site_id}' ({s.path}:{s.line}) is annotated "
+                  f"but claimed by no window and not in NONWINDOW: its "
+                  f"syncs are proven by nothing",
+                  subject=f"unclaimed:{s.site_id}")
+
+    # 1b. the model does not claim sites that no longer exist (drift)
+    discovered_ids = {s.site_id for s in sites if s.site_id}
+    for name, win in syncflow.WINDOWS.items():
+        for sid in win.sites:
+            if sid not in discovered_ids:
+                _fail(findings, "sync-leak", name,
+                      f"window '{name}' claims site '{sid}' which no "
+                      f"longer exists in the source tree (stale model)",
+                      subject=f"stale:{name}:{sid}")
+
+    # 1c. call-graph completeness: every dispatch site reachable from a
+    # window's entry is claimed by that window (includes-closure) or is a
+    # registered non-window surface
+    edges, defs = syncflow.build_call_graph()
+    by_qual: Dict[str, List[syncflow.DiscoveredSite]] = {}
+    for s in sites:
+        by_qual.setdefault(s.qualname, []).append(s)
+    for name, win in syncflow.WINDOWS.items():
+        missing_entries = [e for e in win.entries if e not in defs]
+        if missing_entries:
+            _fail(findings, "sync-leak", name,
+                  f"window '{name}' entry point(s) {missing_entries} not "
+                  f"found in the source tree (stale model)",
+                  subject=f"entry:{name}")
+            continue
+        claimed = win.all_site_ids(syncflow.WINDOWS)
+        reach = syncflow.reachable(win.entries, edges)
+        for q in sorted(reach):
+            for s in by_qual.get(q, ()):
+                if s.kind == "raw":
+                    continue  # checked in 1a against KNOWN_RAW
+                if s.site_id in claimed:
+                    continue
+                if s.site_id in syncflow.NONWINDOW:
+                    _info(findings, "sync-leak", name,
+                          f"non-window site '{s.site_id}' reachable from "
+                          f"'{name}': {syncflow.NONWINDOW[s.site_id]}",
+                          subject=f"nonwindow:{name}:{s.site_id}")
+                    continue
+                _fail(findings, "sync-leak", name,
+                      f"dispatch.{s.kind} site "
+                      f"'{s.site_id or '<unannotated>'}' at "
+                      f"{s.path}:{s.line} is reachable from window "
+                      f"'{name}' ({' -> '.join(win.entries)}) but absent "
+                      f"from its dataflow model: the proven bound would "
+                      f"undercount",
+                      hint="claim the site in the window's model with a "
+                           "multiplicity, or break the call edge",
+                      subject=f"leak:{name}:{s.site_id}:{s.qualname}")
+
+    # 1d. symbolic budget proof
+    worst = syncflow.worst_case_env()
+    for name, win in syncflow.WINDOWS.items():
+        if "rounds" in win.syncs:
+            samples = ({"rounds": r} for r in (0, 1, 2, 7, 33, 101))
+            exact = all(
+                syncflow.evaluate(win.syncs, {**worst, **s})
+                == syncflow.evaluate(win.budget, {**worst, **s})
+                for s in samples)
+            if not exact:
+                _fail(findings, "sync-budget", name,
+                      f"window '{name}' proves host_syncs = {win.syncs} "
+                      f"but its budget is {win.budget}: the symbolic forms "
+                      f"disagree", subject=f"budget:{name}")
+            else:
+                _info(findings, "sync-budget", name,
+                      f"proved host_syncs = {win.syncs} (exact, symbolic "
+                      f"in rounds)", subject=f"proved:{name}")
+            continue
+        bound = win.syncs_bound(worst)
+        budget = syncflow.evaluate(win.budget, worst)
+        if bound > budget:
+            _fail(findings, "sync-budget", name,
+                  f"window '{name}' proves host_syncs <= {bound} "
+                  f"({win.syncs} at worst-case indicators), over its "
+                  f"budget of {budget}",
+                  hint="the window gained a transfer site; batch it into "
+                       "an existing fetch or raise the documented budget "
+                       "deliberately",
+                  subject=f"budget:{name}")
+        else:
+            _info(findings, "sync-budget", name,
+                  f"proved host_syncs <= {bound} ({win.syncs}) within "
+                  f"budget {budget}", subject=f"proved:{name}")
+    return findings
+
+
+# -- gate 2: recompile-stability ----------------------------------------------
+
+def _lattice(v) -> bool:
+    """True for capacity-lattice values: powers of two (>= 8, the pow2
+    bucket ladder's floor) or multiples of 128 (kernel lane widths)."""
+    if not isinstance(v, (int, np.integer)) or isinstance(v, bool):
+        return False
+    v = int(v)
+    return (v >= 8 and (v & (v - 1)) == 0) or (v > 0 and v % 128 == 0)
+
+
+def _atoms(x, out: List) -> List:
+    if isinstance(x, (tuple, list)):
+        for item in x:
+            _atoms(item, out)
+    else:
+        out.append(x)
+    return out
+
+
+def _route_signatures(seed: int) -> Dict[str, tuple]:
+    """Per-route executable-signature census from one data seed's plans
+    (all host planning + abstract staging; no solver runs)."""
+    from .contracts import (_adaptive_fixture, _legacy_fixture, _points,
+                            _query_fixture, _sharded_fixture)
+
+    pts = _points(seed)
+    k, supercell = 8, 3
+    from ..runtime.dispatch import signature
+
+    cfg, grid, plan, pack = _legacy_fixture(pts, k, supercell)
+    out = {"legacy-pack": signature(pack, plan.qcap, plan.ccap, k)}
+    _cfg, _grid, aplan = _adaptive_fixture(pts, k, supercell)
+    out["adaptive"] = signature(
+        aplan.classes, *(cp.qcap_pad for cp in aplan.classes),
+        *(cp.ccap for cp in aplan.classes), k)
+    queries, sc_counts, starts, q2cap, inv_flat, inv_sc = _query_fixture(
+        grid, plan, supercell)
+    out["external-query"] = signature((sc_counts, starts, inv_flat),
+                                      q2cap, k)
+    _scfg, state, chip, _pcap = _sharded_fixture(pts, k, supercell)
+    out["sharded-chip"] = signature(
+        state, *(cp.qcap_pad for cp in chip.classes),
+        *(cp.ccap for cp in chip.classes), k)
+    return out
+
+
+def check_signatures(fault: Optional[str] = None) -> List[Finding]:
+    from collections import Counter
+
+    from .contracts import _SEEDS, _points
+
+    findings: List[Finding] = []
+    sig_a = _route_signatures(_SEEDS[0])
+    sig_b = _route_signatures(_SEEDS[1])
+    if fault == "sig-data-dep":
+        # seeded fault: a raw coordinate from the data baked into one
+        # route's recompile key -- the recompile-storm precursor shape
+        leak = float(_points(_SEEDS[0])[0, 0])
+        sig_a["adaptive"] = sig_a["adaptive"] + (leak,)
+    for route in sig_a:
+        a = Counter(map(repr, _atoms(sig_a[route], [])))
+        b = Counter(map(repr, _atoms(sig_b[route], [])))
+        varying = list(((a - b) + (b - a)).keys())
+        if not varying:
+            _info(findings, "sig-stability", route,
+                  "executable signature stable across data seeds",
+                  subject=f"stable:{route}")
+            continue
+        offenders = []
+        counts = []
+        for rep in varying:
+            try:
+                v = eval(rep, {"__builtins__": {}}, {})  # noqa: S307 -- repr of signature atoms (ints/strs/floats), no names in scope
+            except Exception:  # noqa: BLE001 -- unparseable atom = offender by definition
+                offenders.append(rep)
+                continue
+            if _lattice(v):
+                continue  # capacity-lattice drift: the allowed axis
+            if isinstance(v, (int, np.integer)):
+                counts.append(v)
+            else:
+                offenders.append(rep)
+        if offenders:
+            _fail(findings, "sig-data-dep", route,
+                  f"executable signature varies across data seeds through "
+                  f"NON-lattice atoms {offenders[:4]}: a raw data value is "
+                  f"baked into the recompile key -- every shifting input "
+                  f"would recompile",
+                  hint="quantize the offending component onto the class x "
+                       "capacity x k lattice (pow2/128 rounding), or drop "
+                       "it from the signature",
+                  subject=f"data-dep:{route}")
+        elif counts:
+            _info(findings, "sig-stability", route,
+                  f"signature varies through occupancy counts "
+                  f"{sorted(set(counts))[:4]} (prepare-time retrace, "
+                  f"expected; serving-path capacities stay lattice-"
+                  f"quantized)", subject=f"counts:{route}")
+        else:
+            _info(findings, "sig-stability", route,
+                  "signature varies only on the capacity lattice "
+                  "(pow2/128 buckets)", subject=f"lattice:{route}")
+    return findings
+
+
+# -- gate 3: cross-route equivalence ------------------------------------------
+
+def check_equivalence(fault: Optional[str] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    fresh = equiv.build_certificates(fault=fault)
+    committed = equiv.load_certificates()
+    if committed is None:
+        _fail(findings, "route-diverge", "equivalence",
+              "analysis/equivalence.json is missing or has a stale "
+              "schema: the route matrix has no committed certificate",
+              hint="regenerate with `python -m cuda_knearests_tpu"
+                   ".analysis --write-equivalence` and review the diff",
+              subject="equiv:missing")
+        return findings
+    if fresh != committed:
+        diverged = []
+        for fc, cc in zip(fresh["cells"], committed["cells"]):
+            for fam in fc["families"]:
+                if fc["families"][fam] != cc["families"].get(fam):
+                    diverged.append(
+                        f"k={fc['k']},s={fc['supercell']},{fam}")
+        _fail(findings, "route-diverge", "equivalence",
+              f"regenerated certificates diverge from the committed "
+              f"analysis/equivalence.json at {diverged or ['<structure>']}"
+              f": a route's canonical core no longer matches its "
+              f"certified twin",
+              hint="if the change is intentional (a deliberate core "
+                   "edit), re-bless with --write-equivalence and review "
+                   "which pairs were lost; otherwise the routes have "
+                   "silently diverged -- the bug this gate exists for",
+              subject="equiv:diverged")
+    for cell in fresh["cells"]:
+        label = f"k={cell['k']},s={cell['supercell']}"
+        n_pairs = {fam: len(data["pairs"])
+                   for fam, data in cell["families"].items()}
+        best = max(n_pairs.values(), default=0)
+        if best < 2:
+            _fail(findings, "route-diverge", "equivalence",
+                  f"[{label}] only {best} certified route pair(s) at this "
+                  f"plan shape (need >= 2): the matrix-collapse "
+                  f"precondition is gone", subject=f"equiv:thin:{label}")
+        else:
+            _info(findings, "route-equiv", "equivalence",
+                  f"[{label}] certified pairs: gather={n_pairs.get('gather', 0)}, "
+                  f"scatter={n_pairs.get('scatter', 0)}; bound to shared "
+                  f"launch: "
+                  f"{cell['families']['gather']['bound_to_shared']}",
+                  subject=f"equiv:{label}")
+    return findings
+
+
+# -- engine entry -------------------------------------------------------------
+
+def run_verify(fault: Optional[str] = None) -> List[Finding]:
+    """Run all three verifier gates.  ``fault`` (or KNTPU_ANALYSIS_FAULT)
+    seeds one deliberate violation; contract-engine faults are ignored
+    here (they seed engine 1)."""
+    from .contracts import FAULTS as CONTRACT_FAULTS
+
+    fault = fault if fault is not None else _fault()
+    if fault is not None and fault not in FAULTS:
+        if fault in CONTRACT_FAULTS:
+            fault = None
+        else:
+            raise ValueError(f"unknown analysis fault {fault!r}: "
+                             f"expected one of {CONTRACT_FAULTS + FAULTS}")
+    findings = check_syncflow(fault)
+    findings += check_signatures(fault)
+    findings += check_equivalence(fault)
+    return findings

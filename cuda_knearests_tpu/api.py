@@ -232,7 +232,7 @@ class KnnProblem:
         by tests/test_dispatch.py."""
         cnt = (res.uncert_count if res.uncert_count is not None
                else jax.numpy.sum(~res.certified, dtype=jax.numpy.int32))
-        nbr, d2, cert, n_unc = _dispatch.fetch(
+        nbr, d2, cert, n_unc = _dispatch.fetch(  # syncflow: solve-final
             res.neighbors, res.dists_sq, res.certified, cnt)
         nbr = np.asarray(nbr)
         d2 = np.asarray(d2)
@@ -247,12 +247,12 @@ class KnnProblem:
         # Pad to a power of two so repeated solves reuse a handful of compiles.
         q_idx = _pad_pow2(bad, fill=-1)
         b_ids, b_d2 = brute_force_by_index(
-            self.grid.points, _dispatch.stage(q_idx), self.config.k,
+            self.grid.points, _dispatch.stage(q_idx), self.config.k,  # syncflow: solve-fallback-stage
             self.config.exclude_self)
         # the SAME batched fetch primitive as the main readback: an
         # uncertified row costs one more round trip total, never a second
         # sync storm of eager per-array readbacks
-        b_ids, b_d2 = _dispatch.fetch(b_ids, b_d2)
+        b_ids, b_d2 = _dispatch.fetch(b_ids, b_d2)  # syncflow: solve-fallback
         sel = q_idx >= 0
         nbr[q_idx[sel]] = np.asarray(b_ids)[sel]
         d2[q_idx[sel]] = np.asarray(b_d2)[sel]
@@ -278,7 +278,7 @@ class KnnProblem:
         reference); checkpoint-resumed problems pay one counted fetch and
         cache it."""
         if self.host_points is None:
-            pts, perm = _dispatch.fetch(self.grid.points,
+            pts, perm = _dispatch.fetch(self.grid.points,  # syncflow: host-original
                                         self.grid.permutation)
             out = np.empty_like(np.asarray(pts))
             out[np.asarray(perm)] = np.asarray(pts)
@@ -431,7 +431,7 @@ class KnnProblem:
         (gridhash.unpermute_neighbors -- still the device-side API) would
         cost H2D + eager dispatches + D2H on the serving path for nothing."""
         self._require_solved()
-        nbrs, perm = _dispatch.fetch(self.result.neighbors,
+        nbrs, perm = _dispatch.fetch(self.result.neighbors,  # syncflow: extract-original
                                      self.grid.permutation)
         if self.grid.n_points == 0:
             return np.asarray(nbrs)

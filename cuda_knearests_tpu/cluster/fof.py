@@ -252,11 +252,11 @@ def fof_labels(points, linking_length: float, *,
     nbr_cells, nbr_ok = _neighbor_cells_host(points, order, dim, domain)
     b2 = np.float32(b) * np.float32(b)
     args = (
-        _dispatch.stage(np.arange(n, dtype=np.int32)),
+        _dispatch.stage(np.arange(n, dtype=np.int32)),  # syncflow: fof-stage
         grid.points[:, 0], grid.points[:, 1], grid.points[:, 2],
         grid.cell_starts, grid.cell_counts,
-        _dispatch.stage(nbr_cells), _dispatch.stage(nbr_ok),
-        _dispatch.stage(np.float32(b2)),
+        _dispatch.stage(nbr_cells), _dispatch.stage(nbr_ok),  # syncflow: fof-stage
+        _dispatch.stage(np.float32(b2)),  # syncflow: fof-stage
     )
     labels = args[0]
     rounds = 0
@@ -266,13 +266,13 @@ def fof_labels(points, linking_length: float, *,
         rounds += 1
         # the counted convergence read: ONE flag per round through the
         # sanctioned batched-fetch primitive (DESIGN.md sections 12/14)
-        changed = bool(_dispatch.fetch(chg))
+        changed = bool(_dispatch.fetch(chg))  # syncflow: fof-round
     if changed:
         raise AssertionError(
             f"FoF propagation failed to converge in {max_rounds} rounds "
             f"(n={n}); pointer jumping guarantees O(log n) -- this is a "
             f"bug, not a large input")
-    out_l, out_s = _dispatch.fetch(*_fof_finalize(labels, grid.permutation))
+    out_l, out_s = _dispatch.fetch(*_fof_finalize(labels, grid.permutation))  # syncflow: fof-final
     out_l = np.asarray(out_l)
     out_s = np.asarray(out_s)
     syncs = _dispatch.stats().host_syncs - s0.host_syncs

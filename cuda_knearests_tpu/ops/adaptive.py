@@ -942,9 +942,9 @@ def launch_class_query(points, starts, counts, cp: ClassPlan,
     # dispatch back-to-back; nothing here blocks the host
     r_i, r_d, r_c = _query_class(
         points, starts, counts, cp,
-        _dispatch.stage(queries_sel[order]), _dispatch.stage(rstarts),
-        _dispatch.stage(rcounts), _dispatch.stage(inv),
-        _dispatch.stage(rows_sorted.astype(np.int32)), q2cap, k,
+        _dispatch.stage(queries_sel[order]), _dispatch.stage(rstarts),  # syncflow: query-class-stage
+        _dispatch.stage(rcounts), _dispatch.stage(inv),  # syncflow: query-class-stage
+        _dispatch.stage(rows_sorted.astype(np.int32)), q2cap, k,  # syncflow: query-class-stage
         route, domain, cfg.interpret, cfg.stream_tile, ids_map,
         cfg.effective_kernel(), cfg.resolved_epilogue())
     return order, r_i, r_d, r_c
@@ -1011,11 +1011,11 @@ def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
             grid.points, grid.cell_starts, grid.cell_counts, cp,
             queries[sel], qrow[sel], k, cfg, grid.domain,
             ids_map=grid.permutation)
-        rows = _dispatch.stage(sel[order].astype(np.int32))
+        rows = _dispatch.stage(sel[order].astype(np.int32))  # syncflow: adaptive-query-place-stage
         out_i, out_d, cert = _place_query_rows(out_i, out_d, cert, rows,
                                                r_i, r_d, r_c)
     # the one sync: a single batched readback of the assembled buffers
-    out_i, out_d, cert = _dispatch.fetch(out_i, out_d, cert)
+    out_i, out_d, cert = _dispatch.fetch(out_i, out_d, cert)  # syncflow: adaptive-query-final
 
     # Exact resolve: classless queries (empty supercells) have no grid route,
     # so they are always brute-forced (their rows stay uncertified above);
@@ -1028,9 +1028,9 @@ def query_adaptive(grid: GridHash, cfg: KnnConfig, plan: AdaptivePlan,
         out_i, out_d = np.array(out_i), np.array(out_d)
         bad = np.nonzero(need)[0].astype(np.int32)
         b_i, b_d = brute_force_by_coords(
-            grid.points, _dispatch.stage(queries[bad]), k,
+            grid.points, _dispatch.stage(queries[bad]), k,  # syncflow: adaptive-query-fallback-stage
             ids_map=grid.permutation)
-        b_i, b_d = _dispatch.fetch(b_i, b_d)
+        b_i, b_d = _dispatch.fetch(b_i, b_d)  # syncflow: adaptive-query-fallback
         out_i[bad] = b_i
         out_d[bad] = b_d
     # writable results on every path, like the legacy route's fresh buffers

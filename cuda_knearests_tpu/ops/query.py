@@ -216,8 +216,8 @@ def _launch_packed(qs, starts, sc_counts, inv_flat, inv_sc, pack, plan, perm,
     chunks) with the same class-shape signature reuse ONE AOT-compiled
     executable instead of re-tracing.  A backend that cannot AOT-lower
     falls back to the plain jitted call (EXEC_CACHE disables itself)."""
-    args = (qs, _dispatch.stage(starts), _dispatch.stage(sc_counts),
-            _dispatch.stage(inv_flat), _dispatch.stage(inv_sc), pack, plan,
+    args = (qs, _dispatch.stage(starts), _dispatch.stage(sc_counts),  # syncflow: query-launch-stage
+            _dispatch.stage(inv_flat), _dispatch.stage(inv_sc), pack, plan,  # syncflow: query-launch-stage
             perm)
     statics = dict(q2cap=q2cap, k=k, exclude_hint=False, domain=domain,
                    interpret=interpret, epilogue=epilogue)
@@ -288,7 +288,7 @@ def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
     pending = []
     for (a, b), (order, sc_counts, starts, _q2, inv_flat, inv_sc) \
             in zip(bounds, buckets):
-        qs = _dispatch.stage(queries[a:b][order])
+        qs = _dispatch.stage(queries[a:b][order])  # syncflow: query-chunk-stage
         if use_kernel:
             r_i, r_d, r_c = _launch_packed(
                 qs, starts, sc_counts, inv_flat, inv_sc, pack, plan,
@@ -302,7 +302,7 @@ def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
         pending.append((r_i, r_d, r_c))
 
     # the one sync: a single batched readback of every chunk's results
-    fetched = _dispatch.fetch(pending)
+    fetched = _dispatch.fetch(pending)  # syncflow: query-final
 
     nbrs = np.empty((m, k), np.int32)
     d2 = np.empty((m, k), np.float32)
@@ -320,9 +320,9 @@ def query_knn(grid: GridHash, plan: SolvePlan, pack, queries: np.ndarray,
     if use_kernel and not cert.all() and fallback == "brute":
         bad = np.nonzero(~cert)[0].astype(np.int32)
         b_i, b_d = brute_force_by_coords(
-            grid.points, _dispatch.stage(queries[bad]), k,
+            grid.points, _dispatch.stage(queries[bad]), k,  # syncflow: query-fallback-stage
             ids_map=grid.permutation)
-        b_i, b_d = _dispatch.fetch(b_i, b_d)
+        b_i, b_d = _dispatch.fetch(b_i, b_d)  # syncflow: query-fallback
         nbrs[bad] = np.asarray(b_i)
         d2[bad] = np.asarray(b_d)
     return nbrs, d2

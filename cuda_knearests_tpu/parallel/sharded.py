@@ -882,7 +882,7 @@ class ShardedKnnProblem:
         # the one sync: a single batched readback across every chip's
         # per-class results (device_get batches across devices), then the
         # host placement is pure numpy
-        for rows, h_i, h_d, h_c in _dispatch.fetch(pending):
+        for rows, h_i, h_d, h_c in _dispatch.fetch(pending):  # syncflow: sharded-query-final
             out_i[rows] = h_i  # fetch() already landed host numpy
             out_d[rows] = h_d
             cert[rows] = h_c
@@ -1065,7 +1065,7 @@ class ShardedKnnProblem:
         # the per-chip readback loop this replaces serialized the assembly
         # on ndev round trips (DESIGN.md section 12)
         live = [d for d in sorted(outs) if outs[d] is not None]
-        fetched = _dispatch.fetch(
+        fetched = _dispatch.fetch(  # syncflow: sharded-solve-final
             [(self._chip_inputs(d)["sids"],) + tuple(outs[d]) for d in live])
         for sids, o_i, o_d, o_c in fetched:
             rows = sids >= 0  # fetch() already landed host numpy

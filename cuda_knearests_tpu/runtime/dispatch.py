@@ -72,6 +72,13 @@ class DispatchStats:
 
 
 _STATS = DispatchStats()
+# Per-call-site transfer trace (None = off).  When a window is being traced
+# (``trace_sites``), every fetch/stage call appends a SiteRecord naming the
+# CALLER's file:line -- the runtime half of the kntpu-check syncflow proof
+# (analysis/syncflow.py): the static model declares every sanctioned
+# host-boundary site, and the 20k-fixture test reconciles these records
+# against the model's per-site multiplicities exactly.
+_SITE_TRACE: "Optional[list]" = None
 # Guards the counter increments so concurrent solves cannot corrupt them.
 # The counters themselves are still ONE process-wide window: a measurement
 # (reset_stats .. stats) only attributes syncs to a single solve when no
@@ -100,6 +107,51 @@ def stats_dict() -> dict:
     return stats().as_dict()
 
 
+@dataclasses.dataclass(frozen=True)
+class SiteRecord:
+    """One traced host-boundary transfer: which source line moved how many
+    bytes in which direction.  ``path`` is repo-relative (matches the
+    syncflow discovery's site paths); ``synced`` is True for a fetch that
+    actually touched a device array (the ones that count as host syncs)."""
+
+    kind: str      # 'fetch' | 'stage'
+    path: str
+    line: int
+    nbytes: int
+    synced: bool
+
+
+def _record_site(kind: str, nbytes: int, synced: bool) -> None:
+    """Append the CALLER-of-fetch/stage's site to the active trace."""
+    import sys
+
+    frame = sys._getframe(2)
+    path = frame.f_code.co_filename
+    marker = "cuda_knearests_tpu"
+    cut = path.rfind(marker)
+    if cut >= 0:
+        path = path[cut:].replace(os.sep, "/")
+    _SITE_TRACE.append(SiteRecord(kind=kind, path=path, line=frame.f_lineno,
+                                  nbytes=nbytes, synced=synced))
+
+
+class trace_sites:
+    """Context manager collecting a :class:`SiteRecord` per fetch/stage call
+    inside the window -- the instrumented mode the syncflow verifier's
+    fixture-equality test runs the routes under.  Single-threaded windows
+    only (same caveat as the counters)."""
+
+    def __enter__(self) -> list:
+        global _SITE_TRACE
+        self._prev = _SITE_TRACE
+        _SITE_TRACE = []
+        return _SITE_TRACE
+
+    def __exit__(self, *exc) -> None:
+        global _SITE_TRACE
+        _SITE_TRACE = self._prev
+
+
 def _device_leaves(tree: Any) -> list:
     import jax
 
@@ -123,6 +175,8 @@ def fetch(*trees: Any) -> Any:
         with _STATS_LOCK:
             _STATS.host_syncs += 1
             _STATS.d2h_bytes += int(sum(l.nbytes for l in dev))
+    if _SITE_TRACE is not None:
+        _record_site("fetch", int(sum(l.nbytes for l in dev)), bool(dev))
     out = jax.device_get(trees)
     return out[0] if len(out) == 1 else out
 
@@ -140,6 +194,8 @@ def stage(x: Any, dtype: Any = None):
         arr = np.asarray(x) if dtype is None else np.asarray(x, dtype)
         with _STATS_LOCK:
             _STATS.h2d_bytes += int(arr.nbytes)
+        if _SITE_TRACE is not None:
+            _record_site("stage", int(arr.nbytes), False)
         return jnp.asarray(arr)
     return x if dtype is None else jnp.asarray(x, dtype)
 

@@ -126,6 +126,7 @@ class ServeDaemon:
         self.failed_batches = 0
         self.failed_mutations = 0
         self.fof_requests = 0
+        self.fof_memo_hits = 0
         self._fof_cache: Optional[tuple] = None  # (version key, FofResult)
         self.refused = 0
         self.failure_kinds: Dict[str, int] = {}
@@ -280,6 +281,13 @@ class ServeDaemon:
         st = self.overlay.stats
         version = (b, st.inserts, st.deletes, st.compactions)
         if self._fof_cache is not None and self._fof_cache[0] == version:
+            # NOTE the memo is daemon-owned host state, deliberately NOT
+            # keyed through the executable cache: an ExecutableCache LRU
+            # eviction (capacity pressure from query buckets) must only
+            # ever cost a recompile on the next MISS, never invalidate or
+            # corrupt an already-computed answer (tests/test_serve.py pins
+            # the eviction-mid-session interaction)
+            self.fof_memo_hits += 1
             return self._fof_cache[1]
         # overlay points are already inside the prepared domain (inserts
         # were validated at admission): skip the O(n) re-scan
@@ -342,7 +350,12 @@ class ServeDaemon:
             "failed_batches": self.failed_batches,
             "failed_mutations": self.failed_mutations,
             "fof_requests": self.fof_requests,
+            "fof_memo_hits": self.fof_memo_hits,
             "refused": self.refused,
+            # executable-cache pressure (hits/misses/evictions/cap): the
+            # zero-recompile steady state AND eviction thrashing are both
+            # visible per session, not just process-wide
+            **_dispatch.EXEC_CACHE.stats_dict(),
             "failure_kinds": dict(self.failure_kinds),
             "flushes": dict(self.batcher.flushes),
             "occupancy_mean": (float(np.mean(occ)) if occ else None),

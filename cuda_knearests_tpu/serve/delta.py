@@ -219,7 +219,7 @@ class DeltaOverlay:
             pts[:n_alive] = self._base_orig[self.alive]
             ids = np.full((cap,), -1, np.int32)
             ids[:n_alive] = np.arange(n_alive, dtype=np.int32)
-            self._alive_cache = (_dispatch.stage(pts), _dispatch.stage(ids))
+            self._alive_cache = (_dispatch.stage(pts), _dispatch.stage(ids))  # syncflow: overlay-alive-stage
         return self._alive_cache
 
     def _delta_launch_arrays(self, sel: np.ndarray, cap: int):
@@ -233,7 +233,7 @@ class DeltaOverlay:
         n_alive = int(self.alive.sum())
         ids = np.full((cap,), -1, np.int32)
         ids[: sel.size] = n_alive + sel.astype(np.int32)
-        return _dispatch.stage(pts), _dispatch.stage(ids)
+        return _dispatch.stage(pts), _dispatch.stage(ids)  # syncflow: overlay-delta-stage
 
     def query(self, queries: np.ndarray, k: int):
         """Exact kNN of ``queries`` against the CURRENT mutated cloud.
@@ -272,9 +272,9 @@ class DeltaOverlay:
                 bq = np.full((bcap, 3), np.float32(0.0), np.float32)
                 bq[:nb] = queries[bad]
                 r_i, r_d = launch_brute(
-                    a_pts, _dispatch.stage(bq), k, ids_map=a_ids,
+                    a_pts, _dispatch.stage(bq), k, ids_map=a_ids,  # syncflow: overlay-resolve-stage
                     base_key=(self.base._exec_key, "overlay-resolve"))
-                r_i, r_d = _dispatch.fetch(r_i, r_d)
+                r_i, r_d = _dispatch.fetch(r_i, r_d)  # syncflow: overlay-resolve
                 r_i = np.asarray(r_i)[:nb]
                 r_d = np.asarray(r_d)[:nb]
                 # alive-set pads carry id -1 at a huge-but-finite distance;
@@ -307,9 +307,9 @@ class DeltaOverlay:
         d_pts, d_ids = self._delta_launch_arrays(sel, cap)
         kd = min(k, cap)
         g_i, g_d = launch_brute(
-            d_pts, _dispatch.stage(queries), kd, ids_map=d_ids,
+            d_pts, _dispatch.stage(queries), kd, ids_map=d_ids,  # syncflow: overlay-delta-query-stage
             base_key=(self.base._exec_key, "overlay-delta"))
-        g_i, g_d = _dispatch.fetch(g_i, g_d)
+        g_i, g_d = _dispatch.fetch(g_i, g_d)  # syncflow: overlay-delta-final
         self.stats.delta_launches += 1
         self.stats.delta_candidates += int(sel.size)
         return _merge_rows(ids, d2, np.asarray(g_i), np.asarray(g_d), k)

@@ -1,14 +1,21 @@
 #!/usr/bin/env bash
-# One-shot static gate: ruff + mypy (when installed) + kntpu-check (always).
+# One-shot static gate: ruff (when installed) + mypy (HARD) + kntpu-check.
 #
-#   scripts/check.sh            # run everything available
-#   scripts/check.sh --strict   # additionally FAIL if ruff/mypy are missing
+#   scripts/check.sh            # run everything
+#   scripts/check.sh --strict   # additionally FAIL if ruff is missing
 #
 # kntpu-check (the committed gate, needs only the runtime deps) runs the
-# abstract contract checker over every solve route plus the TPU-hazard lint,
-# entirely on CPU -- see DESIGN.md section 10.  ruff/mypy are configured in
-# pyproject.toml but are optional tooling: the pinned CI image does not ship
-# them, so their absence is a skip (a note, not a failure) unless --strict.
+# abstract contract checker over every solve route, the TPU-hazard lint,
+# and the kntpu-verify dataflow verifier, entirely on CPU -- see DESIGN.md
+# sections 10 and 15.
+#
+# mypy is a HARD gate (ISSUE 8): its version is pinned in pyproject.toml
+# ([project.optional-dependencies] check) and CI installs it
+# (.github/workflows/ci.yml), so a missing mypy is a broken environment,
+# not a skip.  The ONLY escape is the explicit KNTPU_SKIP_MYPY=1 knob for
+# hermetic images that cannot install tooling -- set it consciously, never
+# by default.  ruff remains optional tooling (absent from the pinned
+# image; a skip unless --strict).
 set -u
 cd "$(dirname "$0")/.."
 
@@ -26,15 +33,34 @@ else
 fi
 
 if command -v mypy >/dev/null 2>&1; then
-    echo "== mypy =="
+    echo "== mypy (hard gate, pinned in pyproject.toml) =="
     mypy cuda_knearests_tpu || rc=1
+elif [ "${KNTPU_SKIP_MYPY:-0}" = "1" ]; then
+    echo "== mypy: SKIPPED via KNTPU_SKIP_MYPY=1 (hermetic image) =="
 else
-    echo "== mypy: not installed, skipping (configured in pyproject.toml) =="
-    [ "$strict" = 1 ] && rc=1
+    echo "== mypy: NOT INSTALLED -- hard gate fails =="
+    echo "   install the pinned version: pip install -e '.[check]'"
+    echo "   (hermetic images without network may set KNTPU_SKIP_MYPY=1)"
+    rc=1
 fi
 
-echo "== kntpu-check (contracts + TPU-hazard lint, CPU-only) =="
+echo "== kntpu-check (contracts + lint + verify, CPU-only) =="
 JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.analysis || rc=1
+
+# kntpu-verify seeded-fault self-tests (DESIGN.md section 15): each of the
+# three dataflow-verifier detectors must FIRE when its fault is seeded --
+# a gate whose detectors cannot fail is not a gate.
+echo "== kntpu-verify seeded-fault self-tests (sync-leak / sig-data-dep / route-diverge) =="
+for fault in sync-leak sig-data-dep route-diverge; do
+    if KNTPU_ANALYSIS_FAULT=$fault JAX_PLATFORMS=cpu \
+        python -m cuda_knearests_tpu.analysis --engine verify \
+        >/dev/null 2>&1; then
+        echo "   FAIL: seeded fault '$fault' was not detected (rc 0)"
+        rc=1
+    else
+        echo "   ok: '$fault' detected"
+    fi
+done
 
 # Bounded differential fuzz smoke (DESIGN.md section 11): a fixed-seed
 # adversarial campaign across all four solve routes vs the exact oracle,

@@ -406,3 +406,108 @@ def test_adaptive_abstract_plan_matches_concrete_shapes():
         assert [(l.shape, np.dtype(l.dtype)) for l in r_leaves] == \
             [(l.shape, np.dtype(l.dtype)) for l in a_leaves]
     assert real.inv_row.shape == abst.inv_row.shape
+
+
+# -- ISSUE 8 satellites: --json schema + baseline schema versioning -----------
+
+def test_cli_json_schema_stable(tmp_path):
+    """The --json document is the CI annotation contract: stable top-level
+    keys, a schema stamp, per-finding fingerprints, and severity counts."""
+    import json
+
+    bad = tmp_path / "hazard.py"
+    bad.write_text("import numpy as np\nx = np.float64(1.0)\n")
+    r = _cli("--paths", str(bad), "--json")
+    assert r.returncode == 2
+    doc = json.loads(r.stdout)
+    assert set(doc) >= {"schema", "analysis_version", "analysis_baseline",
+                        "analysis_equivalence", "engine", "findings",
+                        "new", "stale_baseline", "counts", "ok"}
+    assert doc["schema"] == 1 and doc["ok"] is False
+    assert doc["counts"]["error"] + doc["counts"]["warning"] >= 1
+    assert doc["counts"]["new"] >= 1
+    f = doc["findings"][0]
+    assert set(f) >= {"rule", "severity", "path", "line", "message",
+                      "hint", "subject", "fingerprint"}
+    assert f["fingerprint"] in doc["new"]
+
+
+def test_stale_schema_baseline_refused(tmp_path):
+    """A baseline written under an older fingerprint law must REFUSE (typed
+    baseline-schema finding, rc 1), never silently gate against it."""
+    import json
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    stale = tmp_path / "stale_baseline.json"
+    stale.write_text(json.dumps(
+        {"version": "1.0.0", "fingerprints": []}))  # v1: no schema field
+    r = _cli("--paths", str(clean), "--baseline", str(stale))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "baseline-schema" in r.stdout
+    # the same content WITH the current schema passes
+    from cuda_knearests_tpu.analysis import BASELINE_SCHEMA
+
+    fresh = tmp_path / "fresh_baseline.json"
+    fresh.write_text(json.dumps(
+        {"version": "2.0.0", "schema": BASELINE_SCHEMA,
+         "fingerprints": []}))
+    r = _cli("--paths", str(clean), "--baseline", str(fresh))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_committed_baseline_schema_current():
+    from cuda_knearests_tpu.analysis import BASELINE_SCHEMA, load_baseline
+    from cuda_knearests_tpu.analysis.findings import schema_finding
+
+    base = load_baseline()
+    assert base.get("schema") == BASELINE_SCHEMA
+    assert base["fingerprints"] == []  # the empty-baseline policy holds
+    assert schema_finding(base) is None
+    assert schema_finding({"fingerprints": []}) is not None
+
+
+def test_cli_verify_engine_wired():
+    """--engine verify runs engine 3 alone: rc 0 on the shipped tree, rc 1
+    under a seeded verifier fault (the acceptance exit-code contract)."""
+    r = _cli("--engine", "verify")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "sync-budget" in r.stdout and "route-equiv" in r.stdout
+
+
+def test_analysis_stamp_carries_equivalence_hash():
+    from cuda_knearests_tpu.analysis import analysis_stamp
+
+    stamp = analysis_stamp()
+    assert len(stamp["analysis_equivalence"]) == 12
+    assert stamp["analysis_equivalence"] != "none"
+
+
+def test_cli_refuses_fault_engine_mismatch():
+    """A verify fault with --engine contracts (or vice versa) would be
+    silently ignored by the non-matching engine and report a false
+    'tree clean' -- the CLI must refuse the mismatch outright."""
+    r = _cli("--engine", "contracts", "--fault", "sync-leak")
+    assert r.returncode == 2 and "does not run" in r.stderr
+    r = _cli("--engine", "verify", "--fault", "scatter-map")
+    assert r.returncode == 2 and "does not run" in r.stderr
+    # env-var form warns (external wrappers may export it broadly)
+    r = _cli("--engine", "contracts",
+             env={"KNTPU_ANALYSIS_FAULT": "sync-leak"})
+    assert "no fault was seeded" in r.stderr
+
+
+def test_equivalence_trace_hashes_pin_epilogues():
+    """The certificate's full-trace hashes are what license the matrix
+    collapse: every route x epilogue family carries one, distinct between
+    families (the scatter program is NOT the gather program)."""
+    from cuda_knearests_tpu.analysis import equiv
+
+    cert = equiv.load_certificates()
+    for cell in cert["cells"]:
+        g = cell["families"]["gather"]["trace_hashes"]
+        s = cell["families"]["scatter"]["trace_hashes"]
+        assert set(g) == set(s) == {"legacy-pack", "adaptive",
+                                    "external-query", "sharded-chip"}
+        for route in g:
+            assert g[route] != s[route], (cell["k"], route)

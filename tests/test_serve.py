@@ -542,3 +542,55 @@ def test_mutation_fuzz_duplicate_flavor_hits_base_points():
         if found:
             break
     assert found, "no seed in 0..39 produced a base-point duplicate insert"
+
+
+# -- ISSUE 8 satellite: ExecutableCache LRU eviction x memoized FoF -----------
+
+def test_exec_cache_eviction_mid_session_fof_recompiles():
+    """Eviction pressure mid-session must never corrupt the daemon's
+    memoized FoF answer: the memo is daemon-owned host state, so an LRU
+    eviction of the FoF executables (capacity pressure from query-bucket
+    launches) costs exactly one recompile on the next cache MISS -- the
+    post-mutation FoF must rebuild its executables and still match a fresh
+    rebuild-from-scratch solve, not serve a stale or crashed reply."""
+    from cuda_knearests_tpu.cluster.fof import fof_labels
+
+    pts = generate_uniform(3_000, seed=3)
+    p = KnnProblem.prepare(pts, KnnConfig(k=8, adaptive=False))
+    daemon = ServeDaemon(p, ServeConfig(max_batch=32, max_delay_s=100.0,
+                                        warmup=False))
+    cache = dispatch.EXEC_CACHE
+    cache.clear()
+    old_cap = cache.maxsize
+    try:
+        cache.maxsize = 3  # tiny cap: three query buckets evict everything
+        [r1] = daemon.submit(1, "fof", 25.0)
+        assert r1.ok, r1.error
+        labels0 = np.asarray(r1.labels)
+        # between mutations, repeated FoF answers from the memo
+        [r2] = daemon.submit(2, "fof", 25.0)
+        assert r2.ok and daemon.fof_memo_hits == 1
+        np.testing.assert_array_equal(np.asarray(r2.labels), labels0)
+        # three differently-bucketed query batches thrash the tiny cache:
+        # the FoF executables are now evicted
+        for i, m in enumerate((1, 9, 17)):
+            daemon.submit(10 + i, "query",
+                          np.full((m, 3), 500.0, np.float32))
+            daemon.drain()
+        assert cache.evictions > 0
+        assert daemon.stats_dict()["exec_cache_evictions"] > 0
+        # a mutation invalidates the memo; the next FoF must RECOMPILE
+        # (fresh cache misses) and still answer exactly
+        [mr] = daemon.submit(50, "insert",
+                             np.full((4, 3), 321.5, np.float32))
+        assert mr.ok, mr.error
+        misses_before = cache.misses
+        [r3] = daemon.submit(51, "fof", 25.0)
+        assert r3.ok, r3.error
+        assert cache.misses > misses_before  # rebuilt, not stale
+        ref = fof_labels(daemon.overlay.mutated_points(), 25.0)
+        np.testing.assert_array_equal(np.asarray(r3.labels), ref.labels)
+        assert r3.n_clusters == ref.n_clusters
+    finally:
+        cache.maxsize = old_cap
+        cache.clear()
