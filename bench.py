@@ -342,6 +342,7 @@ def bench_north_star() -> dict:
                                 "ratio withheld"}
            if backend_used == "oracle" else {}),
         "recall_at_10": round(recall, 6),
+        "recall": round(recall, 6),
         "solve_s": round(solve_s, 4),
         "cpu_oracle_qps": round(cpu_qps, 1),
         "oracle_sampled": sample_n,
@@ -385,6 +386,7 @@ def bench_config(name: str) -> dict:
         return {"config": "kd_tree CPU kNN on pts20K.xyz (k=10)",
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": "oracle",  # provenance: this row IS the CPU bar
+                "recall": 1.0,  # the exact oracle defines recall
                 "seconds": round(s, 4), "n_points": points.shape[0]}
     if name == "grid_300k_k10":
         points = get_dataset("pts300K.xyz")
@@ -393,6 +395,7 @@ def bench_config(name: str) -> dict:
                           + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
+                "recall": 1.0,  # exact path (certificates + fallback)
                 "solve_s": round(s, 4), "n_points": points.shape[0], **sync,
                 **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "blue_900k_k20":
@@ -402,6 +405,7 @@ def bench_config(name: str) -> dict:
                           + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
+                "recall": 1.0,  # exact path (certificates + fallback)
                 "solve_s": round(s, 4), "n_points": points.shape[0], **sync,
                 **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "batched_300k_k50":
@@ -411,6 +415,7 @@ def bench_config(name: str) -> dict:
                           + _engine_suffix(prob),
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
+                "recall": 1.0,  # exact path (certificates + fallback)
                 "solve_s": round(s, 4), "n_points": points.shape[0], **sync,
                 **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "clustered_300k_adaptive":
@@ -474,6 +479,7 @@ def bench_config(name: str) -> dict:
                "backend": prob_a.config.backend,
                **global_fields,
                "n_points": n, "recall_at_10": round(recall, 6),
+               "recall": round(recall, 6),
                "oracle_sampled": sample_n,
                "certified_fraction": float(np.asarray(
                    prob_a.result.certified).mean()),
@@ -542,6 +548,7 @@ def bench_config(name: str) -> dict:
                "total_qps": round(qps, 1), "n_devices": ndev,
                "solve_s": round(s, 4), "n_points": n,
                "recall_at_10": round(recall, 6),
+               "recall": round(recall, 6),
                "oracle_sampled": sample_n,
                "certified_fraction": round(certified, 6),
                **sync_fields,
@@ -603,6 +610,123 @@ def bench_config(name: str) -> dict:
 _ALL_CONFIGS = ("kdtree_cpu_20k", "grid_300k_k10", "blue_900k_k20",
                 "batched_300k_k50", "clustered_300k_adaptive",
                 "sharded_10m_k10", "fof_300k")
+
+
+# -- recall-vs-QPS frontier (--frontier): the MXU route's trade curve --------
+
+#: The swept targets: three approximate points plus the exact tier (whose
+#: row doubles as the like-for-like exact bar, recall stamped 1.0-measured).
+_FRONTIER_RTS = (0.6, 0.8, 0.95, 1.0)
+
+
+def bench_frontier() -> list:
+    """The recall-vs-QPS frontier of the brute/MXU route (DESIGN.md
+    section 16): one row per ``recall_target`` on the 20k fixture --
+    approximate rows time ``refine='none'`` (the approximate serving mode)
+    and the exact tier times the full certify-and-refine solve -- plus one
+    d != 3 row (ROADMAP item 4's workload, same engine, same stamps).
+
+    Every row stamps the *measured* tie-aware recall vs the exact f64
+    oracle next to the *configured* TPU-KNN bound, with ``recall_ok``
+    machine-checking measured >= bound (the acceptance bar).
+    Approximate rows measure at the route's declared ``2B`` scoring
+    precision (``recall_discipline: '2B-banded'``, the fuzz
+    comparator's discipline -- DESIGN.md section 16); the refined exact
+    tier and the d=6 row are held to band-free f64 exactness.
+    ``BENCH_FRONTIER_N`` / ``BENCH_FRONTIER_D6_N`` scale the fixtures for
+    constrained runners."""
+    import numpy as np
+
+    from cuda_knearests_tpu.io import get_dataset
+    from cuda_knearests_tpu.mxu import solve_general
+    from cuda_knearests_tpu.mxu.measure import (declared_band, f64_kth,
+                                                measured_recall, row_hits)
+
+    k = 10
+    points = get_dataset("pts20K.xyz")
+    orig_n = points.shape[0]
+    n_target = int(os.environ.get("BENCH_FRONTIER_N", str(orig_n)))
+    if n_target < orig_n:
+        points = np.ascontiguousarray(points[:n_target])
+    n = points.shape[0]
+    band = declared_band(points)
+    # ONE O(n^2 d) f64 oracle pass: kth/avail depend only on (points, k),
+    # so the per-rt rows share them (only the band discipline differs)
+    kth, avail = f64_kth(points, k)
+    total = int(avail.sum())
+    rows = []
+    for rt in _FRONTIER_RTS:
+        exact = rt >= 1.0
+        refine = "brute" if exact else "none"
+        state: dict = {}
+
+        def run():
+            state["res"] = solve_general(points, k=k, recall_target=rt,
+                                         scorer="mxu", refine=refine)
+
+        run()  # compile + warmup
+        _watchdog.heartbeat()
+        s = _steady_state(run, iters=3, max_seconds=_budget_s())
+        res = state["res"]
+        # approximate rows measure at the route's declared 2B scoring
+        # precision (the fuzz comparator's discipline -- band-free f64
+        # ordering is a claim refine='none' never makes, and it bites
+        # exactly when the bound reaches 1.0); the refined exact tier
+        # claims true exactness and is held to it band-free
+        hits = row_hits(points, res.neighbors, kth,
+                        band=None if exact else band)
+        recall = float(hits.sum()) / total if total else 1.0
+        _watchdog.heartbeat()  # the f64 oracle pass is slow but local
+        rows.append({
+            "config": f"mxu frontier pts20K.xyz (k={k}, "
+                      f"recall_target={rt:g}, refine={refine})",
+            "value": round(n / s, 1), "unit": "queries/sec",
+            "backend": f"mxu-{res.backend}",
+            "recall_target": rt,
+            "recall_bound": round(res.bound, 6),
+            "recall": round(recall, 6),
+            "recall_ok": bool(recall >= res.bound),
+            "recall_discipline": "exact" if exact else "2B-banded",
+            "m": res.m, "n_blocks": res.n_blocks,
+            "certified_fraction": round(float(res.certified.mean()), 6)
+            if n else 1.0,
+            "uncert_count": int(res.uncert_count),
+            "solve_s": round(s, 4), "n_points": n, "k": k, "d": 3,
+            **({"scaled_down_from": orig_n} if n < orig_n else {}),
+        })
+
+    # the d != 3 row: same engine, same stamps, exact tier
+    d = 6
+    n6 = int(os.environ.get("BENCH_FRONTIER_D6_N", "4096"))
+    rng = np.random.default_rng(46)
+    pts6 = (rng.random((n6, d)) * 100.0).astype(np.float32)
+    state6: dict = {}
+
+    def run6():
+        state6["res"] = solve_general(pts6, k=k, recall_target=1.0,
+                                      scorer="mxu")
+
+    run6()
+    _watchdog.heartbeat()
+    s6 = _steady_state(run6, iters=3, max_seconds=_budget_s())
+    res6 = state6["res"]
+    recall6 = measured_recall(pts6, res6.neighbors, k)
+    rows.append({
+        "config": f"mxu general-d brute kNN (d={d}, n={n6}, k={k}, "
+                  f"recall_target=1)",
+        "value": round(n6 / s6, 1), "unit": "queries/sec",
+        "backend": f"mxu-{res6.backend}",
+        "recall_target": 1.0,
+        "recall_bound": round(res6.bound, 6),
+        "recall": round(recall6, 6),
+        "recall_ok": bool(recall6 >= res6.bound),
+        "recall_discipline": "exact",
+        "m": res6.m, "n_blocks": res6.n_blocks,
+        "certified_fraction": round(float(res6.certified.mean()), 6),
+        "uncert_count": int(res6.uncert_count),
+        "solve_s": round(s6, 4), "n_points": n6, "k": k, "d": d,
+    })
+    return rows
 
 
 # -- serving rows (--serve): the open-loop load harness as first-class bench --
@@ -678,6 +802,7 @@ def serve_scenario(name: str) -> dict:
         "value": summary["sustained_qps"],
         "unit": "queries/sec",
         "backend": problem.config.backend,
+        "recall": 1.0,  # exact serving path (certificates + fallback)
         "n_points": points.shape[0],
         **{key: summary[key] for key in (
             "requests", "completed_queries", "failed_requests", "refused",
@@ -763,6 +888,16 @@ def main(argv=None) -> int:
                             "like --all: each session runs in an isolated "
                             "worker, so a daemon process death costs one "
                             "typed failure row")
+    group.add_argument("--frontier", action="store_true",
+                       help="measure the recall-vs-QPS frontier of the "
+                            "brute/MXU route instead: one JSON row per "
+                            "recall_target (approximate rows time "
+                            "refine='none', the exact tier the full "
+                            "certify-and-refine solve) plus one d!=3 row, "
+                            "each stamping measured tie-aware recall vs "
+                            "the configured TPU-KNN bound (recall_ok).  "
+                            "CPU-capable; rc 0 iff every row lands with "
+                            "recall_ok and no error")
     ap.add_argument("--skip", choices=_ALL_CONFIGS, action="append",
                     default=None,
                     help="with --all: leave this config out entirely "
@@ -836,6 +971,30 @@ def main(argv=None) -> int:
                                                    honor_jax_platforms_env)
     honor_jax_platforms_env()
     enable_compile_cache()  # remote-tunnel compiles persist across runs
+
+    if args.frontier:
+        # Frontier rows (ISSUE 10): in-process like --only -- the rows are
+        # 20k-fixture CPU-capable measurements; rc 0 iff every row landed
+        # with its measured recall meeting the configured bound.
+        env = _env_fields(platform)
+        rc = 0
+        try:
+            rows = bench_frontier()
+        except Exception as e:  # noqa: BLE001 -- the artifact must appear
+            import traceback
+
+            traceback.print_exc()
+            rows = [{"config": "mxu frontier",
+                     "error": f"{type(e).__name__}: {e}"}]
+        for row in rows:
+            row.update(env)
+            if note:
+                row["backend_note"] = note
+            if "error" in row or not row.get("recall_ok", False):
+                rc = 1
+            print(json.dumps(row), flush=True)
+        state["emitted"] = True
+        return rc
 
     if args.serve:
         # Serving rows (ISSUE 6): one row per open-loop load scenario.

@@ -613,6 +613,197 @@ def _check_sharded(ck: _Checker, points: np.ndarray, k: int,
                          ccap=cp.ccap, k=k)
 
 
+def _mxu_fixture(points: np.ndarray, k: int, supercell: int,
+                 recall_target: float = 0.9):
+    """(cfg, grid, plan) for the adaptive route under ``scorer='mxu'`` --
+    the MXU plan shape (DESIGN.md section 16): eligible classes route
+    through the blocked-matmul class scorer instead of their elementwise
+    solver.  Shared with the equivalence engine (analysis/equiv.py)."""
+    from ..config import KnnConfig
+    from ..ops.adaptive import build_adaptive_plan
+
+    cfg = KnnConfig(k=k, supercell=supercell, interpret=True,
+                    scorer="mxu", recall_target=recall_target)
+    grid, counts = _host_grid(points, cfg.density)
+    plan = build_adaptive_plan(grid, cfg, cell_counts_host=counts,
+                               on_kernel_platform=True, abstract=True)
+    return cfg, grid, plan
+
+
+def _check_mxu_tiles(ck: _Checker, route: str, cfg_label: str, *,
+                     qcap: int, ccap: int) -> None:
+    """vmem-tile for the MXU scorer's layout: the candidate axis rides the
+    128-wide lane dimension of the score tile (and the fold's BLOCK
+    partition REQUIRES a 128 multiple); the query axis is a sublane axis
+    of the (qcap, ccap) tile, so an 8 multiple suffices -- the matmul
+    contraction has no 128-lane query requirement (mxu/scorer.py)."""
+    misalign = 4 if ck.fault == "tile-misalign" else 0
+    for key, value, mult, why in (
+            ("c-lane", ccap + misalign, 128,
+             "candidate axis is the lane dimension of the score tile and "
+             "the fold's BLOCK partition"),
+            ("q-sublane", qcap + misalign, 8,
+             "query axis is a sublane dimension of the (qcap, ccap) "
+             "score tile")):
+        if value % mult == 0:
+            continue
+        msg = (f"[{cfg_label}] {key}={value} is not a multiple of {mult} "
+               f"({why})")
+        if ck.waive("vmem-tile", key, route, msg):
+            continue
+        ck.fail("vmem-tile", route, msg,
+                hint="round the capacity up at plan time (_round_up; the "
+                     "MXU class scorer inherits the adaptive plan's 8/128 "
+                     "rounding), or add a reasoned entry to "
+                     "analysis.contracts.CONTRACT_WAIVERS",
+                subject=f"{route}:tile:{key}")
+
+
+def _check_mxu_adaptive(ck: _Checker, points: np.ndarray, k: int,
+                        supercell: int) -> None:
+    """The adaptive-mxu plan shape: same result contract, both epilogues,
+    value-free jaxpr -- the contract coverage that makes KnnConfig.scorer
+    = 'mxu' a first-class citizen of the route matrix."""
+    import jax
+
+    from ..ops.adaptive import _solve_adaptive
+
+    route = "adaptive-mxu"
+    rt = 0.9
+    label = f"k={k},s={supercell},rt={rt}"
+    cfg, grid, plan = _mxu_fixture(points, k, supercell, rt)
+    mxu_classes = [cp for cp in plan.classes if cp.route == "mxu"]
+    if not mxu_classes:
+        ck.fail("route-shape", route,
+                f"[{label}] scorer='mxu' produced no MXU-routed class: the "
+                f"contract coverage of the MXU plan shape is vacuous",
+                hint="mxu.scorer.class_eligible or build_class_specs "
+                     "regressed -- the fixture's tiles fit the chunk "
+                     "budget by construction",
+                subject=f"{route}:vacuous")
+        return
+    n = grid.n_points
+    pts = _abstract(grid.points)
+    starts = _abstract(grid.cell_starts)
+    counts = _abstract(grid.cell_counts)
+    outs = {}
+    for ep in ("gather", "scatter"):
+        fn = functools.partial(_solve_adaptive, n=n, k=k, exclude_self=True,
+                               domain=grid.domain, interpret=False,
+                               tile=cfg.stream_tile, kernel="kpass",
+                               epilogue=ep, recall_target=rt)
+        try:
+            outs[ep] = jax.eval_shape(fn, pts, starts, counts, plan.classes,
+                                      plan.inv_row, plan.inv_box)
+        except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+            ck.fail("route-shape", route,
+                    f"[{label},ep={ep}] abstract trace failed: "
+                    f"{type(e).__name__}: {e}",
+                    hint="the MXU class scorer's flat-output contract "
+                         "(Sc*qcap, k row-major, NaN decertify at column "
+                         "k-1) no longer matches the epilogue maps",
+                    subject=f"{route}:trace:{ep}")
+            continue
+        _expect_result(ck, route, f"{label},ep={ep}", outs[ep], n, k,
+                       with_count=True)
+    if len(outs) == 2 and _sig(outs["gather"]) != _sig(outs["scatter"]):
+        ck.fail("epilogue-agree", route,
+                f"[{label}] scatter and gather epilogues disagree abstractly",
+                subject=f"{route}:epilogue")
+    for ci, cp in enumerate(mxu_classes):
+        _check_mxu_tiles(ck, route, f"{label},class={ci}",
+                         qcap=cp.qcap_pad, ccap=cp.ccap)
+    fn = functools.partial(_solve_adaptive, n=n, k=k, exclude_self=True,
+                           domain=grid.domain, interpret=False,
+                           tile=cfg.stream_tile, kernel="kpass",
+                           epilogue="gather", recall_target=rt)
+    try:
+        j1 = jax.make_jaxpr(fn)(pts, starts, counts, plan.classes,
+                                plan.inv_row, plan.inv_box)
+        j2 = jax.make_jaxpr(fn)(pts, starts, counts, plan.classes,
+                                plan.inv_row, plan.inv_box)
+    except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+        ck.fail("recompile-key", route,
+                f"[{label}] jaxpr trace failed: {type(e).__name__}: {e}",
+                subject=f"{route}:jaxpr")
+        return
+    if str(j1) != str(j2):
+        ck.fail("recompile-key", route,
+                f"[{label}] two traces of the same abstract inputs yield "
+                f"different jaxprs: the trace depends on something outside "
+                f"its arguments",
+                subject=f"{route}:jaxpr")
+    _check_dtypes(ck, route, label, j1)
+
+
+def _mxu_brute_abstract(k: int, d: int, n: int = 400,
+                        recall_target: float = 0.9):
+    """(abstract args, statics dict) of one brute MXU core launch
+    (mxu.scorer.solve_blocks_xla) at the host prep's real layout rules --
+    shared by the contract check and the verify engine's signature
+    census."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..mxu.solve import _pick_qc
+    from ..mxu.topk import BLOCK, per_block_m
+
+    c_pad = -(-n // BLOCK) * BLOCK
+    g = c_pad // BLOCK
+    m = per_block_m(recall_target, k, g)
+    qc = _pick_qc(c_pad)
+    mq_pad = -(-n // qc) * qc
+    sd = jax.ShapeDtypeStruct
+    args = (sd((c_pad, d), jnp.float32), sd((c_pad,), jnp.int32),
+            sd((mq_pad, d), jnp.float32), sd((mq_pad,), jnp.int32))
+    return args, dict(k=k, m=m, exclude_self=True, qc=qc, fault=None)
+
+
+def _check_mxu_brute(ck: _Checker, k: int, d: int) -> None:
+    """The brute/MXU core (mxu.scorer.solve_blocks_xla) at dimension d:
+    selection contract, (8, 128) tiles, value-free f32/i32 jaxpr.  d != 3
+    runs the same checks -- the general-d route is in the matrix, not an
+    honor-system promise."""
+    import jax
+
+    from ..mxu.scorer import solve_blocks_xla
+
+    route = "mxu-brute"
+    label = f"k={k},d={d}"
+    args, statics = _mxu_brute_abstract(k, d)
+    fn = functools.partial(solve_blocks_xla, **statics)
+    mq, c_pad = args[2].shape[0], args[0].shape[0]
+    try:
+        out = jax.eval_shape(fn, *args)
+    except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+        ck.fail("route-shape", route,
+                f"[{label}] abstract trace failed: {type(e).__name__}: {e}",
+                subject=f"{route}:trace:d={d}")
+        return
+    want = [((mq, k), "int32"), ((mq, k), "float32"), ((mq,), "bool")]
+    got = [(tuple(o.shape), str(np.dtype(o.dtype))) for o in out]
+    if got != want:
+        ck.fail("route-shape", route,
+                f"[{label}] abstract outputs {got} != selection contract "
+                f"{want} (ids by ascending dot score, dot-form scores, "
+                f"certification bits)",
+                subject=f"{route}:shape:d={d}")
+    _check_mxu_tiles(ck, route, label, qcap=statics["qc"], ccap=c_pad)
+    try:
+        j1 = jax.make_jaxpr(fn)(*args)
+        j2 = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+        ck.fail("recompile-key", route,
+                f"[{label}] jaxpr trace failed: {type(e).__name__}: {e}",
+                subject=f"{route}:jaxpr:d={d}")
+        return
+    if str(j1) != str(j2):
+        ck.fail("recompile-key", route,
+                f"[{label}] two traces of the same abstract inputs yield "
+                f"different jaxprs", subject=f"{route}:jaxpr:d={d}")
+    _check_dtypes(ck, route, label, j1)
+
+
 def _check_resolution(ck: _Checker) -> None:
     """epilogue-agree's static half: 'auto' resolves exactly as documented
     (kernel platforms scatter, hosts gather) -- the single-source rule
@@ -707,7 +898,13 @@ def run_contracts(fault: Optional[str] = None) -> List[Finding]:
                 traced += 2 - len(skip)
                 collapsed += len(skip)
                 checker(ck, pts, k, supercell, skip_eps=skip)
-            traced += 2  # the legacy representative always traces both
+            _check_mxu_adaptive(ck, pts, k, supercell)
+            traced += 4  # the legacy representative + adaptive-mxu always
+            #              trace both epilogues (no mxu certificate collapse:
+            #              the MXU core has no legacy twin to be equivalent to)
+    for k in (8, 50):
+        for d in (3, 6):
+            _check_mxu_brute(ck, k, d)
     if collapsed:
         ck.info("matrix-collapse", "equivalence",
                 f"route matrix collapsed by certificate: {traced} epilogue "

@@ -49,7 +49,9 @@ import numpy as np
 
 EQUIV_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "equivalence.json")
-EQUIV_SCHEMA = 1
+# schema 2: cells gained the "mxu" section (the adaptive-mxu plan shape's
+# full-trace hashes + per-class blocked-matmul core hashes, DESIGN.md s16)
+EQUIV_SCHEMA = 2
 
 # The (k, supercell) plan-shape matrix -- matches contracts.run_contracts.
 MATRIX: Tuple[Tuple[int, int], ...] = ((8, 2), (8, 3), (50, 2), (50, 3))
@@ -310,6 +312,62 @@ def _shared_launch_cores(points: np.ndarray, k: int,
     return out
 
 
+_MXU_RT = 0.9  # the certificate's representative sub-1.0 recall target
+
+
+def _mxu_cell(points: np.ndarray, k: int, supercell: int) -> Dict[str, Any]:
+    """The MXU plan shape's certificate section (DESIGN.md section 16).
+
+    The MXU class scorer has no pallas core and no legacy twin -- there is
+    nothing for it to be *equivalent to*, so this section is a drift pin
+    rather than a pair certificate: the canonical FULL-trace hash of the
+    adaptive route under ``scorer='mxu'`` (both epilogue families -- by
+    construction they call the one scorer, so a hash split here means the
+    epilogues stopped sharing it) plus each MXU class's standalone
+    ``grid_class_topk`` core hash at the plan's own capacities.  The
+    verify engine regenerates and diffs it every run: an uncertified edit
+    to the blocked-matmul core, the fold, or the certification arithmetic
+    gates as ``route-diverge`` exactly like a pallas-core drift."""
+    import functools as _ft
+
+    import jax
+
+    from ..mxu.scorer import grid_class_topk
+    from .contracts import _abstract, _mxu_fixture
+
+    cfg, grid, plan = _mxu_fixture(points, k, supercell, _MXU_RT)
+    from ..ops.adaptive import _solve_adaptive
+
+    out: Dict[str, Any] = {"recall_target": _MXU_RT, "trace_hashes": {},
+                           "classes": []}
+    pts = _abstract(grid.points)
+    starts = _abstract(grid.cell_starts)
+    counts = _abstract(grid.cell_counts)
+    for epilogue in ("gather", "scatter"):
+        fn = _ft.partial(_solve_adaptive, n=grid.n_points, k=k,
+                         exclude_self=True, domain=grid.domain,
+                         interpret=False, tile=cfg.stream_tile,
+                         kernel="kpass", epilogue=epilogue,
+                         recall_target=_MXU_RT)
+        jx = jax.make_jaxpr(fn)(pts, starts, counts, plan.classes,
+                               plan.inv_row, plan.inv_box)
+        out["trace_hashes"][epilogue] = canonical_hash(jx)
+    for cp in plan.classes:
+        if cp.route != "mxu":
+            continue
+        fn = _ft.partial(grid_class_topk, qcap=cp.qcap_pad, k=k,
+                         ccap=cp.ccap, exclude_self=True,
+                         recall_target=_MXU_RT)
+        jx = jax.make_jaxpr(fn)(pts, starts, counts, _abstract(cp.own),
+                               _abstract(cp.cand))
+        out["classes"].append({
+            "qcap": int(cp.qcap_pad), "ccap": int(cp.ccap),
+            "core_hash": canonical_hash(jx),
+            "norm_core_hash": canonical_hash(jx, normalize_dims=True),
+        })
+    return out
+
+
 def build_certificates(fault: Optional[str] = None) -> Dict[str, Any]:
     """The full certificate object (the content of equivalence.json).
 
@@ -368,6 +426,7 @@ def build_certificates(fault: Optional[str] = None) -> Dict[str, Any]:
                 "bound_to_shared": bound,
                 "pairs": pairs,
             }
+        cell["mxu"] = _mxu_cell(points, k, supercell)
         cells.append(cell)
     return {"schema": EQUIV_SCHEMA, "cells": cells}
 

@@ -229,7 +229,8 @@ def _r_tracer_leak(ctx: FileContext) -> Iterator[Finding]:
       path_filter=("cuda_knearests_tpu/ops/", "cuda_knearests_tpu/parallel/",
                    "cuda_knearests_tpu/utils/", "cuda_knearests_tpu/api.py",
                    "cuda_knearests_tpu/cluster/",
-                   "cuda_knearests_tpu/oracle.py"))
+                   "cuda_knearests_tpu/oracle.py",
+                   "cuda_knearests_tpu/mxu/"))
 def _r_wide_dtype(ctx: FileContext) -> Iterator[Finding]:
     """f64/i64 on the host is silent 2x width -- fine when chosen (margin
     certificates accumulate in f64 deliberately; cell linearizations need
@@ -336,7 +337,8 @@ def _r_broad_except(ctx: FileContext) -> Iterator[Finding]:
       path_filter=("cuda_knearests_tpu/io.py", "cuda_knearests_tpu/api.py",
                    "cuda_knearests_tpu/parallel/",
                    "cuda_knearests_tpu/serve/",
-                   "cuda_knearests_tpu/cluster/"))
+                   "cuda_knearests_tpu/cluster/",
+                   "cuda_knearests_tpu/mxu/"))
 def _r_bare_valueerror(ctx: FileContext) -> Iterator[Finding]:
     """The input front door (io.validate_or_raise) exists so that illegal
     input is refused with the TYPED taxonomy (utils/memory.py

@@ -54,7 +54,7 @@ _PKG_NAME = os.path.basename(_PKG_ROOT)
 # solve window.  analysis/ itself, the fuzz/bench harnesses, and the CLI
 # surfaces are out of scope (they *wrap* solve windows; their own fetches
 # would double-count the windows they measure).
-SCOPE = ("api.py", "ops", "parallel", "cluster", "serve", "runtime")
+SCOPE = ("api.py", "ops", "parallel", "cluster", "serve", "runtime", "mxu")
 
 _ANNOT_RE = re.compile(r"#\s*syncflow:\s*([A-Za-z0-9_-]+)")
 _DISPATCH_ALIASES = ("_dispatch", "dispatch")
@@ -183,6 +183,21 @@ WINDOWS: Dict[str, Window] = {
             "fof-stage": SiteSpec("stage", "4", "0"),
         },
         syncs="rounds + 1", budget="rounds + 1"),
+    # The brute/MXU route (mxu/solve.py, DESIGN.md section 16): staged
+    # inputs + ONE batched fetch of the selection (ids + certificates --
+    # distances are a pure-host epilogue over it, zero extra syncs), plus
+    # one more batched fetch iff uncertified rows resolve through the
+    # exact brute fallback.  Both selection engines (XLA core / Pallas
+    # kernel) and the elementwise baseline stage at most 4 arrays.
+    "mxu-brute": Window(
+        entries=("mxu.solve.solve_general",),
+        sites={
+            "mxu-stage": SiteSpec("stage", "4", "0"),
+            "mxu-final": SiteSpec("fetch", "1", "4*q*k + q"),
+            "mxu-fallback": SiteSpec("fetch", "fb", "4*u_pad*k"),
+            "mxu-fallback-stage": SiteSpec("stage", "2*fb", "0"),
+        },
+        syncs="1 + fb", budget="2"),
     # Serving overlay query: the base problem's query window, plus one
     # fetch iff a row touched a tombstone, plus one iff the dirty-cell
     # bound could not prune the delta launch.
@@ -223,6 +238,7 @@ ROUTE_WINDOWS: Dict[str, str] = {
     "sharded-query": "sharded-query",
     "fof": "fof",
     "serve-batch": "serve-batch",
+    "mxu-brute": "mxu-brute",
 }
 
 # Sanctioned dispatch sites that live OUTSIDE every solve window: lazy

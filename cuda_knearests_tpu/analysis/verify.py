@@ -242,6 +242,15 @@ def _route_signatures(seed: int) -> Dict[str, tuple]:
     out["sharded-chip"] = signature(
         state, *(cp.qcap_pad for cp in chip.classes),
         *(cp.ccap for cp in chip.classes), k)
+    from .contracts import _mxu_brute_abstract, _mxu_fixture
+
+    _mcfg, _mgrid, mplan = _mxu_fixture(pts, k, supercell)
+    out["adaptive-mxu"] = signature(
+        mplan.classes, *(cp.qcap_pad for cp in mplan.classes),
+        *(cp.ccap for cp in mplan.classes), k)
+    args, statics = _mxu_brute_abstract(k, 3)
+    out["mxu-brute"] = signature(args, statics["k"], statics["m"],
+                                 statics["qc"])
     return out
 
 
@@ -325,6 +334,8 @@ def check_equivalence(fault: Optional[str] = None) -> List[Finding]:
                 if fc["families"][fam] != cc["families"].get(fam):
                     diverged.append(
                         f"k={fc['k']},s={fc['supercell']},{fam}")
+            if fc.get("mxu") != cc.get("mxu"):
+                diverged.append(f"k={fc['k']},s={fc['supercell']},mxu")
         _fail(findings, "route-diverge", "equivalence",
               f"regenerated certificates diverge from the committed "
               f"analysis/equivalence.json at {diverged or ['<structure>']}"
@@ -352,6 +363,24 @@ def check_equivalence(fault: Optional[str] = None) -> List[Finding]:
                   f"launch: "
                   f"{cell['families']['gather']['bound_to_shared']}",
                   subject=f"equiv:{label}")
+        mxu = cell.get("mxu") or {}
+        n_cores = len(mxu.get("classes", ()))
+        eps = sorted(mxu.get("trace_hashes", {}))
+        if n_cores and len(eps) == 2:
+            _info(findings, "route-equiv", "equivalence",
+                  f"[{label}] mxu plan shape pinned: {n_cores} class "
+                  f"core(s) + both epilogue traces at recall_target="
+                  f"{mxu.get('recall_target')} (drift gates as "
+                  f"route-diverge)", subject=f"equiv:mxu:{label}")
+        else:
+            _fail(findings, "route-diverge", "equivalence",
+                  f"[{label}] mxu certificate section is empty or partial "
+                  f"(classes={n_cores}, epilogues={eps}): the MXU plan "
+                  f"shape lost its drift pin",
+                  hint="the adaptive-mxu fixture stopped routing classes "
+                       "to the MXU scorer, or an epilogue trace failed; "
+                       "fix and re-bless with --write-equivalence",
+                  subject=f"equiv:mxu:{label}")
     return findings
 
 

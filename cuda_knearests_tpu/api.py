@@ -67,6 +67,22 @@ def edges_from_neighbors(nbrs: np.ndarray, symmetric: bool = False
     return edges
 
 
+def _config_adaptive_eligible(cfg) -> bool:
+    """THE adaptive-route predicate: prepare's fail-fast scorer guard and
+    solve-time routing must agree on it, or a scorer='mxu' config that
+    passes the refusal can still route legacy and silently score
+    elementwise (the exact case the guard exists to prevent)."""
+    if not (cfg.adaptive and cfg.dist_method == "diff"):
+        return False
+    if cfg.backend == "auto":
+        return True
+    # explicit 'pallas' only routes here where the kernel can actually
+    # run -- off-TPU without interpret it falls through to the legacy
+    # path, which fails loudly instead of silently streaming XLA
+    return (cfg.backend == "pallas"
+            and (jax.devices()[0].platform == "tpu" or cfg.interpret))
+
+
 def _pad_pow2(x: np.ndarray, fill: int, minimum: int = 8) -> np.ndarray:
     m = max(minimum, 1 << (int(x.size) - 1).bit_length()) if x.size else minimum
     out = np.full((m,), fill, x.dtype)
@@ -115,6 +131,24 @@ class KnnProblem:
         from .io import validate_or_raise
 
         config = config or KnnConfig()
+        # fail-fast scorer resolution (DESIGN.md section 16): an illegal
+        # scorer x recall_target combination refuses HERE, not at solve
+        # time -- and the MXU scorer only has a grid-route implementation
+        # on the adaptive class schedule, so configs that would silently
+        # run elementwise under an explicit approximation budget refuse
+        # with a pointer at the route that honors it
+        scorer = config.resolved_scorer()
+        if scorer == "mxu" and not _config_adaptive_eligible(config):
+            from .utils.memory import InvalidConfigError
+
+            raise InvalidConfigError(
+                f"scorer='mxu' (recall_target={config.recall_target}) "
+                f"needs the adaptive grid route (adaptive=True, "
+                f"dist_method='diff', backend 'auto' -- or 'pallas' on "
+                f"TPU/interpret); this config would route to the legacy "
+                f"path and silently score elementwise -- use the "
+                f"brute/MXU route (cuda_knearests_tpu.mxu.solve_general) "
+                f"for plan-free scoring")
         points = (validate_or_raise(points, k=config.k) if validate
                   else np.asarray(points, np.float32))
         grid = build_grid(points, dim=dim, density=config.density)
@@ -162,16 +196,7 @@ class KnnProblem:
         return KnnProblem.prepare(points, self.config, validate=validate)
 
     def _adaptive_eligible(self) -> bool:
-        cfg = self.config
-        if not (cfg.adaptive and cfg.dist_method == "diff"):
-            return False
-        if cfg.backend == "auto":
-            return True
-        # explicit 'pallas' only routes here where the kernel can actually
-        # run -- off-TPU without interpret it falls through to the legacy
-        # path, which fails loudly instead of silently streaming XLA
-        return (cfg.backend == "pallas"
-                and (jax.devices()[0].platform == "tpu" or cfg.interpret))
+        return _config_adaptive_eligible(self.config)
 
     def solve(self) -> KnnResult:
         """Run the grid solve, then resolve uncertified queries exactly

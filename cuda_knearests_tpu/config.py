@@ -169,6 +169,22 @@ class KnnConfig:
 
     k: int = DEFAULT_K
     density: float = DEFAULT_CELL_DENSITY
+    # MXU scoring subsystem (cuda_knearests_tpu/mxu/, DESIGN.md section 16):
+    # 'mxu' recasts candidate scoring as |q|^2 + |p|^2 - 2*QP^T blocked
+    # matmuls with the TPU-KNN in-register approximate top-k (arXiv
+    # 2206.14286); 'elementwise' is the exact diff-arithmetic path every
+    # route has always run; 'auto' resolves from recall_target ('mxu' when
+    # a sub-1.0 target asks for the approximate engine, 'elementwise' at
+    # 1.0 -- the measured-fast exact path on d=3).  Solvers read
+    # resolved_scorer(), never this field.
+    scorer: str = "auto"
+    # TPU-KNN recall/speed knob: the approximate top-k keeps enough
+    # per-block candidates that expected recall@k >= recall_target
+    # (mxu.topk.recall_bound has the derivation).  1.0 = exact selection;
+    # per-row certification bits route any row whose selection is not
+    # PROVABLY exact through the existing one-extra-sync brute fallback,
+    # so the final answer at 1.0 is byte-identical to the elementwise path.
+    recall_target: float = 1.0
     ring_radius: Optional[int] = None
     supercell: int = 3  # best measured tile shape on v5e across k=10..50
     sc_batch: int = 64
@@ -221,6 +237,12 @@ class KnnConfig:
 
         on_kernel = jax.devices()[0].platform == "tpu" or self.interpret
         return resolve_epilogue(self.epilogue, on_kernel)
+
+    def resolved_scorer(self) -> str:
+        """resolve_scorer() against this config -- every solver call site
+        reads this, never the raw ``scorer`` field (same single-source rule
+        as effective_kernel / resolved_epilogue)."""
+        return resolve_scorer(self.scorer, self.recall_target)
 
     def resolved_query_chunk(self) -> Optional[int]:
         """Chunk size of the external-query double-buffered pipeline
@@ -330,6 +352,32 @@ def resolve_epilogue(epilogue: str, on_kernel_platform: bool) -> str:
     if epilogue == "auto":
         return "scatter" if on_kernel_platform else "gather"
     return epilogue
+
+
+def resolve_scorer(scorer: str, recall_target: float) -> str:
+    """'auto' -> 'mxu' below a 1.0 recall target (only the MXU engine has an
+    approximate mode), 'elementwise' at exactly 1.0 (the measured-fast exact
+    arithmetic on d=3 -- a 3-wide contraction leaves the MXU ~2% utilized,
+    see the dist_method docs).  Explicit scorers pass through; an
+    'elementwise' scorer with a sub-1.0 target is refused loudly -- the
+    exact path cannot honor an approximation budget, and silently ignoring
+    the knob would benchmark the wrong engine."""
+    if scorer not in ("auto", "mxu", "elementwise"):
+        raise ValueError(
+            f"unknown scorer {scorer!r}: expected 'auto', 'mxu' or "
+            f"'elementwise'")  # a typo must not silently benchmark the wrong engine
+    r = float(recall_target)
+    if not (0.0 < r <= 1.0):
+        raise ValueError(
+            f"recall_target must lie in (0, 1], got {recall_target!r} "
+            f"(1.0 = exact; the TPU-KNN bound is meaningless outside)")
+    if scorer == "elementwise" and r < 1.0:
+        raise ValueError(
+            f"scorer='elementwise' computes exact top-k only; "
+            f"recall_target={r} needs scorer='mxu' (or 'auto')")
+    if scorer == "auto":
+        return "mxu" if r < 1.0 else "elementwise"
+    return scorer
 
 
 def blocked_topm(k: int, ccap: int) -> int:

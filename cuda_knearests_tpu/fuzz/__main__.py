@@ -40,6 +40,14 @@ def main(argv=None) -> int:
                          "the serving delta overlay vs the rebuild-from-"
                          "scratch oracle; failures minimized and banked "
                          "like point cases -- see fuzz/mutation.py)")
+    ap.add_argument("--approx", action="store_true",
+                    help="run the APPROXIMATE-MODE campaign instead: "
+                         "--cases zoo + block-aliased cases through the "
+                         "brute/MXU route at several recall_target values, "
+                         "asserting measured tie-aware recall >= the "
+                         "TPU-KNN bound and certificate soundness vs the "
+                         "kd-tree oracle; failures minimized and banked as "
+                         "*-approx.npz -- see fuzz/approx.py")
     ap.add_argument("--fof", action="store_true",
                     help="run the FoF campaign instead: --cases clustering "
                          "cases (the same adversarial zoo + seeded linking "
@@ -88,14 +96,37 @@ def main(argv=None) -> int:
             f"{flags} --xla_force_host_platform_device_count="
             f"{max(1, args.devices)}").strip()
 
-    if args.fof and args.mutations is not None:
-        ap.error("--fof and --mutations are mutually exclusive campaigns")
-    if args.fof and args.routes:
+    flavors = [f for f, on in (("--fof", args.fof),
+                               ("--approx", args.approx),
+                               ("--mutations", args.mutations is not None))
+               if on]
+    if len(flavors) > 1:
+        ap.error(f"{' and '.join(flavors)} are mutually exclusive campaigns")
+    if (args.fof or args.approx) and args.routes:
         ap.error("--routes applies to the point-case campaign only; the "
-                 "FoF campaign has a single (grid) route")
-    if args.fof and args.isolation != "auto":
+                 "FoF and approx campaigns each have a single route")
+    if (args.fof or args.approx) and args.isolation != "auto":
         ap.error("--isolation applies to the point-case campaign only; "
-                 "FoF cases run in-process")
+                 "FoF and approx cases run in-process")
+
+    if args.approx:
+        from .approx import run_approx_campaign
+
+        kwargs = {} if args.bank_dir is None else {"bank_dir": args.bank_dir}
+        manifest = run_approx_campaign(
+            n_cases=args.cases, seed=args.seed, budget_s=budget,
+            minimize=not args.no_minimize, **kwargs)
+        if args.manifest:
+            os.makedirs(os.path.dirname(os.path.abspath(args.manifest)),
+                        exist_ok=True)
+            with open(args.manifest, "w") as f:
+                json.dump(manifest, f, indent=2)
+        print(json.dumps(manifest))
+        if not manifest["ok"]:
+            print(f"APPROX FUZZ FAILED: {len(manifest['failures'])} "
+                  f"failure(s); minimized repros banked", file=sys.stderr)
+            return 1
+        return 0
 
     if args.fof:
         from .fof import run_fof_campaign

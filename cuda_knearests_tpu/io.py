@@ -91,16 +91,26 @@ def normalize_points(points: np.ndarray, domain: float = DOMAIN_SIZE) -> np.ndar
 
 def validate_or_raise(points: np.ndarray, k: Optional[int] = None,
                       domain: float = DOMAIN_SIZE,
-                      what: str = "points") -> np.ndarray:
+                      what: str = "points",
+                      dims: Optional[Tuple[int, ...]] = (3,)) -> np.ndarray:
     """THE input front door: every solve route funnels its inputs through
     here (KnnProblem.prepare, the external-query surface, the sharded
-    prepare/query, and the CLI), so "what inputs are legal, and what happens
-    to the rest" is one tested contract rather than scattered checks.
+    prepare/query, the brute/MXU route, and the CLI), so "what inputs are
+    legal, and what happens to the rest" is one tested contract rather than
+    scattered checks.
 
     Legal input (DESIGN.md section 11 has the full table):
-      * ``points``: a (n, 3) array of finite float coordinates inside
-        ``[0, domain]^3`` (the reference's own contract, knearests.cu:21);
-        n = 0 is legal (empty results downstream).
+      * ``points``: a (n, d) array of finite float coordinates with d drawn
+        from ``dims``.  The default ``dims=(3,)`` is the GRID contract: the
+        spatial hash linearizes exactly three axes (gridhash.linearize), so
+        grid routes refuse other widths with an actionable pointer at the
+        dimension-agnostic brute/MXU route (``cuda_knearests_tpu.mxu``,
+        DESIGN.md section 16).  ``dims=None`` accepts any d >= 1 -- the
+        brute/MXU route's contract, which also skips the domain-bounds
+        check below (no grid, no domain; finiteness still holds).
+        Coordinates must lie inside ``[0, domain]^d`` when a grid is in
+        play (the reference's own contract, knearests.cu:21); n = 0 is
+        legal (empty results downstream).
       * ``k`` (when given): a positive integer.  ``k > n`` is legal degraded
         mode -- result rows pad -1/inf beyond the available neighbors, with
         certificates intact -- so it is deliberately NOT rejected here.
@@ -108,7 +118,7 @@ def validate_or_raise(points: np.ndarray, k: Optional[int] = None,
     Raises the typed taxonomy (utils/memory.py; every class subclasses
     ValueError, kind='invalid-input'): InvalidShapeError /
     NonFiniteInputError / DomainBoundsError / InvalidKError.  Returns the
-    validated (n, 3) contiguous float32 array.
+    validated (n, d) contiguous float32 array.
 
     Where the reference silently clamps out-of-range points into boundary
     cells (knearests.cu:26-28) -- quietly corrupting results -- this fails
@@ -128,10 +138,20 @@ def validate_or_raise(points: np.ndarray, k: Optional[int] = None,
     except (TypeError, ValueError) as e:
         raise InvalidShapeError(
             f"{what} are not a numeric array: {e} (input contract: "
-            f"(n, 3) finite float coordinates)") from e
-    if points.ndim != 2 or points.shape[1] != 3:
+            f"(n, d) finite float coordinates)") from e
+    if points.ndim != 2 or points.shape[1] < 1:
         raise InvalidShapeError(
-            f"{what} must be (n, 3), got {points.shape} (input contract)")
+            f"{what} must be a 2-d (n, d) array, got shape {points.shape} "
+            f"(input contract)")
+    if dims is not None and points.shape[1] not in dims:
+        want = dims[0] if len(dims) == 1 else f"one of {dims}"
+        raise InvalidShapeError(
+            f"{what} are (n, {points.shape[1]}) but the grid-route input "
+            f"contract is (n, {want}) -- the spatial hash linearizes "
+            f"exactly that many axes; general-d point sets run on the "
+            f"dimension-agnostic brute/MXU route instead "
+            f"(cuda_knearests_tpu.mxu.knn / mxu.solve_general, DESIGN.md "
+            f"section 16) until the grid hash generalizes")
     if points.size:
         if not np.isfinite(points).all():
             bad = int((~np.isfinite(points)).sum())
@@ -139,7 +159,7 @@ def validate_or_raise(points: np.ndarray, k: Optional[int] = None,
                 f"{what} contain {bad} NaN/inf coordinate(s); clean the "
                 f"input first (input contract: finite f32)")
         lo, hi = float(points.min()), float(points.max())
-        if lo < 0.0 or hi > domain:
+        if dims is not None and (lo < 0.0 or hi > domain):
             raise DomainBoundsError(
                 f"{what} span [{lo:.3g}, {hi:.3g}] but the engine domain "
                 f"contract is [0, {domain:g}]^3 -- run io.normalize_points "

@@ -1,0 +1,41 @@
+"""MXU scoring subsystem: blocked-matmul distances + bounded approximate
+top-k + exact-certify refinement (DESIGN.md section 16).
+
+The TPU's peak FLOP/s live in the MXU; this package recasts candidate
+scoring as ``|q|^2 + |p|^2 - 2 QP^T`` blocked matmuls (TPU-KNN, arXiv
+2206.14286) with the paper's in-register approximate top-k and its
+recall-vs-speed bound surfaced as ``KnnConfig.recall_target``.  The core
+is dimension-agnostic, which is what opens general-d point sets
+(ROADMAP item 4): ``mxu.knn`` / ``mxu.solve_general`` accept ``(n, d)``
+for any d; the grid routes keep their d=3 contract and refuse wider input
+with a pointer here (io.validate_or_raise).
+
+Exactness stays authoritative: every row carries a certification bit
+proving (or declining to prove) that its approximate selection IS a true
+top-k set despite dot-form rounding (topk.py has the bound), and
+uncertified rows batch into the existing one-extra-sync exact brute
+fallback -- at ``recall_target=1.0`` the finalized answer is
+byte-identical to the exact elementwise path.
+
+* :mod:`topk`   -- the recall bound, per-block keep counts, error bound,
+  slot interleave (host math, no jax).
+* :mod:`scorer` -- the shared fold + rescoring, the XLA blocked core, and
+  the grid-fed per-class scorer the adaptive route dispatches to under
+  ``KnnConfig.scorer='mxu'``.
+* :mod:`kernel` -- the Pallas MXU kernel twin of the brute core (TPU /
+  interpret; selection equality vs the XLA core is pinned in tier-1).
+* :mod:`solve`  -- the brute/MXU route: ``solve_general`` (any d, recall
+  knob, counted <= 2-sync finalize) and the ``knn`` convenience.
+
+``python -m cuda_knearests_tpu.mxu`` runs the CPU smoke wired into
+scripts/check.sh: the recall_target=1.0 byte-identity pin, a measured
+recall-vs-bound check, and a general-d exactness check.
+"""
+
+from __future__ import annotations
+
+from .solve import MxuResult, knn, parse_fault, solve_general
+from .topk import BLOCK, per_block_m, recall_bound
+
+__all__ = ["BLOCK", "MxuResult", "knn", "parse_fault", "per_block_m",
+           "recall_bound", "solve_general"]

@@ -1,0 +1,96 @@
+"""MXU subsystem CPU smoke (scripts/check.sh, DESIGN.md section 16).
+
+Three checks, one JSON line each, rc 1 on any failure:
+
+  * **exactness pin** -- ``solve_general(recall_target=1.0, scorer='mxu')``
+    must be BYTE-identical (ids and distances) to the exact elementwise
+    path (``scorer='elementwise'``) on the 20k fixture
+    (``KNTPU_MXU_SMOKE_N`` scales it down for constrained runners; the
+    full-size pin also lives in tier-1, tests/test_mxu.py).
+  * **recall bound** -- a clustered cloud at a sub-1.0 ``recall_target``
+    with ``refine='none'``: the measured tie-aware recall vs the exact
+    f64 oracle -- at the route's declared ``2B`` scoring precision, the
+    fuzz comparator's discipline -- must meet the configured TPU-KNN
+    bound, and every row whose certificate claims exactness must BE
+    exact (band-free).
+  * **general-d** -- a d=6 cloud at ``recall_target=1.0`` must match a
+    host f64 brute-force oracle exactly (tie-aware) end to end.
+
+Run:  JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.mxu
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+# the ONE recall oracle (mxu/measure.py) -- re-exported here because the
+# smoke predates the shared module and tests/bench historically imported
+# the measures from this entry point
+from .measure import certified_recall, declared_band, measured_recall
+
+_certified_recall = certified_recall
+
+
+def _row(name: str, ok: bool, **fields) -> bool:
+    print(json.dumps({"check": name, "ok": bool(ok), **fields}), flush=True)
+    return bool(ok)
+
+
+def main() -> int:
+    from ..io import generate_clustered, get_dataset
+    from . import solve_general
+
+    rc = 0
+
+    # 1. the exactness pin: byte-identity at recall_target=1.0
+    n_pin = int(os.environ.get("KNTPU_MXU_SMOKE_N", "20626"))
+    pts = get_dataset("pts20K.xyz")
+    if n_pin < pts.shape[0]:
+        pts = np.ascontiguousarray(pts[:n_pin])
+    k = 10
+    a = solve_general(pts, k=k, recall_target=1.0, scorer="mxu")
+    b = solve_general(pts, k=k, scorer="elementwise")
+    ids_eq = bool(np.array_equal(a.neighbors, b.neighbors))
+    d2_eq = bool(np.array_equal(a.dists_sq, b.dists_sq))
+    if not _row("byte-identity", ids_eq and d2_eq, n=int(pts.shape[0]),
+                k=k, ids_equal=ids_eq, dists_equal=d2_eq,
+                uncert_count=int(a.uncert_count),
+                backend=a.backend):
+        rc = 1
+
+    # 2. measured recall >= the configured TPU-KNN bound (approx mode),
+    #    and certified rows are actually exact
+    target = 0.75
+    cl = generate_clustered(6000, seed=17)
+    res = solve_general(cl, k=k, recall_target=target, refine="none")
+    rec = measured_recall(cl, res.neighbors, k, band=declared_band(cl))
+    cert_rows = np.nonzero(res.certified)[0]
+    cert_ok = True
+    if cert_rows.size:
+        sub_rec = certified_recall(cl, res.neighbors, cert_rows, k)
+        cert_ok = sub_rec >= 1.0
+    if not _row("recall-bound", rec >= res.bound and cert_ok,
+                recall_target=target, bound=round(res.bound, 6),
+                measured=round(rec, 6), m=res.m, n_blocks=res.n_blocks,
+                certified_fraction=round(float(res.certified.mean()), 4),
+                certified_rows_exact=bool(cert_ok)):
+        rc = 1
+
+    # 3. general-d end to end (the d != 3 workload, ROADMAP item 4)
+    rng = np.random.default_rng(23)
+    d6 = (rng.random((2048, 6)) * 100.0).astype(np.float32)
+    r6 = solve_general(d6, k=8, recall_target=1.0)
+    rec6 = measured_recall(d6, r6.neighbors, 8)
+    if not _row("general-d", rec6 >= 1.0, d=6, n=2048, k=8,
+                measured=round(rec6, 6),
+                certified=bool(r6.certified.all())):
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

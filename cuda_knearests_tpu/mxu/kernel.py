@@ -1,0 +1,183 @@
+"""Pallas MXU kernel for the brute blocked-matmul top-k (any d).
+
+One program per 128-query block: the query block (128, d_pad) and the full
+interleaved candidate set (C, d_pad) live in VMEM; the kernel walks the
+candidate axis one 128-lane block at a time, computes each (128, 128)
+dot-form score tile ON THE MXU (``jnp.dot`` with f32 accumulation --
+pallas_guide.md "Matrix Operations"), reduces it to its ascending top-m by
+m min-and-mask passes while it lives in registers, and runs the final
+top-k on the (G*m, 128) survivor pool -- the TPU-KNN in-register
+approximate top-k (arXiv 2206.14286), structurally the general-d twin of
+``pallas_solve._kernel_blocked``.
+
+The kernel emits the SELECTION (ids + dot-form scores + the certification
+bit from ``kplus >= t + 2B``, topk.py); the exact diff-arithmetic
+rescoring is a shared XLA post-pass (scorer.rescore_sorted), identical to
+the XLA twin's, so the two backends differ only in who runs the fold.
+``tests/test_mxu.py`` pins kernel-vs-twin selection equality in interpret
+mode.
+
+Layouts (all (8, 128)-aligned): queries/candidates pad d to a sublane
+multiple and their point axes to 128 lanes; candidate ids ride as a
+(G, 128) block so block g is a static-stride sublane slice; the survivor
+pool and rem live in VMEM scratch, written at dynamic SUBLANE offsets
+(``pl.ds`` -- the documented Mosaic pattern; lane offsets are always
+static, so the (128, k) output tiles accumulate through iota masks).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .topk import BLOCK, dot_error_bound
+
+_BIG_ID = 2**31 - 1
+
+# Per-program VMEM budget for choosing the kernel path (same constant
+# rationale as pallas_solve._VMEM_BUDGET: headroom for Mosaic's own
+# double-buffering under the 128 MiB v5e budget).
+_VMEM_BUDGET = 32 * 1024 * 1024
+
+
+def kernel_fits(c_pad: int, d_pad: int, k: int, m: int) -> bool:
+    """True when the resident candidate set + survivor pool + tiles fit
+    one program's VMEM budget (the brute route falls back to the XLA twin
+    otherwise -- same contract as pallas_solve.pick_qsub returning 0)."""
+    g = c_pad // BLOCK
+    resident = c_pad * max(8, d_pad) * 4          # candidate coords
+    scratch = (g * m * BLOCK * 8) + (g * BLOCK * 4)   # pool v+i, rem
+    tiles = BLOCK * BLOCK * 4 * 4                 # score tile + temporaries
+    outs = 2 * BLOCK * (-(-k // BLOCK) * BLOCK) * 4
+    return resident + scratch + tiles + outs <= _VMEM_BUDGET
+
+
+def _select_kernel(q_ref, qid_ref, p_ref, cid_ref, out_i_ref, out_v_ref,
+                   cert_ref, pool_v_ref, pool_i_ref, rem_ref, *, k: int,
+                   m: int, d_real: int, exclude_self: bool):
+    """One 128-query block: stage-1 per-block top-m into the VMEM pool,
+    stage-2 k-pass selection + the (k+1)-th probe, certification."""
+    g_total = cid_ref.shape[0]
+    q = q_ref[:, :]                                  # (128, d_pad)
+    qn = jnp.sum(q * q, axis=1)                      # (128,)
+    qid = qid_ref[0, :].reshape(-1, 1) if exclude_self else None
+
+    def s1_body(g, pn_max):
+        p_blk = p_ref[pl.ds(g * BLOCK, BLOCK), :]    # (128, d_pad)
+        cid = cid_ref[pl.ds(g, 1), :]                # (1, 128)
+        pn = jnp.sum(p_blk * p_blk, axis=1)          # (128,)
+        # the MXU contraction: (128, d) x (d, 128) with f32 accumulation
+        qp = jax.lax.dot_general(q, p_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        s = qn[:, None] + pn[None, :] - 2.0 * qp     # (128q, 128c)
+        drop = cid < 0
+        if exclude_self:
+            drop = drop | (cid == qid)
+        s = jnp.where(drop, jnp.inf, s)
+
+        def m_body(j, s):
+            mv = jnp.min(s, axis=1)                  # (128q,)
+            sel = s == mv[:, None]
+            bid = jnp.min(jnp.where(sel, cid, _BIG_ID), axis=1)
+            pool_v_ref[pl.ds(g * m + j, 1), :] = mv.reshape(1, -1)
+            pool_i_ref[pl.ds(g * m + j, 1), :] = bid.reshape(1, -1)
+            return jnp.where(sel & (cid == bid[:, None]), jnp.inf, s)
+
+        s = jax.lax.fori_loop(0, m, m_body, s)
+        # the block's smallest REJECTED score (inf when it kept all)
+        rem_ref[pl.ds(g, 1), :] = jnp.min(s, axis=1).reshape(1, -1)
+        return jnp.maximum(pn_max,
+                           jnp.max(jnp.where(cid[0, :] < 0, -jnp.inf, pn)))
+
+    pn_max = jax.lax.fori_loop(0, g_total, s1_body, jnp.float32(0.0))
+
+    pool_v = pool_v_ref[:, :]                        # (G*m, 128q)
+    pool_i = pool_i_ref[:, :]
+    lane_j = jax.lax.broadcasted_iota(jnp.int32, (BLOCK, k), 1)
+
+    def s2_body(j, carry):
+        pool_v, acc_v, acc_i = carry
+        mv = jnp.min(pool_v, axis=0)                 # (128q,)
+        sel = pool_v == mv[None, :]
+        bid = jnp.min(jnp.where(sel, pool_i, _BIG_ID), axis=0)
+        hit = lane_j == j
+        acc_v = jnp.where(hit, mv[:, None], acc_v)
+        acc_i = jnp.where(hit, bid[:, None], acc_i)
+        return (jnp.where(sel & (pool_i == bid[None, :]), jnp.inf, pool_v),
+                acc_v, acc_i)
+
+    pool_v, acc_v, acc_i = jax.lax.fori_loop(
+        0, k, s2_body,
+        (pool_v, jnp.full((BLOCK, k), jnp.inf, jnp.float32),
+         jnp.full((BLOCK, k), _BIG_ID, jnp.int32)))
+    out_v_ref[:, :] = acc_v
+    out_i_ref[:, :] = acc_i
+    # certification (topk.py): every non-selected score >= kplus; the row
+    # certifies iff kplus clears t by twice the dot-form error bound
+    t = jnp.max(jnp.where(jnp.isfinite(acc_v), acc_v, -jnp.inf), axis=1)
+    t = jnp.where(jnp.any(jnp.isfinite(acc_v), axis=1), t,
+                  jnp.full_like(t, jnp.inf))
+    kplus = jnp.minimum(jnp.min(rem_ref[:, :], axis=0),
+                        jnp.min(pool_v, axis=0))     # pool's (k+1)-th
+    # the ONE certification bound (topk.dot_error_bound, plain arithmetic,
+    # traces fine in-kernel): re-deriving it here would let the two
+    # engines certify with different bands the moment the bound changes
+    err_b = dot_error_bound(qn, pn_max, d_real)
+    cert_ref[0, :] = (kplus >= t + 2.0 * err_b).astype(jnp.int32)
+
+
+def select_pallas(queries, q_ids, pts_il, cid_il, k: int, m: int,
+                  d_real: int, exclude_self: bool, interpret: bool):
+    """Launch the selection kernel over 128-query blocks.
+
+    queries (Mp, d_pad) with Mp a 128 multiple; q_ids (Mp,); pts_il
+    (C, d_pad) interleaved padded candidates; cid_il (C,) ids (-1 pads).
+    Returns (sel_ids (Mp, k) by ascending dot score, sel_scores (Mp, k),
+    certified (Mp,) bool) -- same contract as scorer.block_fold, ready for
+    the shared rescore_sorted post-pass.
+    """
+    mp, d_pad = queries.shape
+    c_pad = pts_il.shape[0]
+    g = c_pad // BLOCK
+    n_qblk = mp // BLOCK
+    q_spec = pl.BlockSpec((BLOCK, d_pad), lambda b: (b, 0),
+                          memory_space=pltpu.VMEM)
+    qid_spec = pl.BlockSpec((1, BLOCK), lambda b: (b, 0),
+                            memory_space=pltpu.VMEM)
+    p_spec = pl.BlockSpec((c_pad, d_pad), lambda b: (0, 0),
+                          memory_space=pltpu.VMEM)
+    cid_spec = pl.BlockSpec((g, BLOCK), lambda b: (0, 0),
+                            memory_space=pltpu.VMEM)
+    out_specs = [
+        pl.BlockSpec((BLOCK, k), lambda b: (b, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((BLOCK, k), lambda b: (b, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, BLOCK), lambda b: (b, 0), memory_space=pltpu.VMEM),
+    ]
+    out_i, out_v, cert = pl.pallas_call(
+        functools.partial(_select_kernel, k=k, m=m, d_real=d_real,
+                          exclude_self=exclude_self),
+        grid=(n_qblk,),
+        in_specs=[q_spec, qid_spec, p_spec, cid_spec],
+        out_specs=out_specs,
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, k), jnp.int32),
+            jax.ShapeDtypeStruct((mp, k), jnp.float32),
+            jax.ShapeDtypeStruct((n_qblk, BLOCK), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((g * m, BLOCK), jnp.float32),
+                        pltpu.VMEM((g * m, BLOCK), jnp.int32),
+                        pltpu.VMEM((g, BLOCK), jnp.float32)],
+        interpret=interpret,
+    )(queries, q_ids.reshape(n_qblk, BLOCK), pts_il,
+      cid_il.reshape(g, BLOCK))
+    # sanitize like scorer.solve_blocks_xla: an all-inf pool can emit a
+    # REAL id with an inf score (min-id over equal-inf slots), so validity
+    # keys on the score and ids carry the -1 sentinel for the host epilogue
+    invalid = (out_i == _BIG_ID) | ~jnp.isfinite(out_v)
+    sel_v = jnp.where(invalid, jnp.inf, out_v)
+    sel_i = jnp.where(invalid, -1, out_i)
+    return sel_i, sel_v, cert.reshape(-1).astype(bool)

@@ -1,0 +1,132 @@
+"""The ONE tie-aware f64 recall oracle of the MXU subsystem.
+
+Every gate that measures recall -- the CPU smoke (``mxu/__main__``), the
+approximate-mode fuzz flavor (``fuzz/approx``), and ``bench.py
+--frontier``'s ``recall_ok`` bar -- imports THIS module, so they all
+measure the same claim with the same tie discipline; two hand-rolled
+copies would let a tie-rule fix in one silently desynchronize the fuzz
+comparator from the bench gate (DESIGN.md section 16).
+
+The discipline, in both measures:
+
+* a returned id counts as a hit iff its exact f64 squared distance does
+  not exceed the true k-th distance -- any member of a tied boundary
+  group is a valid top-k pick (the fuzz campaign's comparator rule);
+* **band-free** measurement (``band=None``) additionally accepts a pick
+  that TIES the true k-th at f32 resolution: engines select in f32 (the
+  refined/exact tier through the f32 diff brute force, the approximate
+  tier through the f32 dot form), so two boundary candidates whose f64
+  distances differ below one f32 ulp are indistinguishable to any engine
+  under the subsystem's own arithmetic contract -- holding the selection
+  to strict f64 ordering would fail byte-correct results exactly when
+  the measured recall is gated at 1.0;
+* the **declared-precision** measurement widens the hit threshold by the
+  per-row dot-form rounding band ``2B`` (``declared_band``, the same
+  band the certificate reasons with) -- the recall-vs-bound measure for
+  unrefined approximate rows, whose selection never claimed f64
+  ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def declared_band(points: np.ndarray,
+                  queries: Optional[np.ndarray] = None) -> np.ndarray:
+    """Per-query scoring-precision band ``2B`` of the dot-form route
+    (topk.dot_error_bound -- the same band the certificate reasons
+    with): the width within which f32 blocked-matmul scores provably
+    cannot order candidates.  Recall measured at the route's declared
+    precision widens the hit threshold by this band."""
+    from .topk import dot_error_bound
+
+    p64 = points.astype(np.float64)  # kntpu-ok: wide-dtype -- oracle math: the band is a bound on f32 error, computed exactly
+    q64 = p64 if queries is None else queries.astype(np.float64)  # kntpu-ok: wide-dtype -- oracle math
+    qn = (q64 * q64).sum(axis=1)
+    pn_max = float((p64 * p64).sum(axis=1).max()) if p64.size else 0.0
+    return 2.0 * dot_error_bound(qn, pn_max, points.shape[1])
+
+
+def f64_kth(points: np.ndarray, k: int,
+            queries: Optional[np.ndarray] = None,
+            exclude: Optional[np.ndarray] = None,
+            exclude_self: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-query (k-th true squared distance, available-neighbor count)
+    in exact f64 -- the tie threshold of both recall measures.  Chunked
+    brute force; fine to a few 10k points.  ``exclude`` masks one
+    candidate column per query (self-exclusion at arbitrary indices);
+    the default self-solve (``queries=None, exclude_self=True``) masks
+    the diagonal."""
+    p64 = points.astype(np.float64)  # kntpu-ok: wide-dtype -- oracle math: tie thresholds in exact f64
+    q64 = p64 if queries is None else queries.astype(np.float64)  # kntpu-ok: wide-dtype -- oracle math
+    if exclude is None and queries is None and exclude_self:
+        exclude = np.arange(p64.shape[0])
+    m = q64.shape[0]
+    kth = np.empty((m,), np.float64)  # kntpu-ok: wide-dtype -- oracle math
+    avail = np.empty((m,), np.int64)  # kntpu-ok: wide-dtype -- oracle math
+    chunk = max(1, int(2.0e7) // max(1, p64.shape[0]))
+    for s in range(0, m, chunk):
+        q = q64[s:s + chunk]
+        d2 = ((q[:, None, :] - p64[None, :, :]) ** 2).sum(-1)
+        if exclude is not None:
+            d2[np.arange(q.shape[0]), exclude[s:s + q.shape[0]]] = np.inf
+        a = np.minimum(k, np.isfinite(d2).sum(1))
+        avail[s:s + chunk] = a
+        kth[s:s + chunk] = np.sort(d2, axis=1)[
+            np.arange(q.shape[0]), np.maximum(a, 1) - 1]
+    return kth, avail
+
+
+def row_hits(points: np.ndarray, neighbors: np.ndarray,
+             kth: np.ndarray,
+             band: Optional[np.ndarray] = None,
+             queries: Optional[np.ndarray] = None) -> np.ndarray:
+    """Tie-aware per-row hit counts against precomputed ``kth``
+    thresholds (module docstring has the full discipline)."""
+    p64 = points.astype(np.float64)  # kntpu-ok: wide-dtype -- oracle math
+    q64 = p64 if queries is None else queries.astype(np.float64)  # kntpu-ok: wide-dtype -- oracle math
+    valid = neighbors >= 0
+    c = p64[np.where(valid, neighbors, 0)]
+    gd = ((q64[:, None, :] - c) ** 2).sum(-1)
+    if band is not None:
+        hit = gd <= (kth + band)[:, None]
+    else:
+        # f32-tie discipline: a pick tying the true kth at f32 resolution
+        # is a valid boundary-group member under the engines' own f32
+        # arithmetic contract
+        hit = ((gd <= kth[:, None])
+               | (gd.astype(np.float32) <= kth[:, None].astype(np.float32)))
+    return (valid & hit).sum(axis=1)
+
+
+def measured_recall(points: np.ndarray, neighbors: np.ndarray,
+                    k: int, queries: Optional[np.ndarray] = None,
+                    exclude_self: bool = True,
+                    band: Optional[np.ndarray] = None) -> float:
+    """Aggregate tie-aware recall@k vs the exact f64 oracle.  ``band``
+    (e.g. ``declared_band``) switches from the band-free f32-tie measure
+    to the route's declared-precision measure; an empty/neighborless
+    cloud is vacuously 1.0."""
+    exclude = (np.arange(points.shape[0])
+               if queries is None and exclude_self else None)
+    kth, avail = f64_kth(points, k, queries=queries, exclude=exclude,
+                         exclude_self=False)
+    hits = row_hits(points, neighbors, kth, band=band, queries=queries)
+    total = int(avail.sum())
+    return float(hits.sum()) / total if total else 1.0
+
+
+def certified_recall(points: np.ndarray, neighbors: np.ndarray,
+                     rows: np.ndarray, k: int) -> float:
+    """Band-free recall restricted to ``rows`` (the certified-claim
+    audit: a certified row below 1.0 is a SOUNDNESS failure, the exact
+    shape the KNTPU_MXU_FAULT=drop-block self-test plants)."""
+    q = points[rows]
+    kth, avail = f64_kth(points, k, queries=q, exclude=rows,
+                         exclude_self=False)
+    hits = row_hits(points, neighbors[rows], kth, queries=q)
+    total = int(avail.sum())
+    return float(hits.sum()) / total if total else 1.0

@@ -1,0 +1,305 @@
+"""Blocked-matmul distance scoring with the TPU-KNN approximate top-k.
+
+The scoring core recasts candidate distances as ``|q|^2 + |p|^2 - 2 QP^T``
+so the O(Q * C * d) work lands on the MXU as a blocked matmul (f32
+accumulation via ``preferred_element_type``) instead of the VPU's
+elementwise diff path -- the TPU-KNN formulation (arXiv 2206.14286).  The
+core is dimension-agnostic by construction: ``d`` is just the contraction
+axis, which is what opens the general-d workload (ROADMAP item 4).
+
+Three layers, all sharing one selection/certification fold (topk.py has
+the math and the soundness argument):
+
+* :func:`block_fold` -- the in-register approximate top-k: per-128-lane
+  block top-m + the block's smallest *rejected* score, folded into a
+  ``G * m`` pool, exact top-k over the pool, and the per-row certification
+  bit ``kplus >= t + 2B`` that proves the selected id set is a true top-k
+  set despite the dot-form's cancellation error.
+* :func:`rescore_sorted` -- selected ids re-scored in the engine's exact
+  ``diff`` arithmetic (the same subtract-square-accumulate loop, axis
+  order 0..d-1, as ops/solve.py) and re-sorted ascending with min-id tie
+  break.  This is the DEVICE-side variant the grid-fed class scorer uses
+  (its rows feed the margin certificate in-program); the brute route's
+  final distances are instead a HOST epilogue over the one fetched
+  selection (solve.py ``_host_rescore``), because XLA strips
+  ``optimization_barrier`` on CPU and reassociates/FMA-contracts the
+  3-term sum SHAPE-DEPENDENTLY -- measured: 3 rows of the 20k fixture
+  flip 1 ulp between two shapes of the same program.  Host numpy is
+  strict IEEE at every shape, which is what makes ``recall_target=1.0``
+  byte-identity with the exact elementwise path pinnable (the same
+  host-epilogue precedent as the plane feed, DESIGN.md section 14).
+* :func:`solve_blocks_xla` / :func:`grid_class_topk` -- the brute
+  (all-candidates, any d) core and the grid-fed (d=3, per-class candidate
+  boxes) core.  Both are pure XLA: the batched matmul lowers onto the MXU
+  on TPU by itself; the hand-blocked Pallas twin (kernel.py) exists for
+  the brute route where the fold can stay in registers.
+
+Seeded faults (``KNTPU_MXU_FAULT``, resolved by the solve wrapper and
+passed as a static): ``drop-block`` silently discards block 0's pool
+contribution AFTER certification (a certified-yet-incomplete row -- the
+shape of a broken fold), ``skip-certify`` forces every row certified (a
+dead refinement tier).  Each must yield a banked failure in the
+``fuzz --approx`` self-test (scripts/check.sh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.solve import pack_cells
+from ..ops.topk import INVALID_ID
+from .topk import BLOCK, dot_error_bound, interleave_slots, per_block_m
+
+#: Score-tile budget (bytes) per chunk: bounds the (qc, C) f32 tile the
+#: blocked matmul materializes per step on the XLA path.
+_MXU_TILE_BYTES = 64 << 20
+
+FAULTS = ("drop-block", "skip-certify")
+
+
+def _sort_pairs(vals: jax.Array, ids: jax.Array):
+    """Ascending lexicographic sort by (value, id) along the last axis --
+    the canonical tie rule of this subsystem (min id among equal values,
+    matching the Pallas kernels' min-and-mask convention)."""
+    return jax.lax.sort((vals, ids), num_keys=2, dimension=-1)
+
+
+def block_fold(s: jax.Array, ids: jax.Array, k: int, m: int,
+               err_b: jax.Array, fault: Optional[str] = None
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The TPU-KNN fold over a scored tile.
+
+    s:     (..., C) dot-form scores, C a BLOCK multiple; masked slots +inf.
+    ids:   (..., C) global candidate ids aligned with ``s``.
+    err_b: (...,) per-row dot-vs-true error bound B (topk.dot_error_bound).
+    Returns (sel_ids (..., k), sel_scores (..., k) ascending dot-form,
+    certified (...,)) -- see topk.py for the certification soundness proof.
+    """
+    lead = s.shape[:-1]
+    c = s.shape[-1]
+    if c % BLOCK != 0:
+        raise ValueError(f"candidate axis {c} is not a {BLOCK} multiple")  # kntpu-ok: bare-valueerror -- internal layout invariant (callers pad), not user input
+    g = c // BLOCK
+    m = min(m, BLOCK)
+    sb = s.reshape(lead + (g, BLOCK))
+    mm = min(m + 1, BLOCK)
+    neg, slot = jax.lax.top_k(-sb, mm)              # (..., g, mm) ascending
+    # barrier before slicing: a consumer that slices top_k's INDEX output
+    # defeats XLA CPU's TopK custom-call lowering and falls back to a
+    # generic sort (measured 13.8s -> 2.0s for one 8k fold on this host);
+    # free where the fast path was already taken
+    neg, slot = jax.lax.optimization_barrier((neg, slot))
+    vals = -neg
+    # block g's smallest REJECTED score: the (m+1)-th smallest, inf when
+    # the block kept everything it had (m == BLOCK, or fewer real slots)
+    rem = vals[..., m] if mm > m else jnp.full(lead + (g,), jnp.inf,
+                                               jnp.float32)
+    kept_v = vals[..., :m].reshape(lead + (g * m,))
+    flat = (slot[..., :m]
+            + (jnp.arange(g, dtype=jnp.int32) * BLOCK)[..., :, None])
+    kept_i = jnp.take_along_axis(ids, flat.reshape(lead + (g * m,)),
+                                 axis=-1)
+    pad = max(0, k + 1 - g * m)
+    if pad:
+        # tiny pools (few blocks at small m) widen with inf sentinels so
+        # the k-th / (k+1)-th reads below are always in range
+        kept_v = jnp.concatenate(
+            [kept_v, jnp.full(lead + (pad,), jnp.inf, jnp.float32)], axis=-1)
+        kept_i = jnp.concatenate(
+            [kept_i, jnp.full(lead + (pad,), INVALID_ID, jnp.int32)],
+            axis=-1)
+    sv, si = _sort_pairs(kept_v, kept_i)
+    t = sv[..., k - 1]
+    # smallest score the selection EXCLUDED: pool overflow or block reject
+    kplus = jnp.minimum(jnp.min(rem, axis=-1), sv[..., k])
+    cert = kplus >= t + 2.0 * err_b
+    if fault == "skip-certify":
+        cert = jnp.ones_like(cert)
+    if fault == "drop-block":
+        # certification above saw the full pool; the selection below
+        # silently loses block 0's survivors -- a certified-yet-incomplete
+        # row, the exact shape the fuzz --approx soundness check exists for
+        flat_all = flat.reshape(lead + (g * m,))
+        if pad:
+            flat_all = jnp.concatenate(
+                [flat_all, jnp.full(lead + (pad,), BLOCK, jnp.int32)],
+                axis=-1)
+        from_blk0 = flat_all < BLOCK
+        sv, si = _sort_pairs(jnp.where(from_blk0, jnp.inf, kept_v),
+                             jnp.where(from_blk0, INVALID_ID, kept_i))
+    return si[..., :k], sv[..., :k], cert
+
+
+def rescore_sorted(points: jax.Array, q: jax.Array, sel_i: jax.Array,
+                   sel_s: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Re-score selected ids in the exact diff arithmetic and re-sort.
+
+    points (n, d) storage; q (..., d) query coords; sel_i/sel_s (..., k)
+    from block_fold.  Returns ((..., k) i32 ids, INVALID_ID pads; (..., k)
+    f32 d2 ascending) -- distances computed as the engine's canonical
+    subtract-square-accumulate over axes 0..d-1 (ops/solve.py), so emitted
+    values are byte-comparable with the elementwise routes."""
+    valid = jnp.isfinite(sel_s)
+    safe = jnp.where(valid & (sel_i >= 0), sel_i, 0)
+    c = jnp.take(points, safe, axis=0)              # (..., k, d)
+    d2 = jnp.zeros(sel_i.shape, jnp.float32)
+    for ax in range(points.shape[1]):
+        diff = q[..., None, ax] - c[..., ax]
+        d2 = d2 + diff * diff
+    d2 = jnp.where(valid, d2, jnp.inf)
+    ids = jnp.where(valid, sel_i, INVALID_ID).astype(jnp.int32)
+    d2s, ids_s = _sort_pairs(d2, ids)
+    return ids_s, d2s
+
+
+def score_tile(q: jax.Array, p: jax.Array) -> jax.Array:
+    """One (Q, C) dot-form score tile: |q|^2 + |p|^2 - 2 q.p with f32
+    accumulation -- the MXU contraction (XLA lowers the matmul onto the
+    MXU on TPU; the Pallas twin issues the same jnp.dot in-kernel)."""
+    qn = jnp.sum(q * q, axis=-1)
+    pn = jnp.sum(p * p, axis=-1)
+    qp = jnp.dot(q, p.T, preferred_element_type=jnp.float32)
+    return qn[:, None] + pn[None, :] - 2.0 * qp
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "exclude_self",
+                                             "qc", "fault"))
+def solve_blocks_xla(pts_il: jax.Array, cid_il: jax.Array,
+                     queries: jax.Array, q_ids: jax.Array, k: int, m: int,
+                     exclude_self: bool, qc: int,
+                     fault: Optional[str] = None):
+    """The brute MXU core (any d): every query scored against every stored
+    point in BLOCK-wide bins, approximate top-k + certification, chunked
+    over the query axis to bound the score tile.
+
+    pts_il/cid_il: (C, d)/(C,) interleaved padded candidates + global ids
+      (-1 pads) -- built host-side by the solve wrapper (interleave_slots).
+    queries: (M, d), M a ``qc`` multiple (wrapper pads); q_ids (M,) the
+      global id each query excludes (-1 = exclude nothing / padded row).
+    Returns the SELECTION: (ids (M, k) i32 by ascending dot score, -1
+    where fewer than k candidates exist; scores (M, k) f32 dot-form;
+    cert (M,) bool).  The exact diff-arithmetic distances and the final
+    (d2, id) ordering are the caller's host epilogue
+    (solve._host_rescore) -- see rescore_sorted's docstring for why the
+    byte-identity contract forces them off-device.
+    """
+    d = pts_il.shape[1]
+    pn = jnp.sum(pts_il * pts_il, axis=1)
+    pn_max = jnp.max(jnp.where(cid_il >= 0, pn, -jnp.inf), initial=0.0)
+
+    def chunk(args):
+        q_c, qid_c = args
+        s = score_tile(q_c, pts_il)
+        drop = cid_il[None, :] < 0
+        if exclude_self:
+            drop = drop | (cid_il[None, :] == qid_c[:, None])
+        s = jnp.where(drop, jnp.inf, s)
+        qn = jnp.sum(q_c * q_c, axis=1)
+        err_b = dot_error_bound(qn, pn_max, d)
+        ids_b = jnp.broadcast_to(cid_il[None, :], s.shape)
+        sel_i, sel_s, cert = block_fold(s, ids_b, k, m, err_b, fault)
+        # a dropped/pad candidate can ride out of the fold carrying a REAL
+        # id with an inf score (min-id over an all-inf pool); sanitize to
+        # the -1 sentinel so the host epilogue keys validity on ids alone
+        sel_i = jnp.where(jnp.isfinite(sel_s), sel_i, INVALID_ID)
+        return sel_i, sel_s, cert
+
+    n_chunks = queries.shape[0] // qc
+    ids, scores, cert = jax.lax.map(
+        chunk, (queries.reshape(n_chunks, qc, d),
+                q_ids.reshape(n_chunks, qc)))
+    return (ids.reshape(-1, k), scores.reshape(-1, k), cert.reshape(-1))
+
+
+# -- grid-fed d=3 class scoring (the adaptive route's 'mxu' scorer) -----------
+
+#: Per-chunk (rows, qcap, ccap) score-tile ceiling for the class scorer --
+#: same order as adaptive._DENSE_TILE_BYTES; classes past it at one row
+#: per chunk fall back to their elementwise route (exact, never silent).
+_CLASS_TILE_BYTES = 64 << 20
+
+
+def class_eligible(qcap: int, ccap: int) -> bool:
+    """True when one class row's (qcap, ccap) score tile fits the chunk
+    budget (ccap is a BLOCK multiple by plan construction)."""
+    return ccap % BLOCK == 0 and qcap * ccap * 4 <= _CLASS_TILE_BYTES
+
+
+def grid_class_topk(points: jax.Array, starts: jax.Array,
+                    counts: jax.Array, own_cells: jax.Array,
+                    cand_cells: jax.Array, qcap: int, k: int, ccap: int,
+                    exclude_self: bool, recall_target: float):
+    """One adaptive class's self-solve through the MXU scorer: CSR-packed
+    queries x candidate boxes scored as blocked matmuls, the TPU-KNN fold,
+    diff-arithmetic rescoring, and NaN-decertification.
+
+    Same flat output contract as adaptive._dense_self -- (Sc * qcap, k)
+    row-major dists/ids, ascending -- with one addition: a row whose
+    selection did not certify carries NaN at column k-1, which fails the
+    downstream margin certificate in every epilogue (the blocked kernel's
+    established decertify trick), so it resolves through the standard
+    exact fallback.  At recall_target=1.0 the fold is exhaustive and the
+    NaN only fires on dot-arithmetic boundary ambiguity (topk.py), keeping
+    the finalized result byte-identical to the elementwise path.
+    """
+    n_sc = own_cells.shape[0]
+    g = ccap // BLOCK
+    m = per_block_m(recall_target, k, g)
+    rows_chunk = max(1, min(n_sc, _CLASS_TILE_BYTES // max(1, qcap * ccap * 4)))
+    n_chunks = -(-n_sc // rows_chunk)
+    il = jnp.asarray(interleave_slots(ccap))
+    d = points.shape[1]
+
+    def pad_rows(a, fill):
+        pad = n_chunks * rows_chunk - a.shape[0]
+        if pad:
+            a = jnp.concatenate(
+                [a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)])
+        return a.reshape((n_chunks, rows_chunk) + a.shape[1:])
+
+    def step(_, inp):
+        own_c, cand_c = inp
+        qi_c, qo_c = pack_cells(own_c, starts, counts, qcap)
+        ci_c, co_c = pack_cells(cand_c, starts, counts, ccap)
+        # round-robin slot interleave (the _pack_inputs trick): CSR packing
+        # puts spatially-adjacent candidates in adjacent slots, which would
+        # concentrate every query's near neighbors into one or two blocks
+        # and rot the per-block top-m's recall bound
+        ci_c = jnp.take(ci_c, il, axis=1)
+        co_c = jnp.take(co_c, il, axis=1)
+        q = jnp.take(points, qi_c, axis=0)           # (rows, qcap, d)
+        c = jnp.take(points, ci_c, axis=0)           # (rows, ccap, d)
+        qn = jnp.sum(q * q, axis=-1)
+        cn = jnp.sum(c * c, axis=-1)
+        qp = jnp.einsum("rqd,rcd->rqc", q, c,
+                        preferred_element_type=jnp.float32)
+        s = qn[:, :, None] + cn[:, None, :] - 2.0 * qp
+        drop = ~co_c[:, None, :]
+        if exclude_self:
+            drop = drop | (ci_c[:, None, :] == qi_c[:, :, None])
+        s = jnp.where(drop, jnp.inf, s)
+        pn_max = jnp.max(jnp.where(co_c, cn, -jnp.inf), initial=0.0,
+                         axis=(1,), keepdims=True)  # (rows, 1) per-class-row
+        err_b = dot_error_bound(qn, pn_max, d)
+        ids_b = jnp.broadcast_to(ci_c[:, None, :], s.shape)
+        sel_i, sel_s, cert = block_fold(s, ids_b, k, m, err_b)
+        ids_o, d2_o = rescore_sorted(points, q, sel_i, sel_s)
+        # decertify via the NaN trick: NaN <= margin is false even for an
+        # infinite margin, so the row fails every downstream certificate
+        # and resolves through the exact fallback.  Padded query slots
+        # (qo false) are dropped by the epilogue maps either way.
+        kth = d2_o[..., k - 1]
+        d2_o = d2_o.at[..., k - 1].set(
+            jnp.where(cert | ~qo_c, kth, jnp.nan))
+        return None, (d2_o, ids_o)
+
+    _, (out_d, out_i) = jax.lax.scan(
+        step, None, (pad_rows(own_cells, -1), pad_rows(cand_cells, -1)))
+    out_d = out_d.reshape(-1, k)[: n_sc * qcap]
+    out_i = out_i.reshape(-1, k)[: n_sc * qcap]
+    return out_d, out_i

@@ -1,0 +1,127 @@
+"""TPU-KNN approximate top-k: the bound math and the shared fold.
+
+The selection scheme is the in-register approximate top-k of TPU-KNN
+(arXiv 2206.14286, PAPERS.md): the candidate axis is partitioned into
+128-lane *blocks* (the MXU/VPU tile width), each block keeps only its
+ascending top-``m`` while its distance tile lives in registers, and the
+final top-k runs on the surviving ``G * m`` pool.  With candidates spread
+uniformly at random across ``L = G * m`` kept slots, the expected recall of
+the true top-k is bounded below by
+
+    E[recall@k] >= 1 - k * (k - 1) / (2 * L)
+
+(the paper's bound; ``jax.lax.approx_max_k`` sizes its bins from the same
+expression).  :func:`per_block_m` inverts it: the smallest per-block keep
+count whose bound meets ``KnnConfig.recall_target``.  Candidate slots are
+round-robin interleaved across blocks before scoring (the ``_pack_inputs``
+trick) so spatially-adjacent candidates -- the near neighbors -- spread
+evenly and the uniform-binning assumption is defensible on clustered data.
+
+Exactness tier: the fold also emits a per-row **certification bit** that is
+sound against the *true* (diff-arithmetic) distances, not just the
+dot-form scores.  Let ``s(j) = |q|^2 + |p_j|^2 - 2 q.p_j`` be the f32
+dot-form score and ``d(j)`` the true squared distance; catastrophic
+cancellation bounds their gap by ``|s - d| <= B`` with
+``B = O(eps32 * (|q|^2 + max|p|^2))`` (:func:`dot_error_bound`).  The fold
+tracks, per row, the k-th selected score ``t`` and the smallest score the
+selection *excluded* -- ``kplus = min(min_g rem_g, pool_(k+1))``, where
+``rem_g`` is block g's smallest non-kept score (so every non-selected
+candidate scores >= kplus).  A row certifies iff
+
+    kplus >= t + 2 * B
+
+which proves every excluded candidate's TRUE distance exceeds every
+selected candidate's (d_excl >= kplus - B >= t + B >= d_sel), i.e. the
+selected id set IS a true top-k set up to exact-distance ties.  Certified
+rows are exact; uncertified rows carry correct-but-unproven approximations
+and the refinement tier (api._finalize's batched brute fallback) resolves
+them -- at ``recall_target=1.0`` (m = k: the fold is exhaustive) this makes
+the final answer byte-identical to the exact elementwise path.
+
+Host-only math here (no jax import): the jnp fold lives in scorer.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+#: Candidate-axis block width: the TPU lane count (one MXU tile edge).
+BLOCK = 128
+
+#: f32 unit roundoff.
+_EPS32 = float(np.finfo(np.float32).eps)
+
+#: Safety factor on the dot-form error bound: covers the handful of
+#: rounding sites (two norms, the dot reduction, two adds) plus headroom
+#: for XLA reassociation.  Deliberately generous -- an under-bound would
+#: certify rows whose selection a rounding swap corrupted.
+_ERR_SAFETY = 4.0
+
+
+def bins_for(recall_target: float, k: int) -> int:
+    """Kept-slot count L whose TPU-KNN bound meets ``recall_target``:
+    L = ceil(k(k-1) / (2(1-r))).  Infinite (exhaustive) at r = 1.0."""
+    r = float(recall_target)
+    if k <= 1 or r >= 1.0:
+        return k  # top-1 (or exact) needs no approximation slack
+    return max(k, int(math.ceil(k * (k - 1) / (2.0 * (1.0 - r)))))
+
+
+def per_block_m(recall_target: float, k: int, n_blocks: int) -> int:
+    """Per-block keep count m for ``n_blocks`` candidate blocks.
+
+    r = 1.0 keeps min(k, BLOCK) per block -- an m-of-min(k,128) fold is
+    EXHAUSTIVE (a block holding more than m of the global top-k would have
+    to hold more than min(k, 128) of them, impossible within one 128-lane
+    block when m = min(k, 128)), so selection is exact by construction.
+    Below 1.0, the smallest m whose L = m * n_blocks meets the bound; the
+    floor ceil(k / n_blocks) keeps the pool wide enough to hold k at all.
+    """
+    n_blocks = max(1, int(n_blocks))
+    cap = min(int(k), BLOCK)
+    if float(recall_target) >= 1.0:
+        return cap
+    need = bins_for(recall_target, k)
+    m = max(1, -(-need // n_blocks), -(-int(k) // n_blocks))
+    return min(m, cap)
+
+
+def recall_bound(k: int, n_blocks: int, m: int) -> float:
+    """The proven expected-recall lower bound of an (n_blocks, m) fold:
+    1.0 when the fold is exhaustive (m covers min(k, BLOCK)), else the
+    TPU-KNN expression over L = m * n_blocks kept slots."""
+    if m >= min(int(k), BLOCK) or k <= 1:
+        return 1.0
+    loss = k * (k - 1) / (2.0 * m * max(1, n_blocks))
+    return max(0.0, 1.0 - loss)
+
+
+def dot_error_bound(qn, pn_max, d: int):
+    """Per-row upper bound B on |dot-form score - true squared distance|.
+
+    The dot identity subtracts two O(|q|^2 + |p|^2) quantities to produce an
+    O(d2) result: each f32 rounding site contributes up to eps32 times the
+    LARGE operands, so the absolute error scales with the norms, not the
+    distance.  (d + 8) counts the reduction depth (d-term dot product plus
+    the norm sums and the final combine); _ERR_SAFETY covers reassociation.
+    Works elementwise on arrays (qn per row, pn_max a scalar or row-shaped).
+    """
+    return _ERR_SAFETY * (d + 8) * _EPS32 * (qn + pn_max)
+
+
+def interleave_slots(n_slots: int) -> np.ndarray:
+    """Round-robin slot permutation: slot ``r * G + g -> lane g * BLOCK + r``
+    (the `_pack_inputs` interleave).  Adjacent input slots -- spatially
+    adjacent candidates under CSR packing or storage order -- land in
+    DIFFERENT blocks, spreading every query's near neighbors evenly so no
+    single block overflows its top-m (the uniform-binning assumption the
+    recall bound rests on).  ``n_slots`` must be a BLOCK multiple.
+    Returns the (n_slots,) i32 gather map: out[i] = in[perm[i]]."""
+    if n_slots % BLOCK != 0:
+        raise ValueError(f"n_slots={n_slots} is not a multiple of {BLOCK}")  # kntpu-ok: bare-valueerror -- internal layout invariant (callers pad), not user input
+    g = n_slots // BLOCK
+    # lane-major inverse of (r, g) -> (g, r): out[g*BLOCK + r] = in[r*g_ + g]
+    return np.arange(n_slots, dtype=np.int32).reshape(
+        BLOCK, g).T.reshape(-1)

@@ -117,7 +117,7 @@ class ClassSpec:
     qcap: int             # per-supercell query capacity (pre-lane-rounding)
     qcap_pad: int         # capacity as laid out by the class solver
     ccap: int
-    route: str            # 'pallas' | 'dense' | 'streamed'
+    route: str            # 'pallas' | 'dense' | 'streamed' | 'mxu'
 
     @property
     def use_pallas(self) -> bool:
@@ -143,7 +143,11 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
     host platforms run a chunked dense masked-top-k (measured ~3.5x the
     streamed path's throughput on CPU -- XLA CPU's TopK is fast, the
     streaming merge's extra tile copies are not), streaming only tiles past
-    the dense byte ceiling.
+    the dense byte ceiling.  Under ``cfg.resolved_scorer() == 'mxu'``
+    (DESIGN.md section 16) every class whose (qcap, ccap) score tile fits
+    the MXU chunk budget routes through the blocked-matmul scorer instead
+    (mxu.scorer.grid_class_topk -- pure XLA, platform-agnostic); oversized
+    classes keep their elementwise route, exact and never silent.
     """
     from ..config import resolve_epilogue, resolve_kernel
     from .pallas_solve import (hbm_budget_bytes, hbm_fits, launch_row_out,
@@ -176,10 +180,22 @@ def build_class_specs(own_n: np.ndarray, pts_cum: np.ndarray,
         groups = groups[2:] + [(np.concatenate([rows_a, rows_b]),
                                 max(r_a, r_b))]
 
+    scorer = cfg.resolved_scorer()
+
     def mk(rows: np.ndarray, radius: int) -> ClassSpec:
         qcap = _round_up(int(own_n[rows].max()), 8)
         ccap = _round_up(max(int(cand_at(rows, radius).max()), cfg.k), 128)
         qcap_pad = -(-qcap // 128) * 128
+        if scorer == "mxu":
+            from ..mxu.scorer import class_eligible
+
+            if class_eligible(qcap, ccap):
+                # the MXU class scorer packs at the dense qcap (8-aligned
+                # sublanes; the matmul contraction needs no 128-lane query
+                # axis) -- ineligible tiles fall through to the platform's
+                # elementwise route below, exact and never silent
+                return ClassSpec(rows=rows, radius=radius, qcap=qcap,
+                                 qcap_pad=qcap, ccap=ccap, route="mxu")
         if on_kernel_platform:
             # oversized query axes no longer demote (pick_qsub grids over
             # query sub-blocks); a candidate axis too wide for VMEM at a
@@ -243,7 +259,7 @@ class ClassPlan:
     qcap: int
     qcap_pad: int
     ccap: int
-    route: str        # 'pallas' | 'dense' | 'streamed'
+    route: str        # 'pallas' | 'dense' | 'streamed' | 'mxu'
     pk: "ClassPack | None" = None
     tgt: "jax.Array | None" = None
 
@@ -620,15 +636,23 @@ def _dense_query_topk(points: jax.Array, starts: jax.Array, counts: jax.Array,
 
 def _class_flat(points: jax.Array, starts: jax.Array, counts: jax.Array,
                 cp: ClassPlan, k: int, exclude_self: bool, tile: int,
-                interpret: bool, kernel: str = "kpass"):
+                interpret: bool, kernel: str = "kpass",
+                recall_target: float = 1.0):
     """Route one class's self-solve to its solver.  Returns the solver's
     RAW output flattened 1-D (Sc * qcap_pad * k elements): pallas emits
-    (Sc, k, qcap) order, dense/streamed emit (Sc*qcap, k) order -- the
+    (Sc, k, qcap) order, dense/streamed/mxu emit (Sc*qcap, k) order -- the
     epilogue's `_rows2d` normalizes both to row-major before the one
     per-point row gather (AdaptivePlan.inv_row)."""
     if cp.route == "pallas":
         return _pallas_class(points, starts, counts, cp, k, exclude_self,
                              interpret, kernel)
+    if cp.route == "mxu":
+        from ..mxu.scorer import grid_class_topk
+
+        fd, fi = grid_class_topk(points, starts, counts, cp.own, cp.cand,
+                                 cp.qcap_pad, k, cp.ccap, exclude_self,
+                                 recall_target)
+        return fd.reshape(-1), fi.reshape(-1)
     if cp.route == "dense":
         fd, fi = _dense_self(points, starts, counts, cp.own, cp.cand,
                              cp.qcap_pad, k, cp.ccap, exclude_self)
@@ -686,7 +710,8 @@ def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
 
 def _class_rows(points: jax.Array, starts: jax.Array, counts: jax.Array,
                 cp: ClassPlan, k: int, exclude_self: bool, tile: int,
-                interpret: bool, kernel: str = "kpass"):
+                interpret: bool, kernel: str = "kpass",
+                recall_target: float = 1.0):
     """One class's self-solve as ROW-MAJOR (Sc * qcap_pad, k) dists/ids --
     the scatter-epilogue twin of _class_flat.  pallas classes go through
     pallas_solve._topk_rows_or_transpose (the shared eligibility gate:
@@ -705,14 +730,14 @@ def _class_rows(points: jax.Array, starts: jax.Array, counts: jax.Array,
             qx, qy, qz, cx, cy, cz, qid3, cid3, cp.qcap_pad, cp.ccap, k,
             exclude_self, interpret, q_ok, resolve_kernel(kernel, k, cp.ccap))
     fd, fi = _class_flat(points, starts, counts, cp, k, exclude_self, tile,
-                         interpret, kernel)
+                         interpret, kernel, recall_target)
     return fd.reshape(-1, k), fi.reshape(-1, k)
 
 
 def _scatter_classes(points: jax.Array, starts: jax.Array, counts: jax.Array,
                      classes: Tuple[ClassPlan, ...], n_rows: int, k: int,
                      exclude_self: bool, tile: int, interpret: bool,
-                     kernel: str = "kpass"):
+                     kernel: str = "kpass", recall_target: float = 1.0):
     """Scatter epilogue: every class's row-major rows land in the final
     (n_rows, k) buffers through its prepare-time forward map (ClassPlan.tgt,
     pad slots -> dropped sentinel).  Replaces the gather epilogue's
@@ -730,7 +755,8 @@ def _scatter_classes(points: jax.Array, starts: jax.Array, counts: jax.Array,
                 "this plan predates the scatter epilogue (ClassPlan.tgt is "
                 "None); rebuild it or use epilogue='gather'")
         rows_d, rows_i = _class_rows(points, starts, counts, cp, k,
-                                     exclude_self, tile, interpret, kernel)
+                                     exclude_self, tile, interpret, kernel,
+                                     recall_target)
         out_d = out_d.at[cp.tgt].set(rows_d, mode="drop")
         out_i = out_i.at[cp.tgt].set(rows_i, mode="drop")
     return out_d, out_i
@@ -738,12 +764,14 @@ def _scatter_classes(points: jax.Array, starts: jax.Array, counts: jax.Array,
 
 @functools.partial(jax.jit, static_argnames=("n", "k", "exclude_self",
                                              "domain", "interpret", "tile",
-                                             "kernel", "epilogue"))
+                                             "kernel", "epilogue",
+                                             "recall_target"))
 def _solve_adaptive(points: jax.Array, starts: jax.Array, counts: jax.Array,
                     classes: Tuple[ClassPlan, ...], inv_row: jax.Array,
                     inv_box: jax.Array, n: int, k: int, exclude_self: bool,
                     domain: float, interpret: bool, tile: int,
-                    kernel: str = "kpass", epilogue: str = "gather"):
+                    kernel: str = "kpass", epilogue: str = "gather",
+                    recall_target: float = 1.0):
     """One program = the whole class-partitioned solve: every class launch,
     the device-resident (n, k) assembly, and the certificate -- the solve
     dispatches as ONE async call and syncs nowhere (api._finalize does the
@@ -756,12 +784,12 @@ def _solve_adaptive(points: jax.Array, starts: jax.Array, counts: jax.Array,
     if epilogue == "scatter":
         row_d, row_i = _scatter_classes(
             points, starts, counts, classes, n, k,
-            exclude_self, tile, interpret, kernel)
+            exclude_self, tile, interpret, kernel, recall_target)
     else:
         flats_d, flats_i = [], []
         for cp in classes:
             fd, fi = _class_flat(points, starts, counts, cp, k, exclude_self,
-                                 tile, interpret, kernel)
+                                 tile, interpret, kernel, recall_target)
             flats_d.append(fd)
             flats_i.append(fi)
         all_d, all_i = _rows2d(flats_d, flats_i, classes, k)
@@ -791,7 +819,8 @@ def solve_adaptive(grid: GridHash, cfg: KnnConfig,
         grid.points, grid.cell_starts, grid.cell_counts, plan.classes,
         plan.inv_row, plan.inv_box, plan.n_points, cfg.k,
         cfg.exclude_self, grid.domain, cfg.interpret, cfg.stream_tile,
-        cfg.effective_kernel(), cfg.resolved_epilogue())
+        cfg.effective_kernel(), cfg.resolved_epilogue(),
+        float(cfg.recall_target))
     return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
                      uncert_count=n_unc)
 
@@ -931,6 +960,12 @@ def launch_class_query(points, starts, counts, cp: ClassPlan,
     # (bounds recompiles across query sets)
     q2cap_pal = -(-max_q // 128) * 128
     route = cp.route
+    if route == "mxu":
+        # external queries keep the exact elementwise class solvers: the
+        # grid-fed MXU scorer is a self-solve (queries ARE the class's own
+        # stored points); arbitrary-coordinate MXU scoring is the brute
+        # route's job (mxu.solve_general(queries=...), DESIGN.md s16)
+        route = "dense"
     if route == "pallas" and not pick_qsub(q2cap_pal, cp.ccap, k):
         route = "streamed"
     q2cap = (q2cap_pal if route == "pallas"

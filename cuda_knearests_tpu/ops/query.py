@@ -153,18 +153,19 @@ def brute_force_by_coords(points: jax.Array, queries: jax.Array, k: int,
     streaming merge_topk over point tiles (the external-query twin of
     solve.brute_force_by_index).  ``ids_map`` (e.g. the grid permutation)
     translates result ids on device before readback, same contract as
-    _query_class."""
-    n = points.shape[0]
+    _query_class.  Dimension-agnostic like its twin: (n, d) points for any
+    d (the traced program at d=3 is unchanged)."""
+    n, dim = points.shape
     n_pad = -(-n // tile) * tile
     pts = jnp.concatenate(
-        [points, jnp.full((n_pad - n, 3), _FAR, points.dtype)], axis=0)
+        [points, jnp.full((n_pad - n, dim), _FAR, points.dtype)], axis=0)
     ids_all = jnp.arange(n_pad, dtype=jnp.int32)
 
     def body(carry, inp):
         best_d, best_i = carry
         pts_t, ids_t = inp
         d2 = jnp.zeros((queries.shape[0], tile), jnp.float32)
-        for ax in range(3):
+        for ax in range(dim):
             diff = queries[:, None, ax] - pts_t[None, :, ax]
             d2 = d2 + diff * diff
         mask = ids_t[None, :] < n
@@ -173,7 +174,7 @@ def brute_force_by_coords(points: jax.Array, queries: jax.Array, k: int,
 
     init = init_topk((queries.shape[0],), k)
     (best_d, best_i), _ = jax.lax.scan(
-        body, init, (pts.reshape(-1, tile, 3), ids_all.reshape(-1, tile)))
+        body, init, (pts.reshape(-1, tile, dim), ids_all.reshape(-1, tile)))
     if ids_map is not None:
         best_i = translate_ids(best_i, ids_map)
     return best_i, best_d

@@ -393,12 +393,14 @@ def brute_force_by_index(points: jax.Array, q_idx: jax.Array, k: int,
     Streaming merge_topk over point tiles -- the exact-resolution path for
     uncertified queries and the small-n reference solver for tests.  q_idx may be
     padded with -1 (rows ignored).  Returns ((m, k) ids ascending, (m, k) d2) in
-    sorted indexing.
+    sorted indexing.  Dimension-agnostic: ``points`` may be (n, d) for any
+    d >= 1 (the brute/MXU route's general-d refinement tier rides this same
+    path; at d=3 the traced program is unchanged).
     """
-    n = points.shape[0]
+    n, dim = points.shape
     n_pad = -(-n // tile) * tile
     pts = jnp.concatenate(
-        [points, jnp.full((n_pad - n, 3), _FAR, points.dtype)], axis=0)
+        [points, jnp.full((n_pad - n, dim), _FAR, points.dtype)], axis=0)
     q_ok = q_idx >= 0
     q = jnp.take(points, jnp.where(q_ok, q_idx, 0), axis=0)
 
@@ -408,7 +410,7 @@ def brute_force_by_index(points: jax.Array, q_idx: jax.Array, k: int,
         best_d, best_i = carry
         pts_t, ids_t = inp
         d2 = jnp.zeros((q.shape[0], tile), jnp.float32)
-        for ax in range(3):
+        for ax in range(dim):
             diff = q[:, None, ax] - pts_t[None, :, ax]
             d2 = d2 + diff * diff
         mask = (ids_t[None, :] < n)
@@ -419,7 +421,7 @@ def brute_force_by_index(points: jax.Array, q_idx: jax.Array, k: int,
 
     init = init_topk((q.shape[0],), k)
     (best_d, best_i), _ = jax.lax.scan(
-        body, init, (pts.reshape(-1, tile, 3), ids_all.reshape(-1, tile)))
+        body, init, (pts.reshape(-1, tile, dim), ids_all.reshape(-1, tile)))
     best_i = jnp.where(q_ok[:, None], best_i, INVALID_ID)
     best_d = jnp.where(q_ok[:, None], best_d, jnp.inf)
     return best_i, best_d

@@ -591,6 +591,19 @@ class ShardedKnnProblem:
             raise InvalidConfigError(
                 "backend='oracle' is a single-chip host engine; the sharded "
                 "path runs grid engines only ('auto'/'pallas'/'xla')")
+        if config.resolved_scorer() == "mxu":
+            # same fail-fast rule as KnnProblem.prepare's scorer guard: the
+            # per-chip class solves have no recall_target plumbing, so an
+            # mxu config here would silently run exact selection and ignore
+            # the configured approximation budget
+            raise InvalidConfigError(
+                f"scorer='mxu' (recall_target={config.recall_target}) has "
+                f"no sharded implementation: per-chip class solves would "
+                f"silently run exact selection, ignoring the approximation "
+                f"budget -- use the single-chip adaptive route "
+                f"(KnnProblem.prepare) or the brute/MXU route "
+                f"(cuda_knearests_tpu.mxu.solve_general); sharded solves "
+                f"stay elementwise-exact")
         if mesh is None:
             n_devices = n_devices or len(jax.devices())
             mesh = jax.make_mesh((n_devices,), ("z",))

@@ -1,5 +1,5 @@
 """Real-hardware (non-interpret) exactness pass for the blocked kernel
-(VERDICT r4 next #2's remaining sub-item).
+(VERDICT r4 next #2's remaining sub-item) and the MXU scorer (ISSUE 10).
 
 The blocked kernel's equality with kpass was pinned in interpret mode only
 (tests/conftest.py hard-pins the suite to the emulated CPU mesh, by design);
@@ -7,6 +7,23 @@ this script runs the same differential on the live chip: explicit
 kernel='blocked' vs 'kpass' end-to-end on a blue-noise and a clustered
 fixture, neighbors/distances must match exactly and both must be fully
 certified after fallback.  One JSON line per (fixture, k).
+
+The MXU cells (DESIGN.md section 16) run the same discipline for the
+blocked-matmul subsystem, one cell per claim:
+
+  * ``mxu-brute-vs-elementwise`` -- ``mxu.solve_general`` at
+    ``recall_target=1.0`` is BYTE-identical (ids and distances) to the
+    elementwise selection; on TPU this is the Pallas kernel's hardware
+    evidence, with a vacuous-pass flag when ``kernel_fits`` demoted the
+    solve to the XLA core.
+  * ``mxu-adaptive-vs-elementwise`` -- the adaptive route under
+    ``scorer='mxu'``: ids byte-identical + fully certified + every
+    distance realized exactly (<= 1 ulp of the true f64 value).
+    Distance BIT-identity is deliberately not claimed here: fallback
+    rows ride the shared exact brute HLO, whose f32 association may
+    differ from the dense route's by 1 ulp (the shape-dependent FMA
+    divergence measured in mxu/scorer.py).  The vacuous-pass flag fires
+    when the planner routed no class through the MXU scorer.
 
 Run on a healthy accelerator:  python scripts/blocked_exactness.py
 """
@@ -37,6 +54,109 @@ def main() -> int:
     for name, pts in (("blue_15k", generate_blue_noise(15_000, seed=7)),
                       ("clustered_20k", generate_clustered(20_000, seed=5))):
         for k in (10, 20):
+            # MXU brute-route cell (ISSUE 10): solve_general at
+            # recall_target=1.0 must be BYTE-identical to the elementwise
+            # selection -- every row realizes through the one strict-IEEE
+            # host epilogue, so the pin is engine-independent (and on TPU
+            # this cell is the Pallas kernel's hardware evidence)
+            row = {"config": f"mxu-brute-vs-elementwise {name} k={k}",
+                   "platform": plat}
+            try:
+                from cuda_knearests_tpu.mxu import solve_general
+
+                a = solve_general(pts, k=k, recall_target=1.0,
+                                  scorer="mxu")
+                watchdog.heartbeat()
+                b = solve_general(pts, k=k, scorer="elementwise")
+                watchdog.heartbeat()
+                row["backend"] = a.backend
+                if plat != "cpu" and a.backend != "pallas":
+                    # vacuous for HARDWARE kernel evidence: the solve fell
+                    # back to the XLA core (kernel_fits refused), so the
+                    # Pallas kernel was never in play on this chip
+                    row.update(skipped=True,
+                               reason="mxu backend resolved to "
+                                      f"'{a.backend}' on {plat}: the "
+                                      "Pallas-kernel differential would "
+                                      "be vacuous")
+                else:
+                    ids_eq = bool(np.array_equal(a.neighbors, b.neighbors))
+                    d2_eq = bool(np.array_equal(a.dists_sq, b.dists_sq))
+                    row.update(ids_equal=ids_eq, dists_equal=d2_eq,
+                               certified=bool(a.certified.all()),
+                               uncert_count=int(a.uncert_count),
+                               n_points=int(pts.shape[0]))
+                    compared += 1
+                    if not (ids_eq and d2_eq and a.certified.all()):
+                        rc = 1
+            except Exception as e:  # noqa: BLE001 -- every cell must report
+                row["error"] = f"{type(e).__name__}: {e}"
+                rc = 1
+            print(json.dumps(row), flush=True)
+
+            # MXU grid-scorer cell: the adaptive route under scorer='mxu'
+            # at recall_target=1.0.  Contract (DESIGN.md section 16): ids
+            # BYTE-identical + fully certified + every distance realized
+            # exactly (within 1 ulp of the true f64 value) -- fallback
+            # rows ride the shared exact brute HLO, whose f32 association
+            # may differ from the dense route's by 1 ulp, so bit-identity
+            # of distances is the BRUTE route's guarantee, not this one's
+            row = {"config": f"mxu-adaptive-vs-elementwise {name} k={k}",
+                   "platform": plat}
+            try:
+                p_mxu = KnnProblem.prepare(
+                    pts, KnnConfig(k=k, scorer="mxu", recall_target=1.0))
+                routes = [c.route for c in p_mxu.aplan.classes]
+                row["resolved_routes"] = routes
+                if "mxu" not in routes:
+                    # vacuous-pass flag (same contract as the blocked cell
+                    # below): no class fit the MXU chunk budget, so the
+                    # differential would compare elementwise with itself
+                    row.update(skipped=True,
+                               reason="no mxu-routed class (every tile "
+                                      "exceeded the MXU chunk budget): "
+                                      "the differential would be vacuous")
+                else:
+                    p_el = KnnProblem.prepare(pts, KnnConfig(k=k))
+                    res_m = p_mxu.solve()
+                    p_el.solve()
+                    watchdog.heartbeat()
+                    im, ie = (p_mxu.get_knearests_original(),
+                              p_el.get_knearests_original())
+                    dm = np.asarray(jax.device_get(res_m.dists_sq))
+                    ids_eq = bool(np.array_equal(im, ie))
+                    # realized-exact: every emitted f32 distance within
+                    # the diff arithmetic's own rounding budget (3 diffs
+                    # + 3 squares + 2 adds: <= 4 f32 ulp) of the exact
+                    # f64 distance of its own id -- the same budget the
+                    # elementwise baseline's values satisfy (result rows
+                    # are in SORTED indexing)
+                    p64 = np.asarray(jax.device_get(
+                        p_mxu.grid.points)).astype(np.float64)
+                    valid = np.asarray(jax.device_get(
+                        res_m.neighbors)) >= 0
+                    safe = np.where(valid,
+                                    np.asarray(jax.device_get(
+                                        res_m.neighbors)), 0)
+                    exact = ((p64[safe] - p64[:, None, :]) ** 2).sum(-1)
+                    ulp = np.spacing(
+                        np.abs(exact).astype(np.float32)).astype(np.float64)
+                    realized = (~valid | (np.abs(dm - exact)
+                                          <= 4.0 * ulp)).all()
+                    row.update(ids_equal=ids_eq,
+                               dists_exact_realized=bool(realized),
+                               certified=bool(np.asarray(
+                                   res_m.certified).all()),
+                               n_points=int(pts.shape[0]))
+                    compared += 1
+                    if not (ids_eq and realized
+                            and np.asarray(res_m.certified).all()):
+                        rc = 1
+            except Exception as e:  # noqa: BLE001 -- every cell must report
+                row["error"] = f"{type(e).__name__}: {e}"
+                rc = 1
+            print(json.dumps(row), flush=True)
+
             row = {"config": f"blocked-vs-kpass {name} k={k}",
                    "platform": plat}
             try:
@@ -93,6 +213,7 @@ def main() -> int:
                 row["error"] = f"{type(e).__name__}: {e}"
                 rc = 1
             print(json.dumps(row), flush=True)
+
     if compared == 0 and rc == 0:
         # every cell skipped as vacuous: rc 0 would bank the run as
         # exactness evidence although zero comparisons executed (the same

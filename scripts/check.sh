@@ -103,6 +103,40 @@ echo "== mutation fuzz smoke (delta overlay vs rebuild oracle, CPU-only) =="
 JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
     --mutations "${KNTPU_MUT_CASES:-4}" --seed 0 --budget 60s || rc=1
 
+# MXU smoke (DESIGN.md section 16): the blocked-matmul subsystem's three
+# CPU-checkable claims -- the recall_target=1.0 byte-identity pin vs the
+# exact elementwise path (the blocked-exactness pin's CPU form), one
+# measured-recall-vs-TPU-KNN-bound check with a certified-rows soundness
+# audit, and general-d (d=6) end-to-end exactness.
+echo "== MXU smoke (byte-identity pin + recall bound + general-d, CPU-only) =="
+JAX_PLATFORMS=cpu KNTPU_MXU_SMOKE_N="${KNTPU_MXU_SMOKE_N:-8000}" \
+    python -m cuda_knearests_tpu.mxu || rc=1
+
+# Approx fuzz smoke (DESIGN.md section 16): the adversarial zoo + the
+# block-aliased planted generator through the brute/MXU route at several
+# recall targets, asserting measured tie-aware recall >= the TPU-KNN bound
+# and certificate soundness vs the exact oracle.  KNTPU_APPROX_CASES
+# deepens it for nightly runs.
+echo "== approx fuzz smoke (MXU recall bound + certificate soundness, ${KNTPU_APPROX_CASES:-16} cases, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
+    --approx --cases "${KNTPU_APPROX_CASES:-16}" --seed 0 --budget 60s || rc=1
+
+# MXU seeded-fault self-tests (DESIGN.md section 16): each detector must
+# FIRE when its fault is seeded -- drop-block plants a certified-yet-
+# incomplete fold, skip-certify a dead refinement tier; both must yield a
+# banked failure (rc != 0), diverted away from the real corpus.
+echo "== MXU seeded-fault self-tests (drop-block / skip-certify) =="
+for fault in drop-block skip-certify; do
+    if KNTPU_MXU_FAULT=$fault JAX_PLATFORMS=cpu \
+        python -m cuda_knearests_tpu.fuzz --approx --cases 1 --seed 0 \
+        >/dev/null 2>&1; then
+        echo "   FAIL: seeded MXU fault '$fault' was not detected (rc 0)"
+        rc=1
+    else
+        echo "   ok: '$fault' detected"
+    fi
+done
+
 # Sync-budget smoke (DESIGN.md section 12): every solve route -- adaptive,
 # legacy pack, external query (single-shot + chunked pipeline), sharded
 # solve + query -- must complete within the one-sync contract's budget of

@@ -110,6 +110,16 @@ def _artifact_good(path: str, allow_partial: bool = False) -> bool:
         return False
     if allow_partial:
         return any("error" not in ln for ln in lines)
+    # every kNN-throughput row of a FULL bench artifact must carry the
+    # recall stamp (ISSUE 10 satellite): frontier rows trade recall for
+    # QPS, so a throughput number without its recall is not comparable
+    # like-for-like and must never be banked as a record.  The
+    # experiment-matrix steps above (kernel A/B, phase breakdown) are
+    # kernel micro-benches with no result rows to measure recall on.
+    for ln in lines:
+        if (str(ln.get("unit", "")).startswith("queries/sec")
+                and not isinstance(ln.get("recall"), (int, float))):
+            return False
     return all("error" not in ln for ln in lines)
 
 

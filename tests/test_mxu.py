@@ -1,0 +1,376 @@
+"""MXU scoring subsystem: byte-identity, recall bounds, certification
+soundness, general-d, and the approx corpus replay (DESIGN.md section 16).
+
+The acceptance pin of ISSUE 10 lives here: ``recall_target=1.0`` on the
+brute/MXU route must be BYTE-identical (ids and distances) to the exact
+elementwise path on the reference's 20k fixture -- every row realizes its
+distances through the one strict-IEEE host epilogue, so the scorer knob
+changes selection only, never values.  Also pinned:
+
+  * the TPU-KNN bound math (per_block_m / recall_bound inversion,
+    exhaustive fold at recall_target=1.0),
+  * measured tie-aware recall >= the configured bound in approx mode,
+    and certificate soundness (certified rows ARE exact),
+  * the adaptive grid route under ``KnnConfig(scorer='mxu')``:
+    id-identity + full certification at recall_target=1.0,
+  * Pallas kernel (interpret mode) selection equality vs the XLA twin,
+  * the general-d contract end to end (io front door + solve + oracle),
+  * the <=2-host-sync finalize window ('mxu-brute', analysis/syncflow.py),
+  * config refusals (resolve_scorer, parse_fault) and both seeded faults,
+  * every banked ``tests/corpus/*-approx.npz`` repro replays clean.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.config import resolve_scorer
+from cuda_knearests_tpu.io import generate_blue_noise, generate_clustered
+from cuda_knearests_tpu.mxu import (BLOCK, knn, parse_fault, per_block_m,
+                                    recall_bound, solve_general)
+from cuda_knearests_tpu.mxu.__main__ import measured_recall
+from cuda_knearests_tpu.runtime import dispatch
+from cuda_knearests_tpu.utils.memory import (InputContractError,
+                                             InvalidConfigError,
+                                             InvalidShapeError)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(REPO, "tests", "corpus")
+
+
+# -- the acceptance pin: recall_target=1.0 byte-identity on the 20k fixture --
+
+def test_byte_identity_20k(pts20k):
+    """ISSUE 10's acceptance bar: the MXU route at recall_target=1.0 is
+    byte-identical to the exact elementwise path on the full 20k fixture
+    (ids AND distances), fully certified after refinement."""
+    a = solve_general(pts20k, k=10, recall_target=1.0, scorer="mxu")
+    b = solve_general(pts20k, k=10, scorer="elementwise")
+    np.testing.assert_array_equal(a.neighbors, b.neighbors)
+    np.testing.assert_array_equal(a.dists_sq, b.dists_sq)
+    assert a.certified.all() and b.certified.all()
+    assert a.bound == 1.0
+    # the ledger is honest: rows that needed the exact fallback are counted
+    assert 0 <= a.uncert_count <= pts20k.shape[0]
+
+
+def test_byte_identity_external_queries():
+    pts = generate_blue_noise(3000, seed=11)
+    rng = np.random.default_rng(3)
+    q = (rng.random((513, 3)) * 1000.0).astype(np.float32)
+    a = solve_general(pts, k=8, recall_target=1.0, scorer="mxu", queries=q)
+    b = solve_general(pts, k=8, scorer="elementwise", queries=q)
+    np.testing.assert_array_equal(a.neighbors, b.neighbors)
+    np.testing.assert_array_equal(a.dists_sq, b.dists_sq)
+    assert a.certified.all()
+
+
+# -- the TPU-KNN bound math ---------------------------------------------------
+
+def test_per_block_m_exact_tier_is_exhaustive():
+    # r=1.0 keeps min(k, BLOCK) per block: exhaustive by the pigeonhole
+    # argument in topk.per_block_m, so the bound is exactly 1.0
+    for k in (1, 10, 50, 200):
+        for g in (1, 7, 64):
+            m = per_block_m(1.0, k, g)
+            assert m == min(k, BLOCK)
+            assert recall_bound(k, g, m) == 1.0
+
+
+def test_per_block_m_meets_target():
+    # below 1.0 the inversion must pick an m whose proven bound meets the
+    # target (or saturate at the exhaustive cap, where the bound is 1.0)
+    for rt in (0.5, 0.8, 0.95, 0.999):
+        for k in (4, 10, 50):
+            for g in (2, 16, 157):
+                m = per_block_m(rt, k, g)
+                assert 1 <= m <= min(k, BLOCK)
+                assert recall_bound(k, g, m) >= rt or m == min(k, BLOCK)
+
+
+def test_recall_bound_monotone_in_m():
+    bounds = [recall_bound(10, 16, m) for m in range(1, 11)]
+    assert bounds == sorted(bounds)
+    assert bounds[-1] == 1.0
+
+
+# -- approx mode: measured recall vs bound + certificate soundness ------------
+
+def test_measured_recall_meets_bound():
+    # targets chosen so the fold stays genuinely approximate (m < k):
+    # at a saturated bound of 1.0 with refine='none', dot-form boundary
+    # ties make the EXACT-threshold measure below unfair -- that regime
+    # is audited band-aware by test_approx_claims_audit instead
+    pts = generate_clustered(6000, seed=17)
+    for rt in (0.6, 0.75):
+        res = solve_general(pts, k=10, recall_target=rt, refine="none")
+        assert res.m < 10 and rt <= res.bound < 1.0
+        assert measured_recall(pts, res.neighbors, 10) >= res.bound
+
+
+def test_approx_claims_audit():
+    """The fuzz flavor's full claim set (recall bound at the route's
+    declared scoring precision, certificate soundness at the exact
+    threshold, structure, exact tier at 1.0) on one adversarial cloud."""
+    from cuda_knearests_tpu.fuzz.approx import _approx_failure
+
+    pts = generate_clustered(2048, seed=47)
+    for rt in (0.6, 0.9, 1.0):
+        assert _approx_failure(pts, 10, rt) is None
+
+
+def test_certified_rows_are_exact():
+    """Certificate soundness: every row whose bit claims provable
+    exactness must realize 1.0 recall at the EXACT threshold -- the
+    load-bearing claim the refinement tier trusts."""
+    from cuda_knearests_tpu.mxu.__main__ import _certified_recall
+
+    pts = generate_clustered(3000, seed=31)
+    res = solve_general(pts, k=10, recall_target=0.6, refine="none")
+    rows = np.nonzero(res.certified)[0]
+    assert rows.size  # the clustered cloud certifies plenty of rows
+    assert _certified_recall(pts, res.neighbors, rows, 10) >= 1.0
+
+
+def test_refine_resolves_every_row():
+    pts = generate_clustered(2000, seed=37)
+    res = solve_general(pts, k=10, recall_target=0.6, refine="brute")
+    assert res.certified.all()
+    assert measured_recall(pts, res.neighbors, 10) >= 1.0
+
+
+# -- the adaptive grid route under KnnConfig(scorer='mxu') --------------------
+
+def test_adaptive_mxu_matches_elementwise():
+    """The grid-fed class scorer: ids identical + fully certified at
+    recall_target=1.0 (distance BIT-identity is the brute route's claim;
+    fallback rows here ride the shared exact brute HLO, whose f32
+    association can differ by 1 ulp -- scorer.rescore_sorted docstring)."""
+    pts = generate_blue_noise(6000, seed=13)
+    p_m = KnnProblem.prepare(pts, KnnConfig(k=10, scorer="mxu",
+                                            recall_target=1.0))
+    assert "mxu" in [c.route for c in p_m.aplan.classes]
+    p_e = KnnProblem.prepare(pts, KnnConfig(k=10))
+    res_m = p_m.solve()
+    p_e.solve()
+    np.testing.assert_array_equal(p_m.get_knearests_original(),
+                                  p_e.get_knearests_original())
+    assert bool(np.asarray(res_m.certified).all())
+
+
+def test_adaptive_mxu_approx_recall():
+    pts = generate_blue_noise(6000, seed=19)
+    p = KnnProblem.prepare(pts, KnnConfig(k=10, scorer="mxu",
+                                          recall_target=0.9))
+    p.solve()
+    ids = p.get_knearests_original()
+    # the adaptive route always refines uncertified rows exactly
+    # (api._finalize), so the finalized answer is exact regardless of the
+    # in-flight approximation
+    assert measured_recall(pts, ids, 10) >= 1.0
+
+
+# -- Pallas kernel (interpret) vs the XLA twin --------------------------------
+
+def test_kernel_selection_matches_xla_interpret():
+    """The in-register Pallas fold and the XLA core must produce the same
+    finalized answer (selection feeds the same host epilogue; ids and
+    distances compare byte-for-byte) -- interpret mode is the CPU stand-in
+    for the TPU kernel, same discipline as tests/test_pallas.py."""
+    pts = generate_blue_noise(1500, seed=41)
+    a = solve_general(pts, k=8, recall_target=1.0, scorer="mxu",
+                      interpret=True)
+    assert a.backend == "pallas"
+    b = solve_general(pts, k=8, recall_target=1.0, scorer="mxu")
+    assert b.backend == "xla"
+    np.testing.assert_array_equal(a.neighbors, b.neighbors)
+    np.testing.assert_array_equal(a.dists_sq, b.dists_sq)
+    assert a.certified.all() and b.certified.all()
+
+
+def test_kernel_approx_certificates_match_xla():
+    pts = generate_clustered(1024, seed=43)
+    a = solve_general(pts, k=10, recall_target=0.7, refine="none",
+                      interpret=True)
+    b = solve_general(pts, k=10, recall_target=0.7, refine="none")
+    assert a.backend == "pallas" and b.backend == "xla"
+    np.testing.assert_array_equal(a.certified, b.certified)
+    np.testing.assert_array_equal(a.neighbors, b.neighbors)
+
+
+# -- general-d (ROADMAP item 4) -----------------------------------------------
+
+@pytest.mark.parametrize("d", [1, 2, 6, 17])
+def test_general_d_exact(d):
+    rng = np.random.default_rng(100 + d)
+    pts = (rng.random((700, d)) * 50.0).astype(np.float32)
+    res = solve_general(pts, k=6, recall_target=1.0)
+    assert res.certified.all()
+    assert measured_recall(pts, res.neighbors, 6) >= 1.0
+
+
+def test_general_d_external_queries_and_knn():
+    rng = np.random.default_rng(7)
+    pts = (rng.random((512, 5)) * 10.0).astype(np.float32)
+    q = (rng.random((65, 5)) * 10.0).astype(np.float32)
+    res = solve_general(pts, k=4, queries=q)
+    assert measured_recall(pts, res.neighbors, 4, queries=q,
+                           exclude_self=False) >= 1.0
+    ids = knn(pts, k=4)
+    assert ids.shape == (512, 4)
+
+
+def test_general_d_degraded_modes():
+    # k > n pads -1/inf with certificates intact; n = 0 is legal-empty
+    pts = np.zeros((3, 7), np.float32)
+    pts[:] = np.arange(3)[:, None]
+    res = solve_general(pts, k=5)
+    assert res.certified.all()
+    assert (res.neighbors[:, 2:] == -1).all()
+    assert np.isinf(res.dists_sq[:, 2:]).all()
+    empty = solve_general(np.zeros((0, 9), np.float32), k=3)
+    assert empty.neighbors.shape == (0, 3)
+
+
+def test_general_d_query_width_mismatch():
+    pts = np.zeros((8, 4), np.float32)
+    with pytest.raises(InvalidShapeError):
+        solve_general(pts, k=2, queries=np.zeros((4, 3), np.float32))
+
+
+# -- the io front door: d != 3 routing ----------------------------------------
+
+def test_grid_routes_refuse_general_d_with_pointer():
+    pts = np.zeros((16, 5), np.float32)
+    with pytest.raises(InputContractError, match="mxu"):
+        KnnProblem.prepare(pts, KnnConfig(k=4))
+
+
+def test_validate_dims_none_accepts_and_skips_domain():
+    from cuda_knearests_tpu.io import validate_or_raise
+
+    # the brute/MXU contract: any d >= 1, finite, NO domain-bounds check
+    pts = np.array([[-5.0, 2e6]], np.float32)
+    out = validate_or_raise(pts, k=1, dims=None)
+    assert out.shape == (1, 2) and out.dtype == np.float32
+    with pytest.raises(InputContractError):
+        validate_or_raise(np.array([[np.nan, 0.0]], np.float32), dims=None)
+
+
+# -- config refusals ----------------------------------------------------------
+
+def test_resolve_scorer_rules():
+    assert resolve_scorer("auto", 1.0) == "elementwise"
+    assert resolve_scorer("auto", 0.9) == "mxu"
+    assert resolve_scorer("mxu", 1.0) == "mxu"
+    with pytest.raises(ValueError, match="unknown scorer"):
+        resolve_scorer("gpu", 1.0)
+    with pytest.raises(ValueError, match="recall_target"):
+        resolve_scorer("auto", 0.0)
+    with pytest.raises(ValueError, match="recall_target"):
+        resolve_scorer("auto", 1.5)
+    with pytest.raises(ValueError, match="elementwise"):
+        resolve_scorer("elementwise", 0.9)
+
+
+def test_prepare_refuses_mxu_off_the_adaptive_route():
+    pts = generate_blue_noise(256, seed=2)
+    with pytest.raises(InvalidConfigError, match="solve_general"):
+        KnnProblem.prepare(pts, KnnConfig(k=4, scorer="mxu",
+                                          adaptive=False))
+
+
+def test_parse_fault_refuses_typos(monkeypatch):
+    assert parse_fault("") is None and parse_fault("drop-block")
+    with pytest.raises(InvalidConfigError, match="KNTPU_MXU_FAULT"):
+        parse_fault("drop-blok")
+    monkeypatch.setenv("KNTPU_MXU_FAULT", "nope")
+    with pytest.raises(InvalidConfigError):
+        parse_fault()
+
+
+# -- seeded faults: each detector must fire -----------------------------------
+
+@pytest.mark.parametrize("fault", ["drop-block", "skip-certify"])
+def test_seeded_fault_yields_banked_failure(fault, tmp_path, monkeypatch):
+    """Detector liveness (the check.sh self-test's in-process twin): the
+    planted block-aliased case must fail, minimize, and bank under each
+    fault -- and the banked repro must replay CLEAN without the fault
+    (the corpus pins fixes, not failures)."""
+    from cuda_knearests_tpu.fuzz.approx import (ApproxCaseSpec,
+                                                _approx_failure,
+                                                load_approx_case,
+                                                run_approx_case)
+
+    monkeypatch.setenv("KNTPU_MXU_FAULT", fault)
+    spec = ApproxCaseSpec(generator="block-aliased", seed=3, n=2048, k=10,
+                          recall_target=0.6)
+    f = run_approx_case(spec, bank_dir=str(tmp_path), max_probes=8)
+    assert f is not None and f.banked and os.path.exists(f.banked)
+    assert f.minimized_n <= f.original_n
+    banked = load_approx_case(f.banked)
+    assert banked["spec"] == spec
+    monkeypatch.delenv("KNTPU_MXU_FAULT")
+    assert _approx_failure(banked["points"], banked["k"],
+                           banked["recall_target"]) is None
+
+
+def test_faulted_run_never_banks_into_real_corpus(monkeypatch):
+    from cuda_knearests_tpu.fuzz.approx import CORPUS_DIR, _safe_bank_dir
+
+    monkeypatch.setenv("KNTPU_MXU_FAULT", "skip-certify")
+    diverted = _safe_bank_dir(CORPUS_DIR)
+    assert os.path.abspath(diverted) != os.path.abspath(CORPUS_DIR)
+    monkeypatch.delenv("KNTPU_MXU_FAULT")
+    assert _safe_bank_dir(CORPUS_DIR) == CORPUS_DIR
+
+
+# -- sync budget: the 'mxu-brute' window --------------------------------------
+
+def test_solve_general_sync_budget():
+    """The finalize discipline api._finalize pioneered, proven for this
+    route by analysis/syncflow.py's 'mxu-brute' window: ONE batched fetch
+    of the selection plus at most one more for the fallback batch."""
+    pts = generate_blue_noise(2000, seed=5)
+    dispatch.reset_stats()
+    res = solve_general(pts, k=10, recall_target=1.0, scorer="mxu")
+    stats = dispatch.stats()
+    expected = 1 + (1 if res.uncert_count else 0)
+    assert stats.host_syncs == expected <= dispatch.SYNC_BUDGET
+
+
+# -- campaign manifest + corpus replay ----------------------------------------
+
+def test_approx_campaign_manifest(tmp_path):
+    from cuda_knearests_tpu.fuzz.approx import run_approx_campaign
+
+    manifest = run_approx_campaign(n_cases=2, seed=1,
+                                   bank_dir=str(tmp_path), log=None)
+    assert manifest["ok"] is True and manifest["flavor"] == "approx"
+    for key in ("requested_cases", "completed_cases", "seed", "elapsed_s",
+                "failures", "corpus_size", "truncated_after"):
+        assert key in manifest
+
+
+def _approx_corpus_entries():
+    return sorted(glob.glob(os.path.join(CORPUS, "*-approx.npz")))
+
+
+@pytest.mark.parametrize("path", _approx_corpus_entries() or ["<empty>"],
+                         ids=[os.path.basename(p)
+                              for p in _approx_corpus_entries()] or ["none"])
+def test_approx_corpus_replays_clean(path):
+    """Every banked approx repro must stay fixed on the current tree (the
+    same regression-pin policy as every other corpus flavor)."""
+    if path == "<empty>":
+        pytest.skip("no banked approx repros (none found yet)")
+    from cuda_knearests_tpu.fuzz.approx import (_approx_failure,
+                                                load_approx_case)
+
+    b = load_approx_case(path)
+    got = _approx_failure(b["points"], b["k"], b["recall_target"])
+    assert got is None, (f"{os.path.basename(path)} regressed: "
+                         f"{got[0]}: {got[1]}")

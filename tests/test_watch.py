@@ -146,6 +146,28 @@ def test_artifact_good_rejects_cpu_fallback_and_errors(tmp_path):
     assert tpu_watch._artifact_good(str(p))
 
 
+def test_artifact_good_requires_recall_stamp(tmp_path):
+    """ISSUE 10 satellite: a queries/sec row without its recall stamp
+    cannot be compared like-for-like against frontier rows that trade
+    recall for QPS, so a full artifact missing it is never banked."""
+    p = tmp_path / "r.json"
+    unstamped = {"rc": 0, "lines": [
+        {"platform": "tpu", "unit": "queries/sec", "value": 1}]}
+    p.write_text(json.dumps(unstamped))
+    assert not tpu_watch._artifact_good(str(p))
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        {"platform": "tpu", "unit": "queries/sec", "value": 1,
+         "recall": 1.0}]}))
+    assert tpu_watch._artifact_good(str(p))
+    # non-throughput rows (kernel micro-benches, GB/s) stay exempt, as do
+    # partial experiment-matrix artifacts with no result rows to measure
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        {"platform": "tpu", "unit": "GB/s", "value": 1}]}))
+    assert tpu_watch._artifact_good(str(p))
+    p.write_text(json.dumps(unstamped))
+    assert tpu_watch._artifact_good(str(p), True)
+
+
 def test_artifact_good_partial_accepts_result_rows(tmp_path):
     """Experiment-matrix artifacts (kernel A/B, phases): a per-config error
     row is a result (e.g. blocked failing Mosaic); the step must not be
