@@ -732,7 +732,90 @@ def bench_frontier() -> list:
 # -- serving rows (--serve): the open-loop load harness as first-class bench --
 
 _SERVE_SCENARIOS = ("serve_20k_steady", "serve_20k_mutating",
-                    "serve_20k_contained_fault")
+                    "serve_20k_contained_fault", "fleet_4tenant_mix",
+                    "fleet_failover")
+
+
+def _fleet_scenario(name: str) -> dict:
+    """Fleet-tier serving rows (serve/fleet/, DESIGN.md section 17).
+
+    ``fleet_4tenant_mix``: four tenants of mixed SLO classes (two sharing
+    an executable signature, one tiny tenant on the CPU sidecar) under a
+    merged open-loop Poisson mix in which the throughput-tier tenant
+    FLOODS at several times the latency tenants' rate.  The row stamps
+    per-tenant p50/p99/p999, the Jain fairness index over per-tenant
+    completion ratios, per-tenant SLO verdicts (p99 <= the class budget),
+    and ``steady_ok`` (zero fleet-wide steady-state recompiles, asserted
+    from the ExecutableCache counters).
+
+    ``fleet_failover``: the process-level failover drill -- a primary and
+    a replica as real child processes on the framed transport, a genuine
+    SIGKILL mid-stream, and a machine-checkable ``failover_ok`` (>= 1
+    failover, zero lost committed mutations, post-failover answers
+    byte-identical to the rebuild oracle)."""
+    from cuda_knearests_tpu.serve.fleet import (TenantLoad,
+                                                default_fleet_builds,
+                                                failover_drill)
+    from cuda_knearests_tpu.serve.fleet.frontdoor import FleetDaemon
+    from cuda_knearests_tpu.serve.fleet.loadgen import run_fleet_session
+
+    if name == "fleet_failover":
+        drill = failover_drill(
+            n=int(os.environ.get("BENCH_FLEET_FAILOVER_N", "1500")),
+            k=8, ops=24, seed=7)
+        return {
+            "config": "serving fleet [fleet_failover]: SIGKILL the "
+                      "primary mid-stream, promote a caught-up replica "
+                      "over the framed transport",
+            "value": 1.0 if drill["failover_ok"] else 0.0,
+            "unit": "failover_ok",
+            "backend": "subprocess",
+            **drill,
+        }
+    n = int(os.environ.get("BENCH_FLEET_N", "6000"))
+    k = 10
+    _dispatch.EXEC_CACHE.clear()
+    builds = default_fleet_builds(n_tenants=4, base_n=n, k=k, seed=11)
+    _watchdog.heartbeat()
+    fleet = FleetDaemon(builds)   # warmup compiles every tenant's buckets
+    _watchdog.heartbeat()
+    reqs = int(os.environ.get("BENCH_FLEET_REQUESTS", "80"))
+    loads = []
+    for i, (spec, _pts) in enumerate(builds):
+        flood = spec.slo == "throughput" \
+            and not fleet.tenants[spec.name].is_sidecar
+        loads.append(TenantLoad(
+            tenant=spec.name,
+            rate=900.0 if flood else 250.0,
+            requests=reqs * 2 if flood else reqs,
+            seed=40 + i))
+    summary = run_fleet_session(fleet, loads)
+    per_tenant = {
+        t: {key: pt[key] for key in (
+            "slo", "offered_rows", "served_rows", "completion", "refused",
+            "sustained_qps", "sidecar", "p50_ms", "p99_ms", "p999_ms",
+            "slo_p99_budget_ms", "slo_ok")}
+        for t, pt in summary["per_tenant"].items()}
+    return {
+        "config": f"serving fleet [{name}]: 4 tenants mixed SLO "
+                  f"(throughput tier flooding) on uniform:{n} (k={k})",
+        "value": summary["sustained_qps"],
+        "unit": "queries/sec",
+        "backend": "fleet",
+        "recall": 1.0,  # exact serving path (certificates + fallback)
+        "n_points": n,
+        "steady_ok": bool(summary["recompiles"] == 0
+                          and summary["exec_cache_enabled"]
+                          and summary["fleet_batches"] > 0),
+        **{key: summary[key] for key in (
+            "requests", "completed_queries", "failed_requests",
+            "refused_requests", "elapsed_s", "recompiles",
+            "fleet_batches", "occupancy_mean", "jain_fairness",
+            "slo_ok_all", "n_tenants", "host_syncs", "d2h_bytes",
+            "h2d_bytes", "exec_cache_hits", "exec_cache_misses",
+            "exec_cache_evictions", "drr_quantum", "drr_dispatches")},
+        "per_tenant": per_tenant,
+    }
 
 
 def serve_scenario(name: str) -> dict:
@@ -756,6 +839,8 @@ def serve_scenario(name: str) -> dict:
 
     if name not in _SERVE_SCENARIOS:
         raise ValueError(f"unknown serve scenario {name!r}")
+    if name.startswith("fleet_"):
+        return _fleet_scenario(name)
     points = get_dataset("pts20K.xyz")
     k = 10
     # the serving problem pins the legacy external-query route: its
@@ -1025,8 +1110,10 @@ def main(argv=None) -> int:
         a_fields = _analysis_fields()
         a_fields.update(_fuzz_fields())
         for name in _SERVE_SCENARIOS:
-            row, failure = sup.run_job(
-                name, {"job": "serve_scenario", "name": name})
+            job_kind = ("fleet_scenario" if name.startswith("fleet_")
+                        else "serve_scenario")
+            row, failure = sup.run_job(name, {"job": job_kind,
+                                              "name": name})
             if failure is not None:
                 row = {"config": name,
                        "error": f"supervised serve worker failed "

@@ -225,6 +225,38 @@ WINDOWS: Dict[str, Window] = {
         syncs="(1 + fb) + tomb + delta", budget="4",
         notes="_run_batch -> overlay.query is attribute dispatch; "
               "declared via includes"),
+    # One fleet batch (serve/fleet, DESIGN.md section 17): the DRR
+    # scheduler dispatches one tenant's flushed batch through that
+    # tenant's OWN ServeDaemon._execute -- the fleet tier adds admission,
+    # scheduling, and replication bookkeeping (all host-side), never a
+    # transfer site, so the proven bound is exactly the serve bound.
+    "fleet-batch": Window(
+        entries=("serve.fleet.frontdoor.FleetDaemon._run_batch",),
+        includes=("serve-batch",),
+        sites={},
+        syncs="(1 + fb) + tomb + delta", budget="4",
+        notes="_run_batch -> tenant.daemon._execute is attribute "
+              "dispatch; declared via includes and pinned by the fleet "
+              "cache-sharing tests (tests/test_fleet.py)"),
+    # Replication apply: a replica applies one committed DeltaRecord
+    # through the overlay's insert/delete -- pure host CSR bookkeeping
+    # (tombstones, delta rows, cache invalidation).  ZERO host syncs: the
+    # device staging those mutations imply is LAZY, claimed by the
+    # overlay query window at the replica's next query.
+    "fleet-replica-apply": Window(
+        entries=("serve.fleet.replica.Replica.apply",),
+        sites={},
+        syncs="0", budget="0",
+        notes="overlay.insert/delete mutate host state only; the "
+              "deferred overlay-*-stage sites belong to "
+              "serve-overlay-query (byte-identity pins in test_fleet)"),
+    # CPU sidecar: tiny/degenerate tenants answer from pure host numpy --
+    # no executables minted, no dispatch layer touched, zero host syncs
+    # by construction (the Hybrid KNN-Join split, arXiv 1810.04758).
+    "fleet-sidecar": Window(
+        entries=("serve.fleet.sidecar.CpuSidecar.query",),
+        sites={},
+        syncs="0", budget="0"),
 }
 
 # Which model window proves each runtime route's bound -- the route names
@@ -239,6 +271,9 @@ ROUTE_WINDOWS: Dict[str, str] = {
     "fof": "fof",
     "serve-batch": "serve-batch",
     "mxu-brute": "mxu-brute",
+    "fleet-batch": "fleet-batch",
+    "fleet-replica-apply": "fleet-replica-apply",
+    "fleet-sidecar": "fleet-sidecar",
 }
 
 # Sanctioned dispatch sites that live OUTSIDE every solve window: lazy

@@ -332,6 +332,114 @@ class ServeConfig:
                          f"{self.max_batch}")
 
 
+@dataclasses.dataclass(frozen=True)
+class SloClass:
+    """One service-level-objective tier of the serving fleet (serve/fleet,
+    DESIGN.md section 17).  An SLO class parameterizes the EXISTING
+    batching law -- it introduces no new trigger kinds, it just picks the
+    deadline (latency tier: short ``max_delay_s``, small batches flush
+    fast) or the depth (throughput tier: long deadline, deep batches ride
+    the big capacity buckets) per tenant.  "Bigger Buffer k-d Trees"
+    (arXiv 1512.02831) is the motivation for the throughput tier's deep
+    per-tenant buffering.
+
+    Attributes:
+      name: the class's wire name ('latency' / 'throughput').
+      max_delay_s: deadline flush trigger for tenants of this class.
+      max_batch: batch depth cap for tenants of this class (clamped to the
+        fleet ladder's global max_batch so every batch shape stays on the
+        shared bucket ladder).
+      p99_budget_ms: the class's latency promise -- stamped on fleet bench
+        rows as ``slo_ok`` (p99 <= budget) so the "latency tier holds while
+        a throughput tenant floods" law is machine-checkable."""
+
+    name: str
+    max_delay_s: float
+    max_batch: int
+    p99_budget_ms: float
+
+
+# The fleet's SLO-class table.  Tenants name a class; the front door builds
+# each tenant's ServeConfig from it plus the shared ladder (min_bucket and
+# the global max_batch come from ServeFleetConfig, so tenants of equal
+# problem signature share executable-cache entries bucket for bucket).
+SLO_CLASSES = {
+    "latency": SloClass("latency", max_delay_s=0.002, max_batch=64,
+                        p99_budget_ms=250.0),
+    "throughput": SloClass("throughput", max_delay_s=0.05, max_batch=256,
+                           p99_budget_ms=4000.0),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeFleetConfig:
+    """Tunables of the multi-tenant serving fleet (serve/fleet/,
+    DESIGN.md section 17).
+
+    Attributes:
+      min_bucket: smallest capacity bucket of the SHARED ladder.  Every
+        tenant's batches pad to this one power-of-two ladder, so tenants
+        whose prepared problems carry equal executable signatures share
+        ExecutableCache entries -- the second such tenant warms with ZERO
+        new compiles (asserted in tests/test_fleet.py).
+      max_batch: the ladder's global cap; per-class max_batch clamps to it.
+      compact_threshold: per-tenant delta-overlay compaction threshold
+        (serve/delta.py semantics, unchanged).
+      warmup: pre-execute one sentinel batch per bucket per DENSE tenant at
+        fleet start (sidecar tenants mint no executables, nothing to warm).
+      sidecar_threshold: tenants whose cloud is smaller than this (or
+        degenerate: n < k) route to the brute CPU sidecar
+        (serve/fleet/sidecar.py) instead of the dense batching ladder --
+        the Hybrid KNN-Join split (arXiv 1810.04758): tiny tenants must
+        not mint executable signatures or ride capacity buckets.
+      quota_qps: default token-bucket refill rate (query rows/sec) for
+        tenants that do not set their own; None = unmetered.
+      quota_burst: default token-bucket depth (rows) -- the burst a tenant
+        may spend above its sustained rate.
+      drr_quantum: deficit-round-robin quantum (query rows added to each
+        active tenant's deficit per scheduling round).  The fairness law:
+        over any window in which tenants stay backlogged, served rows per
+        tenant differ by at most one quantum plus one batch -- a hot
+        tenant provably cannot starve the rest (DESIGN.md section 17).
+    """
+
+    min_bucket: int = 8
+    max_batch: int = 256
+    compact_threshold: int = 512
+    warmup: bool = True
+    sidecar_threshold: int = 192
+    quota_qps: Optional[float] = None
+    quota_burst: float = 4096.0
+    drr_quantum: int = 64
+
+    def __post_init__(self):
+        if self.min_bucket < 1 or self.max_batch < self.min_bucket:
+            raise ValueError(
+                f"fleet ladder needs 1 <= min_bucket <= max_batch, got "
+                f"min_bucket={self.min_bucket} max_batch={self.max_batch}")
+        if self.sidecar_threshold < 0:
+            raise ValueError(f"sidecar_threshold must be >= 0, got "
+                             f"{self.sidecar_threshold}")
+        if self.drr_quantum < 1:
+            raise ValueError(f"drr_quantum must be >= 1, got "
+                             f"{self.drr_quantum}")
+        if self.quota_qps is not None and self.quota_qps <= 0:
+            raise ValueError(f"quota_qps must be > 0 (or None for "
+                             f"unmetered), got {self.quota_qps}")
+
+    def serve_config_for(self, slo: SloClass,
+                         k: Optional[int] = None) -> ServeConfig:
+        """The per-tenant ServeConfig an SLO class induces on the shared
+        ladder: class deadline/depth, fleet ladder floor/cap.  Built here
+        so every tenant's bucket set is a prefix of one ladder."""
+        return ServeConfig(
+            max_batch=min(int(slo.max_batch), self.max_batch),
+            max_delay_s=float(slo.max_delay_s),
+            min_bucket=self.min_bucket,
+            compact_threshold=self.compact_threshold,
+            warmup=self.warmup, k=k)
+
+
 def resolve_epilogue(epilogue: str, on_kernel_platform: bool) -> str:
     """'auto' -> 'scatter' on kernel platforms, 'gather' elsewhere.
 

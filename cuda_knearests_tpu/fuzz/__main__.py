@@ -25,6 +25,24 @@ def _parse_budget(text):
     return float(t)
 
 
+
+def _finish_campaign(manifest: dict, args, failed_banner: str) -> int:
+    """The shared campaign epilogue: optional --manifest write, one JSON
+    summary line on stdout, banner + rc 1 on failures (every flavor's
+    rc-0 bar is manifest['ok'])."""
+    if args.manifest:
+        os.makedirs(os.path.dirname(os.path.abspath(args.manifest)),
+                    exist_ok=True)
+        with open(args.manifest, "w") as f:
+            json.dump(manifest, f, indent=2)
+    print(json.dumps(manifest))
+    if not manifest["ok"]:
+        print(f"{failed_banner}: {len(manifest['failures'])} failure(s); "
+              f"minimized repros banked", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m cuda_knearests_tpu.fuzz",
@@ -48,6 +66,15 @@ def main(argv=None) -> int:
                          "TPU-KNN bound and certificate soundness vs the "
                          "kd-tree oracle; failures minimized and banked as "
                          "*-approx.npz -- see fuzz/approx.py")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the FLEET campaign instead: --cases seeded "
+                         "multi-tenant interleavings (queries + mutations "
+                         "+ mid-stream replica failover, duplicate/cluster "
+                         "hazards per tenant) through the serve/fleet "
+                         "front door vs per-tenant rebuild oracles with "
+                         "the tie-aware comparison; failures ddmin over "
+                         "the op stream and bank as *-fleet.npz -- see "
+                         "fuzz/fleet.py")
     ap.add_argument("--fof", action="store_true",
                     help="run the FoF campaign instead: --cases clustering "
                          "cases (the same adversarial zoo + seeded linking "
@@ -98,16 +125,26 @@ def main(argv=None) -> int:
 
     flavors = [f for f, on in (("--fof", args.fof),
                                ("--approx", args.approx),
+                               ("--fleet", args.fleet),
                                ("--mutations", args.mutations is not None))
                if on]
     if len(flavors) > 1:
         ap.error(f"{' and '.join(flavors)} are mutually exclusive campaigns")
-    if (args.fof or args.approx) and args.routes:
+    if (args.fof or args.approx or args.fleet) and args.routes:
         ap.error("--routes applies to the point-case campaign only; the "
-                 "FoF and approx campaigns each have a single route")
-    if (args.fof or args.approx) and args.isolation != "auto":
+                 "FoF, approx and fleet campaigns each have a single route")
+    if (args.fof or args.approx or args.fleet) and args.isolation != "auto":
         ap.error("--isolation applies to the point-case campaign only; "
-                 "FoF and approx cases run in-process")
+                 "FoF, approx and fleet cases run in-process")
+
+    if args.fleet:
+        from .fleet import run_fleet_campaign
+
+        kwargs = {} if args.bank_dir is None else {"bank_dir": args.bank_dir}
+        manifest = run_fleet_campaign(
+            n_cases=args.cases, seed=args.seed, budget_s=budget,
+            minimize=not args.no_minimize, **kwargs)
+        return _finish_campaign(manifest, args, "FLEET FUZZ FAILED")
 
     if args.approx:
         from .approx import run_approx_campaign
@@ -116,17 +153,7 @@ def main(argv=None) -> int:
         manifest = run_approx_campaign(
             n_cases=args.cases, seed=args.seed, budget_s=budget,
             minimize=not args.no_minimize, **kwargs)
-        if args.manifest:
-            os.makedirs(os.path.dirname(os.path.abspath(args.manifest)),
-                        exist_ok=True)
-            with open(args.manifest, "w") as f:
-                json.dump(manifest, f, indent=2)
-        print(json.dumps(manifest))
-        if not manifest["ok"]:
-            print(f"APPROX FUZZ FAILED: {len(manifest['failures'])} "
-                  f"failure(s); minimized repros banked", file=sys.stderr)
-            return 1
-        return 0
+        return _finish_campaign(manifest, args, "APPROX FUZZ FAILED")
 
     if args.fof:
         from .fof import run_fof_campaign
@@ -135,17 +162,7 @@ def main(argv=None) -> int:
         manifest = run_fof_campaign(
             n_cases=args.cases, seed=args.seed, budget_s=budget,
             minimize=not args.no_minimize, **kwargs)
-        if args.manifest:
-            os.makedirs(os.path.dirname(os.path.abspath(args.manifest)),
-                        exist_ok=True)
-            with open(args.manifest, "w") as f:
-                json.dump(manifest, f, indent=2)
-        print(json.dumps(manifest))
-        if not manifest["ok"]:
-            print(f"FOF FUZZ FAILED: {len(manifest['failures'])} "
-                  f"failure(s); minimized repros banked", file=sys.stderr)
-            return 1
-        return 0
+        return _finish_campaign(manifest, args, "FOF FUZZ FAILED")
 
     if args.mutations is not None:
         from .mutation import run_mutation_campaign
@@ -154,18 +171,7 @@ def main(argv=None) -> int:
         manifest = run_mutation_campaign(
             n_cases=args.mutations, seed=args.seed, budget_s=budget,
             minimize=not args.no_minimize, **kwargs)
-        if args.manifest:
-            os.makedirs(os.path.dirname(os.path.abspath(args.manifest)),
-                        exist_ok=True)
-            with open(args.manifest, "w") as f:
-                json.dump(manifest, f, indent=2)
-        print(json.dumps(manifest))
-        if not manifest["ok"]:
-            print(f"MUTATION FUZZ FAILED: {len(manifest['failures'])} "
-                  f"failure(s); minimized op streams banked",
-                  file=sys.stderr)
-            return 1
-        return 0
+        return _finish_campaign(manifest, args, "MUTATION FUZZ FAILED")
 
     from .campaign import run_campaign
     from .routes import ROUTE_NAMES
@@ -181,18 +187,7 @@ def main(argv=None) -> int:
         n_cases=args.cases, seed=args.seed, routes=routes, budget_s=budget,
         isolation=args.isolation, n_devices=max(1, args.devices),
         minimize=not args.no_minimize, **kwargs)
-    if args.manifest:
-        os.makedirs(os.path.dirname(os.path.abspath(args.manifest)),
-                    exist_ok=True)
-        with open(args.manifest, "w") as f:
-            json.dump(manifest, f, indent=2)
-    print(json.dumps(manifest))
-    if not manifest["ok"]:
-        n = len(manifest["failures"])
-        print(f"FUZZ CAMPAIGN FAILED: {n} unwaived failure(s); minimized "
-              f"repros banked (see manifest 'failures')", file=sys.stderr)
-        return 1
-    return 0
+    return _finish_campaign(manifest, args, "FUZZ CAMPAIGN FAILED")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,405 @@
+"""Fleet fuzzing: multi-tenant interleavings vs per-tenant rebuild oracles.
+
+The fleet front door (serve/fleet) promises per-tenant ISOLATION: every
+tenant's answers are exactly what a single-tenant engine over that
+tenant's mutated cloud would produce, no matter how the other tenants'
+queries, mutations, sidecar placements, and failovers interleave.  This
+module attacks that promise the way fuzz/mutation.py attacks the overlay:
+
+* Seeded multi-tenant op streams (queries / inserts with duplicate- and
+  cluster-hazard flavors / deletes, tenant-tagged), with a guaranteed
+  mutate -> failover -> query subsequence on the replicated tenant so the
+  replication log's re-ship path is exercised mid-stream, under both
+  ship modes ('sync' and 'lazy').
+* After every query op, the answering tenant is checked against ITS OWN
+  independently tracked cloud (host np.delete/np.concatenate replay of
+  the acked mutations -- the same canonical indexing the overlay and the
+  replication log use) via ``KnnProblem.prepare(tracked).query`` with the
+  tie-aware comparison (fuzz/compare.py) -- index equality is wrong under
+  the duplicate hazards, distance-multiset equality is the contract.
+* Failing streams ddmin-minimize (kind-preserving, delete ids
+  re-legalized per tenant) and bank to ``tests/corpus/*-fleet.npz``,
+  replayed forever by tests/test_fleet.py.
+* ``KNTPU_FLEET_FAULT=cross-tenant|drop-delta|stale-replica`` seeds the
+  three fleet corruptions (serve/fleet/frontdoor.py); each provably
+  yields a banked failure (the check.sh self-tests), diverted away from
+  the real corpus like every other faulted flavor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import CORPUS_DIR, corpus_size
+from .compare import check_route_result
+from .mutation import ddmin_ops
+from ..config import DOMAIN_SIZE
+
+# Small enough that streams compact mid-case; sidecar threshold sits
+# between the tiny and dense generator sizes so both placements fuzz.
+FLEET_COMPACT_THRESHOLD = 24
+FLEET_SIDECAR_THRESHOLD = 48
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSpec:
+    """Regenerable identity of one fleet case."""
+
+    seed: int
+    n0s: Tuple[int, ...]          # per-tenant initial cloud sizes
+    ks: Tuple[int, ...]           # per-tenant serving k
+    n_ops: int
+    replicated: int               # tenant index carrying replicas (-1=none)
+    ship_mode: str                # 'sync' | 'lazy'
+
+    @property
+    def n_tenants(self) -> int:
+        return len(self.n0s)
+
+    def tenant_names(self) -> List[str]:
+        return [f"t{i}" for i in range(self.n_tenants)]
+
+    def case_id(self) -> str:
+        sizes = "x".join(str(n) for n in self.n0s)
+        return (f"fleet-s{self.seed}-n{sizes}-o{self.n_ops}"
+                f"-r{self.replicated}-{self.ship_mode}")
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FleetSpec":
+        return cls(seed=int(d["seed"]), n0s=tuple(d["n0s"]),
+                   ks=tuple(d["ks"]), n_ops=int(d["n_ops"]),
+                   replicated=int(d["replicated"]),
+                   ship_mode=str(d["ship_mode"]))
+
+
+@dataclasses.dataclass
+class FleetFailure:
+    """One stream's isolation violation (or crash)."""
+
+    case_id: str
+    kind: str
+    reason: str
+    op_index: int
+    original_ops: int
+    minimized_ops: Optional[int] = None
+    banked: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def initial_clouds(spec: FleetSpec) -> List[np.ndarray]:
+    return [(np.random.default_rng(spec.seed + 101 * i)
+             .random((n0, 3)) * (DOMAIN_SIZE * 0.98)
+             + DOMAIN_SIZE * 0.01).astype(np.float32)
+            for i, n0 in enumerate(spec.n0s)]
+
+
+def generate_ops(spec: FleetSpec) -> List[dict]:
+    """The seeded tenant-tagged op stream.  Structure guarantees: when a
+    tenant is replicated, the stream contains at least one committed
+    mutation on it, then a failover, then a query of it (the re-ship path
+    always fuzzes); every tenant gets one final query (a pure-mutation
+    tail still checks)."""
+    rng = np.random.default_rng(spec.seed + 1)
+    clouds = initial_clouds(spec)
+    live = [int(c.shape[0]) for c in clouds]
+    names = spec.tenant_names()
+    ops: List[dict] = []
+
+    def _insert(ti: int) -> dict:
+        m = int(rng.integers(1, 7))
+        flavor = rng.random()
+        if flavor < 0.5 or live[ti] == 0:
+            pts = (rng.random((m, 3)) * (DOMAIN_SIZE * 0.98)
+                   + DOMAIN_SIZE * 0.01).astype(np.float32)
+        elif flavor < 0.8:
+            # duplicate hazard: exact copies of one initial point of THIS
+            # tenant (exactly-tied f32 distances through the merge)
+            src = clouds[ti][int(rng.integers(0, clouds[ti].shape[0]))]
+            pts = np.tile(src, (m, 1)).astype(np.float32)
+        else:
+            # cluster hazard: a tight blob inside one cell
+            c = rng.random(3) * (DOMAIN_SIZE * 0.9) + DOMAIN_SIZE * 0.05
+            pts = (c + rng.normal(0, DOMAIN_SIZE * 1e-4, (m, 3))
+                   ).clip(0, np.nextafter(DOMAIN_SIZE, 0)).astype(np.float32)
+        live[ti] += m
+        return {"op": "insert", "tenant": names[ti], "points": pts}
+
+    def _query(ti: int) -> dict:
+        m = int(rng.integers(1, 7))
+        qs = (rng.random((m, 3)) * (DOMAIN_SIZE * 0.98)
+              + DOMAIN_SIZE * 0.01).astype(np.float32)
+        return {"op": "query", "tenant": names[ti], "queries": qs}
+
+    for _ in range(spec.n_ops):
+        ti = int(rng.integers(0, spec.n_tenants))
+        roll = rng.random()
+        if roll < 0.35:
+            ops.append(_insert(ti))
+        elif roll < 0.55 and live[ti] > 8:
+            m = int(rng.integers(1, 5))
+            ids = np.sort(rng.choice(live[ti], size=m, replace=False))
+            ops.append({"op": "delete", "tenant": names[ti],
+                        "ids": ids.astype(np.int64)})  # kntpu-ok: wide-dtype -- host id payload
+            live[ti] -= m
+        else:
+            ops.append(_query(ti))
+    if 0 <= spec.replicated < spec.n_tenants:
+        ti = spec.replicated
+        ops.append(_insert(ti))
+        ops.append({"op": "failover", "tenant": names[ti]})
+        ops.append(_query(ti))
+    ops.extend(_query(ti) for ti in range(spec.n_tenants))
+    return ops
+
+
+def _parse_fleet_fault() -> Optional[str]:
+    """One validation site for KNTPU_FLEET_FAULT: the front door owns it
+    (typed InvalidConfigError on unknown values); lazy import keeps the
+    serve stack off this module's import path."""
+    from ..serve.fleet.frontdoor import _parse_fleet_fault as parse
+
+    return parse()
+
+
+def replay_ops(spec: FleetSpec, ops: Sequence[dict]) \
+        -> Optional[Tuple[str, str, int]]:
+    """Run one stream through a fresh fleet, differentially checking every
+    query op against the answering tenant's independently tracked cloud.
+    Returns None when clean, else (kind, reason, op_index).  A raise on a
+    legal stream IS the failure (containment contract)."""
+    from .. import KnnConfig, KnnProblem
+    from ..config import ServeFleetConfig
+    from ..serve.fleet.frontdoor import FleetDaemon
+    from ..serve.fleet.tenants import TenantSpec
+
+    names = spec.tenant_names()
+    try:
+        clouds = initial_clouds(spec)
+        tracked = {name: np.array(c) for name, c in zip(names, clouds)}
+        builds = [(TenantSpec(name=names[i], k=spec.ks[i],
+                              slo="latency" if i % 2 == 0
+                              else "throughput",
+                              replicas=1 if i == spec.replicated else 0,
+                              ship_mode=spec.ship_mode), clouds[i])
+                  for i in range(spec.n_tenants)]
+        fleet = FleetDaemon(builds, ServeFleetConfig(
+            min_bucket=8, max_batch=64,
+            compact_threshold=FLEET_COMPACT_THRESHOLD, warmup=False,
+            sidecar_threshold=FLEET_SIDECAR_THRESHOLD, drr_quantum=16))
+        now = 0.0
+        for i, op in enumerate(ops):
+            now += 1e-3
+            name = op["tenant"]
+            ti = names.index(name)
+            if op["op"] == "insert":
+                resp = fleet.submit(i, name, "insert", op["points"],
+                                    now=now)
+                if resp and resp[-1].ok:
+                    tracked[name] = np.concatenate(
+                        [tracked[name],
+                         np.asarray(op["points"], np.float32)])  # kntpu-ok: host-sync-loop -- host-resident op payload (pure numpy), no device array rides this loop
+            elif op["op"] == "delete":
+                ids = np.asarray(op["ids"]).reshape(-1)  # kntpu-ok: host-sync-loop -- host-resident op payload (pure numpy), no device array rides this loop
+                ids = ids[ids < tracked[name].shape[0]]  # re-legalize
+                if ids.size == 0:
+                    continue
+                resp = fleet.submit(i, name, "delete", ids, now=now)
+                if resp and resp[-1].ok:
+                    tracked[name] = np.delete(tracked[name], ids, axis=0)
+            elif op["op"] == "failover":
+                t = fleet.tenants[name]
+                if t.is_sidecar or not t.replica_pool:
+                    continue  # minimization may orphan the failover op
+                fleet.failover(name)
+            else:
+                queries = np.asarray(op["queries"], np.float32)  # kntpu-ok: host-sync-loop -- host-resident op payload (pure numpy), no device array rides this loop
+                k = spec.ks[ti]
+                responses = fleet.submit(i, name, "query", queries,
+                                         now=now)
+                responses += fleet.drain(now)
+                mine = [r for r in responses
+                        if r.req_id == i and r.tenant == name]
+                if len(mine) != 1 or not mine[0].ok:
+                    err = mine[0].error if mine else "<no response>"
+                    return ("mismatch",
+                            f"op {i}: tenant {name} query got no clean "
+                            f"response: {err}", i)
+                got_i = np.asarray(mine[0].ids)  # kntpu-ok: host-sync-loop -- Response rows are host numpy (the daemon fetched them through dispatch already)
+                got_d = np.asarray(mine[0].d2)  # kntpu-ok: host-sync-loop -- Response rows are host numpy (the daemon fetched them through dispatch already)
+                pts = tracked[name]
+                ref = KnnProblem.prepare(
+                    pts, KnnConfig(k=k, adaptive=False), validate=False)
+                _ref_i, ref_d = ref.query(queries, k)
+                bad = check_route_result(pts, queries, got_i, got_d,
+                                         np.asarray(ref_d), k)  # kntpu-ok: host-sync-loop -- one oracle readback per QUERY op is the differential harness's job
+                if bad is not None:
+                    return ("mismatch",
+                            f"op {i}: tenant {name} diverged from its "
+                            f"rebuild oracle: {bad.render()}", i)
+    except Exception as e:  # noqa: BLE001 -- containment IS the job: any raise on a legal stream is the banked failure
+        from ..utils.memory import classify_fault_text
+
+        kind = classify_fault_text(f"{type(e).__name__}: {e}") or "crash"
+        return (kind, f"op stream raised {type(e).__name__}: {e}",
+                len(ops))
+    return None
+
+
+# -- banking ------------------------------------------------------------------
+
+def _ops_to_json(ops: Sequence[dict]) -> str:
+    out = []
+    for op in ops:
+        item = {"op": op["op"], "tenant": op["tenant"]}
+        if op["op"] == "insert":
+            item["points"] = np.asarray(op["points"],  # kntpu-ok: host-sync-loop -- host-resident op payload (pure numpy), no device array rides this loop
+                                        np.float32).tolist()
+        elif op["op"] == "delete":
+            item["ids"] = np.asarray(op["ids"]).tolist()  # kntpu-ok: host-sync-loop -- host-resident op payload (pure numpy), no device array rides this loop
+        elif op["op"] == "query":
+            item["queries"] = np.asarray(op["queries"],  # kntpu-ok: host-sync-loop -- host-resident op payload (pure numpy), no device array rides this loop
+                                         np.float32).tolist()
+        out.append(item)
+    return json.dumps(out)
+
+
+def ops_from_json(text: str) -> List[dict]:
+    ops = []
+    for op in json.loads(text):
+        item = {"op": op["op"], "tenant": op["tenant"]}
+        if op["op"] == "insert":
+            item["points"] = np.asarray(op["points"], np.float32)  # kntpu-ok: host-sync-loop -- host-resident op payload (pure numpy), no device array rides this loop
+        elif op["op"] == "delete":
+            item["ids"] = np.asarray(op["ids"], np.int64)  # kntpu-ok: wide-dtype -- host id payload  # kntpu-ok: host-sync-loop -- host-resident op payload (pure numpy), no device array rides this loop
+        elif op["op"] == "query":
+            item["queries"] = np.asarray(op["queries"], np.float32)  # kntpu-ok: host-sync-loop -- host-resident op payload (pure numpy), no device array rides this loop
+        ops.append(item)
+    return ops
+
+
+def bank_fleet_case(bank_dir: str, spec: FleetSpec, kind: str,
+                    reason: str, ops: Sequence[dict]) -> str:
+    os.makedirs(bank_dir, exist_ok=True)
+    path = os.path.join(bank_dir, f"{spec.case_id()}-fleet.npz")
+    np.savez_compressed(
+        path,
+        schema=np.bytes_(b"fleet-stream-v1"),
+        spec_json=np.bytes_(json.dumps(spec.to_json()).encode()),
+        ops_json=np.bytes_(_ops_to_json(ops).encode()),
+        kind=np.bytes_(kind.encode()),
+        reason=np.bytes_(reason[:2000].encode()))
+    return path
+
+
+def load_fleet_case(path: str) -> dict:
+    with np.load(path) as z:
+        return {
+            "spec": FleetSpec.from_json(
+                json.loads(bytes(z["spec_json"]).decode())),
+            "ops": ops_from_json(bytes(z["ops_json"]).decode()),
+            "kind": bytes(z["kind"]).decode(),
+            "reason": bytes(z["reason"]).decode(),
+        }
+
+
+def _safe_bank_dir(bank_dir: Optional[str]) -> Optional[str]:
+    """KNTPU_FLEET_FAULT runs must never bank synthetic repros into the
+    real corpus (same rule as the other faulted flavors)."""
+    if bank_dir is None or _parse_fleet_fault() is None:
+        return bank_dir
+    if os.path.abspath(bank_dir) != os.path.abspath(CORPUS_DIR):
+        return bank_dir
+    import tempfile
+
+    return tempfile.mkdtemp(prefix="kntpu-fleet-faulted-")
+
+
+def run_fleet_case(spec: FleetSpec, bank_dir: Optional[str] = None,
+                   minimize: bool = True,
+                   max_probes: int = 24) -> Optional[FleetFailure]:
+    """One case end to end: generate, replay, minimize, bank."""
+    ops = generate_ops(spec)
+    got = replay_ops(spec, ops)
+    if got is None:
+        return None
+    kind, reason, op_index = got
+    failure = FleetFailure(case_id=spec.case_id(), kind=kind,
+                           reason=reason, op_index=op_index,
+                           original_ops=len(ops))
+    repro = list(ops)
+    if minimize and len(ops) > 1:
+        def _still_fails(sub):
+            sub_got = replay_ops(spec, sub)
+            return sub_got is not None and sub_got[0] == kind
+        repro = ddmin_ops(repro, _still_fails, max_probes=max_probes)
+    failure.minimized_ops = len(repro)
+    bank_dir = _safe_bank_dir(bank_dir)
+    if bank_dir is not None:
+        failure.banked = bank_fleet_case(bank_dir, spec, kind, reason,
+                                         repro)
+    return failure
+
+
+def run_fleet_campaign(n_cases: int = 16, seed: int = 0,
+                       bank_dir: str = CORPUS_DIR,
+                       budget_s: Optional[float] = None,
+                       minimize: bool = True,
+                       log=print) -> dict:
+    """The fleet campaign; manifest['ok'] is the rc-0 bar."""
+    log = log or (lambda s: None)
+    t0 = time.monotonic()
+    rng = np.random.default_rng(seed)
+    specs = []
+    for _ in range(n_cases):
+        n_tenants = int(rng.choice([2, 3]))
+        # at least one dense tenant; a size under the sidecar threshold
+        # lands that tenant on the CPU sidecar
+        n0s = tuple(int(rng.choice([36, 90, 150]))
+                    for _ in range(n_tenants - 1)) + (150,)
+        dense = [i for i, n in enumerate(n0s)
+                 if n >= FLEET_SIDECAR_THRESHOLD]
+        specs.append(FleetSpec(
+            seed=int(rng.integers(0, 2 ** 31)),
+            n0s=n0s,
+            ks=tuple(int(rng.choice([4, 8])) for _ in range(n_tenants)),
+            n_ops=int(rng.choice([6, 10, 16])),
+            replicated=int(rng.choice(dense)),
+            ship_mode=str(rng.choice(["sync", "lazy"]))))
+    failures: List[FleetFailure] = []
+    completed = 0
+    truncated_after: Optional[int] = None
+    for i, spec in enumerate(specs):
+        if budget_s is not None and time.monotonic() - t0 > budget_s:
+            truncated_after = i
+            log(f"[{i}/{len(specs)}] budget {budget_s:.0f}s exhausted; "
+                f"remaining fleet cases truncated")
+            break
+        f = run_fleet_case(spec, bank_dir=bank_dir, minimize=minimize)
+        completed += 1
+        tag = "ok" if f is None else f"FAIL {f.kind}"
+        log(f"[{i + 1}/{len(specs)}] {spec.case_id()} {tag}")
+        if f is not None:
+            failures.append(f)
+    return {
+        "ok": not failures,
+        "flavor": "fleet-stream",
+        "requested_cases": n_cases,
+        "completed_cases": completed,
+        "truncated_after": truncated_after,
+        "seed": seed,
+        "fault": _parse_fleet_fault(),
+        "elapsed_s": round(time.monotonic() - t0, 3),
+        "failures": [f.to_json() for f in failures],
+        "corpus_size": corpus_size(bank_dir),
+    }

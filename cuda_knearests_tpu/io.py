@@ -23,7 +23,8 @@ from .config import DOMAIN_SIZE
 from .utils.memory import (CorruptInputError, DegenerateExtentError,
                            DomainBoundsError, InvalidConfigError,
                            InvalidKError, InvalidRequestError,
-                           InvalidShapeError, NonFiniteInputError)
+                           InvalidShapeError, NonFiniteInputError,
+                           OverQuotaError, UnknownTenantError)
 
 
 def load_xyz(path: str) -> np.ndarray:
@@ -204,7 +205,10 @@ REQUEST_KINDS = ("query", "insert", "delete", "fof")
 def validate_request(kind: str, payload, *, k=None, k_max: Optional[int] = None,
                      n_current: Optional[int] = None,
                      max_batch: Optional[int] = None,
-                     domain: float = DOMAIN_SIZE):
+                     domain: float = DOMAIN_SIZE,
+                     tenant: Optional[str] = None,
+                     tenants: Optional[Tuple[str, ...]] = None,
+                     quota_ok: Optional[bool] = None):
     """The request-stream front door: the per-request twin of
     :func:`validate_or_raise`, enforced by the serving daemon at admission
     (serve/daemon.py) so a malformed request is REFUSED with the typed
@@ -226,11 +230,33 @@ def validate_request(kind: str, payload, *, k=None, k_max: Optional[int] = None,
         payload is one finite positive real (validate_linking_length);
         labels are computed over the current mutated cloud.
 
+    Fleet extension (serve/fleet, DESIGN.md section 17) -- the wire
+    contract gains a TENANT field: when ``tenants`` (the front door's
+    registry) is given, ``tenant`` must name one of them, refused typed
+    (UnknownTenantError) otherwise -- never routed to a 'nearest' tenant,
+    which would silently answer against the wrong cloud.  ``quota_ok``
+    carries the admission controller's token-bucket verdict for THIS
+    request (serve/fleet/admission.py computes it; this front door owns
+    the refusal's type and text): ``False`` refuses typed
+    (OverQuotaError).  Per-tenant k/dims mismatches surface through the
+    same ``k_max``/points-contract checks below, with the tenant named in
+    the message when one is in play.
+
     Raises InvalidRequestError (unknown kind / oversized / bad ids),
-    InvalidKError, InvalidConfigError (bad linking length), or the
-    points-contract taxonomy.  Returns the validated payload (f32 (m, 3)
-    for query/insert, i64->i32-safe (m,) int array for delete, float for
-    fof)."""
+    UnknownTenantError, OverQuotaError, InvalidKError, InvalidConfigError
+    (bad linking length), or the points-contract taxonomy.  Returns the
+    validated payload (f32 (m, 3) for query/insert, i64->i32-safe (m,)
+    int array for delete, float for fof)."""
+    if tenants is not None and tenant not in tenants:
+        raise UnknownTenantError(
+            f"unknown tenant {tenant!r}: this front door serves "
+            f"{tuple(tenants)} (request contract; the tenant field is "
+            f"mandatory on fleet wires)")
+    if quota_ok is False:
+        raise OverQuotaError(
+            f"tenant {tenant!r} is over quota: the token-bucket admission "
+            f"rate for this tenant is exhausted -- retry after backoff "
+            f"(request contract; see ServeFleetConfig quotas)")
     if kind not in REQUEST_KINDS:
         raise InvalidRequestError(
             f"unknown request kind {kind!r}: expected one of "
@@ -243,8 +269,10 @@ def validate_request(kind: str, payload, *, k=None, k_max: Optional[int] = None,
                                 domain=domain, what=what)
         if kind == "query" and k is not None and k_max is not None \
                 and int(k) > int(k_max):
+            who = f"tenant {tenant!r}'s" if tenant is not None \
+                else "the"
             raise InvalidKError(
-                f"request k={int(k)} exceeds the serving k={int(k_max)} "
+                f"request k={int(k)} exceeds {who} serving k={int(k_max)} "
                 f"that sized the hot executables (request contract)")
         if max_batch is not None and out.shape[0] > int(max_batch):
             raise InvalidRequestError(

@@ -13,6 +13,10 @@ device job and reports through the one-line framed JSON protocol
                             (one open-loop serving session; isolation makes
                             the PR 2 supervisor the daemon's whole-process
                             crash boundary -- bench.py --serve)
+  {"job": "fleet_scenario", "name": "<fleet row>"} -> bench.serve_scenario
+                            (one fleet-tier session -- multi-tenant mix or
+                            the SIGKILL failover drill; the drill's replica
+                            children nest under this worker)
   {"job": "selftest"}    -> a trivial well-formed row, no device work (the
                             fast vehicle for the fault-injection tests)
 
@@ -136,9 +140,13 @@ def _run_job(job: dict) -> dict:
         row = bench.bench_config(job["name"])
     elif job.get("job") == "north_star":
         row = bench.bench_north_star()
-    elif job.get("job") == "serve_scenario":
+    elif job.get("job") in ("serve_scenario", "fleet_scenario"):
         # one open-loop serving session (bench.py --serve): isolated so a
-        # daemon process death costs one typed scenario row, not the bench
+        # daemon process death costs one typed scenario row, not the
+        # bench.  'fleet_scenario' (DESIGN.md section 17) rides the same
+        # dispatcher -- the distinct job kind labels failure records, and
+        # the failover drill's own child processes nest under this worker
+        # so a wedged replica costs one typed row, never the bench
         row = bench.serve_scenario(job["name"])
     else:
         raise ValueError(f"unknown worker job {job.get('job')!r}")

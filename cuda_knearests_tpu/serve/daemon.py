@@ -65,6 +65,9 @@ class Response:
     # labels over the CURRENT mutated cloud + the distinct-cluster count
     labels: Optional[np.ndarray] = None
     n_clusters: Optional[int] = None
+    # fleet wires (serve/fleet, DESIGN.md section 17) stamp the tenant the
+    # response belongs to; single-tenant daemons leave it None
+    tenant: Optional[str] = None
 
     @property
     def latency_s(self) -> float:
@@ -84,6 +87,8 @@ class Response:
         if self.labels is not None:
             out["labels"] = np.asarray(self.labels).tolist()
             out["n_clusters"] = self.n_clusters
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
         if not self.ok:
             out["error"] = self.error
             out["failure_kind"] = self.failure_kind
@@ -280,6 +285,10 @@ class ServeDaemon:
 
         st = self.overlay.stats
         version = (b, st.inserts, st.deletes, st.compactions)
+        # NOTE the version key is per-OVERLAY: anything that swaps the
+        # overlay object itself (fleet failover) must call
+        # invalidate_fof_memo(), because the new overlay's counters can
+        # legally collide with the old one's
         if self._fof_cache is not None and self._fof_cache[0] == version:
             # NOTE the memo is daemon-owned host state, deliberately NOT
             # keyed through the executable cache: an ExecutableCache LRU
@@ -294,6 +303,14 @@ class ServeDaemon:
         res = fof_labels(self.overlay.mutated_points(), b, validate=False)
         self._fof_cache = (version, res)
         return res
+
+    def invalidate_fof_memo(self) -> None:
+        """Drop the FoF memo.  Mutations invalidate it implicitly through
+        the overlay-stats version key; callers that swap the overlay
+        OBJECT (fleet failover promotes a replica's overlay) must call
+        this, since the new overlay's counters can collide with the old
+        key."""
+        self._fof_cache = None
 
     def _run_batch(self, batch: Batch, idx: int):
         """One padded bucket-capacity launch at the serving k."""

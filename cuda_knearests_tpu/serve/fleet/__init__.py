@@ -1,0 +1,48 @@
+"""Serving fleet: multi-tenant, multi-index, replicated serving tier.
+
+One daemon owning one prepared cloud (serve/) is a demo; the north star --
+heavy traffic from millions of users -- means many indexes behind one
+front door (ROADMAP item 3).  This package is that tier:
+
+* :mod:`tenants` -- the tenant model: per-tenant prepared problem, SLO
+  class, quota, replication factor; dense tenants on the shared bucket
+  ladder, tiny/degenerate tenants on the CPU sidecar.
+* :mod:`admission` -- token-bucket admission (typed over-quota refusals)
+  and deficit-round-robin scheduling with per-dispatch fairness stamps.
+* :mod:`replica` -- the replication log (PR 6 delta payloads + sequence
+  numbers), in-process and child-process replicas, and the
+  SIGKILL-tolerant failover controller.
+* :mod:`sidecar` -- the brute CPU worker absorbing tiny tenants
+  ("Hybrid KNN-Join", arXiv 1810.04758).
+* :mod:`frontdoor` -- the FleetDaemon multiplexing all of it behind one
+  wire surface.
+* :mod:`loadgen` -- the multi-tenant open-loop harness (per-tenant
+  percentiles, Jain fairness, SLO verdicts) behind ``bench.py --serve``'s
+  fleet rows.
+
+``python -m cuda_knearests_tpu.serve.fleet --loadgen`` runs a mixed-SLO
+synthetic fleet session; ``--failover-smoke`` runs the process-level
+SIGKILL failover proof.  DESIGN.md section 17 has the tenant model, the
+admission/fairness law, the replication-log sequencing, and the failover
+protocol.
+"""
+
+from __future__ import annotations
+
+from ...config import SLO_CLASSES, ServeFleetConfig, SloClass
+from .admission import DrrScheduler, TokenBucket, jain_index
+from .frontdoor import FLEET_FAULTS, FleetDaemon
+from .loadgen import (TenantLoad, build_fleet_schedule,
+                      default_fleet_builds, run_fleet_session)
+from .replica import (DeltaRecord, FailoverController, Replica,
+                      ReplicaProcess, ReplicationLog, failover_drill,
+                      replay_on_host)
+from .sidecar import CpuSidecar
+from .tenants import Tenant, TenantSpec
+
+__all__ = ["SLO_CLASSES", "ServeFleetConfig", "SloClass", "DrrScheduler",
+           "TokenBucket", "jain_index", "FLEET_FAULTS", "FleetDaemon",
+           "TenantLoad", "build_fleet_schedule", "default_fleet_builds",
+           "run_fleet_session", "DeltaRecord", "FailoverController",
+           "Replica", "ReplicaProcess", "ReplicationLog", "failover_drill",
+           "replay_on_host", "CpuSidecar", "Tenant", "TenantSpec"]

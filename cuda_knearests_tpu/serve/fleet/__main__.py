@@ -1,0 +1,139 @@
+"""``python -m cuda_knearests_tpu.serve.fleet`` -- the fleet's front door.
+
+Two self-driving modes over the fleet tier (DESIGN.md section 17):
+
+* ``--loadgen`` (default): a mixed-SLO multi-tenant open-loop session
+  (fleet/loadgen.py) -- tenants alternate latency/throughput classes,
+  one tiny tenant rides the CPU sidecar, the first two tenants share an
+  executable signature.  Prints the fleet summary as one JSON line.
+  ``--assert-steady`` exits nonzero unless the session flushed batches
+  for >= 2 tenants with ZERO steady-state recompiles fleet-wide and a
+  defined Jain fairness index -- the scripts/check.sh fleet smoke's gate.
+* ``--failover-smoke``: the process-level failover proof.  A primary and
+  a replica run as REAL child processes (fleet/replica.py, the PR 2
+  framed-JSON transport); a seeded mutation+query stream commits through
+  the primary; mid-stream the primary takes a genuine SIGKILL; the
+  controller fails over to the caught-up replica and the stream finishes.
+  Exit 0 requires ZERO lost committed mutations (the promoted replica's
+  cloud equals the committed log's host replay exactly) and post-failover
+  query results BYTE-IDENTICAL to a rebuild-from-scratch oracle on that
+  cloud.
+
+Exit codes follow the CLI convention: 0 ok; 1 assertion/summary failure;
+4 classified device fault; 5 input-contract violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _failover_smoke(n: int, k: int, ops: int, seed: int) -> int:
+    from .replica import failover_drill
+
+    summary = {"config": "fleet failover smoke",
+               **failover_drill(n=n, k=k, ops=ops, seed=seed,
+                                log=lambda s: print(
+                                    json.dumps({"event": s}), flush=True))}
+    print(json.dumps(summary), flush=True)
+    return 0 if summary["failover_ok"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cuda_knearests_tpu.serve.fleet",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--loadgen", action="store_true",
+                    help="run the mixed-SLO open-loop fleet session (the "
+                         "default mode; the flag exists for symmetry with "
+                         "python -m cuda_knearests_tpu.serve)")
+    ap.add_argument("--tenants", type=int, default=4,
+                    help="fleet size for --loadgen (mixed SLO classes; "
+                         "the last tenant is tiny -> CPU sidecar)")
+    ap.add_argument("--points", type=int, default=6000,
+                    help="dense-tenant cloud size (default 6000)")
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--rate", type=float, default=300.0,
+                    help="per-tenant mean arrivals/sec (Poisson)")
+    ap.add_argument("--requests", type=int, default=60,
+                    help="per-tenant scheduled arrivals")
+    ap.add_argument("--mutation-ratio", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="in-process replicas per dense tenant")
+    ap.add_argument("--assert-steady", action="store_true",
+                    help="exit 1 unless >= 2 tenants flushed batches with "
+                         "zero fleet-wide steady-state recompiles and a "
+                         "defined fairness index (the CI smoke gate)")
+    ap.add_argument("--failover-smoke", action="store_true",
+                    help="run the process-level SIGKILL failover proof "
+                         "instead of the loadgen session")
+    ap.add_argument("--failover-ops", type=int, default=24)
+    ap.add_argument("--failover-points", type=int, default=1500)
+    args = ap.parse_args(argv)
+
+    from ...utils.platform import enable_compile_cache, honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    enable_compile_cache()
+
+    from ...utils.memory import DeviceMemoryError, InputContractError
+
+    try:
+        if args.failover_smoke:
+            return _failover_smoke(args.failover_points, args.k,
+                                   args.failover_ops, args.seed)
+
+        from .frontdoor import FleetDaemon
+        from .loadgen import (TenantLoad, default_fleet_builds,
+                              run_fleet_session)
+
+        builds = default_fleet_builds(
+            n_tenants=max(1, args.tenants), base_n=args.points, k=args.k,
+            seed=args.seed, replicas=args.replicas)
+        fleet = FleetDaemon(builds)
+        loads = [TenantLoad(tenant=spec.name, rate=args.rate,
+                            requests=args.requests,
+                            mutation_ratio=(args.mutation_ratio
+                                            if not fleet.tenants[
+                                                spec.name].is_sidecar
+                                            else 0.0),
+                            seed=args.seed + 31 * i)
+                 for i, (spec, _) in enumerate(builds)]
+        summary = run_fleet_session(fleet, loads)
+    except InputContractError as e:
+        print(json.dumps({"error": str(e),
+                          "failure_kind": getattr(e, "kind", "crash")}),
+              flush=True)
+        return 5
+    except DeviceMemoryError as e:
+        print(json.dumps({"error": str(e),
+                          "failure_kind": getattr(e, "kind", "crash")}),
+              flush=True)
+        return 4
+
+    print(json.dumps(summary), flush=True)
+    if args.assert_steady:
+        dense_served = [name for name, pt in summary["per_tenant"].items()
+                        if not pt["sidecar"] and pt["served_rows"] > 0]
+        ok = (len(dense_served) >= 2
+              and summary["recompiles"] == 0
+              and summary["exec_cache_enabled"]
+              and summary["failed_requests"] == 0
+              and summary["jain_fairness"] is not None)
+        if not ok:
+            print(f"FLEET STEADY-STATE ASSERTION FAILED: "
+                  f"dense_served={dense_served} "
+                  f"recompiles={summary['recompiles']} "
+                  f"cache_enabled={summary['exec_cache_enabled']} "
+                  f"failed={summary['failed_requests']} "
+                  f"jain={summary['jain_fairness']}",
+                  file=sys.stderr, flush=True)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,340 @@
+"""The fleet front door: many tenants, one wire, one scheduling law.
+
+``FleetDaemon`` multiplexes several prepared indexes (tenants) behind one
+request surface (DESIGN.md section 17).  The flow per request:
+
+1. **Admission** -- ONE call to ``io.validate_request`` carrying the
+   tenant field: unknown-tenant, over-quota (the token bucket's verdict),
+   per-tenant k mismatch, and the whole points/ids contract all refuse
+   TYPED here, before anything queues.  A refusal costs nothing but the
+   refused request.
+2. **Placement** -- sidecar tenants answer synchronously from the brute
+   CPU worker; dense tenants enter their OWN dynamic batcher (PR 6
+   machinery, SLO-class flush triggers) on the SHARED bucket ladder.
+3. **Scheduling** -- flushed batches queue per tenant and execute in
+   deficit-round-robin order (serve/fleet/admission.py), each dispatch
+   stamped with its fairness accounting.  Mutations and FoF stay
+   barriers WITHIN their tenant (stream order per tenant is the PR 6
+   daemon's law, unchanged); they do not barrier other tenants.
+4. **Replication** -- a mutation the primary applied successfully commits
+   to the tenant's replication log and ships to its replicas
+   (serve/fleet/tenants.py); ``failover()`` promotes a caught-up replica.
+
+Fault injection (CPU-testable, same convention as KNTPU_SERVE_FAULT):
+``KNTPU_FLEET_FAULT=cross-tenant|drop-delta|stale-replica`` seeds the
+three fleet-specific corruptions the fuzz campaign must detect
+(fuzz/fleet.py): answering one tenant's query against another tenant's
+cloud, dropping a committed delta from the replication log, and promoting
+a stale replica without the re-ship.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...config import DOMAIN_SIZE, ServeFleetConfig
+from ...io import validate_request
+from ...utils.memory import InputContractError, InvalidConfigError
+from ..batching import Batch, Request
+from ..daemon import Response
+from .admission import DrrScheduler, TokenBucket
+from .tenants import Tenant, TenantSpec
+
+FLEET_FAULTS = ("cross-tenant", "drop-delta", "stale-replica")
+
+
+def _parse_fleet_fault() -> Optional[str]:
+    fault = os.environ.get("KNTPU_FLEET_FAULT", "")
+    if not fault:
+        return None
+    if fault not in FLEET_FAULTS:
+        raise InvalidConfigError(
+            f"unknown KNTPU_FLEET_FAULT {fault!r}: expected one of "
+            f"{FLEET_FAULTS}")
+    return fault
+
+
+def _rows_estimate(kind: str, payload) -> int:
+    """Best-effort admission cost (query/mutation rows) BEFORE validation;
+    malformed payloads cost one token and then refuse typed."""
+    if kind == "fof":
+        return 1
+    try:
+        return max(1, int(np.asarray(payload).shape[0]))
+    except Exception:  # noqa: BLE001 -- unparseable payloads refuse typed downstream; admission just needs a nonzero cost
+        return 1
+
+
+class FleetDaemon:
+    """Single-threaded fleet core: admit / poll / pump / drain.
+
+    Same injected-clock design as the single-tenant daemon: the event loop
+    lives in the caller (fleet loadgen, the stdio front end), so the
+    scheduling and fairness laws are unit-testable with synthetic time.
+    """
+
+    def __init__(self, builds: Sequence[Tuple[TenantSpec, np.ndarray]],
+                 config: Optional[ServeFleetConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config or ServeFleetConfig()
+        self.clock = clock
+        self.tenants: Dict[str, Tenant] = {}
+        self.quota: Dict[str, TokenBucket] = {}
+        self.drr = DrrScheduler(self.config.drr_quantum)
+        self.refused: Dict[str, int] = {}
+        self.served_rows: Dict[str, int] = {}
+        # recent-window batch accounting (bounded: the fleet is long-lived
+        # by design, a per-batch list would grow without bound) plus the
+        # forever counter the stats report
+        self.batch_log: Deque[dict] = deque(maxlen=4096)
+        self.n_batches = 0
+        self._fault = _parse_fleet_fault()
+        now = self.clock()
+        for spec, points in builds:
+            if spec.name in self.tenants:
+                raise InvalidConfigError(
+                    f"duplicate tenant name {spec.name!r} in the fleet "
+                    f"build list")
+            self.tenants[spec.name] = Tenant(spec, points, self.config,
+                                             self.clock)
+            self.quota[spec.name] = TokenBucket(
+                spec.quota_qps if spec.quota_qps is not None
+                else self.config.quota_qps,
+                spec.quota_burst if spec.quota_burst is not None
+                else self.config.quota_burst, now=now)
+            self.drr.register(spec.name)
+            self.refused[spec.name] = 0
+            self.served_rows[spec.name] = 0
+
+    # -- admission + routing --------------------------------------------------
+
+    def _refusal(self, req_id, tenant, e: InputContractError,
+                 now: float) -> List[Response]:
+        self.refused[tenant] = self.refused.get(tenant, 0) + 1
+        return [Response(req_id=req_id, ok=False, error=str(e),
+                         failure_kind=e.kind, arrived_at=now,
+                         completed_at=self.clock(), tenant=tenant)]
+
+    def submit(self, req_id: int, tenant: str, kind: str, payload,
+               k: Optional[int] = None,
+               now: Optional[float] = None) -> List[Response]:
+        """Admit one tenant-addressed request.  Query responses may
+        surface later (poll/pump) or now (size-trigger flush); sidecar
+        tenants, mutations, and FoF answer synchronously.  Responses from
+        OTHER requests whose batches this submission flushed ride along,
+        exactly like the single-tenant daemon."""
+        now = self.clock() if now is None else now
+        t = self.tenants.get(tenant)
+        quota_ok = None
+        if t is not None:
+            quota_ok = self.quota[tenant].try_take(
+                _rows_estimate(kind, payload), now)
+        try:
+            payload = validate_request(
+                kind, payload, k=k,
+                k_max=t.spec.k if t is not None else None,
+                n_current=t.n_points if t is not None else None,
+                max_batch=self._max_batch(t),
+                domain=self._domain(t),
+                tenant=tenant, tenants=tuple(self.tenants),
+                quota_ok=quota_ok)
+        except InputContractError as e:
+            return self._refusal(req_id, tenant, e, now)
+        if kind == "query" and self._fault == "cross-tenant" \
+                and len(self.tenants) > 1:
+            return self._cross_tenant_fault(req_id, tenant, payload, k, now)
+        if t.is_sidecar:
+            return self._submit_sidecar(req_id, t, kind, payload, k, now)
+        return self._submit_dense(req_id, t, kind, payload, k, now)
+
+    def _domain(self, t: Optional[Tenant]) -> float:
+        if t is None or t.is_sidecar or t.daemon is None:
+            return DOMAIN_SIZE
+        return float(t.daemon.overlay.base.grid.domain or DOMAIN_SIZE)
+
+    def _max_batch(self, t: Optional[Tenant]) -> int:
+        """The tenant's admittable query-batch cap.  Dense tenants refuse
+        at their SLO class's ladder depth -- their batcher's bucket_for
+        would raise (untyped) past it -- sidecar tenants at the
+        fleet-global cap."""
+        if t is None or t.is_sidecar or t.daemon is None:
+            return self.config.max_batch
+        return int(t.daemon.config.max_batch)
+
+    def _cross_tenant_fault(self, req_id, tenant, payload, k,
+                            now) -> List[Response]:
+        """Seeded fault: answer against the NEXT tenant's cloud while
+        stamping the requested tenant -- the isolation violation the fleet
+        fuzz campaign must catch."""
+        names = list(self.tenants)
+        other = self.tenants[names[(names.index(tenant) + 1) % len(names)]]
+        kq = min(int(k) if k else self.tenants[tenant].spec.k,
+                 other.spec.k)
+        if other.is_sidecar:
+            ids, d2 = other.sidecar.query(payload, kq)
+        else:
+            ids, d2 = other.daemon.overlay.query(payload, kq)
+        want_k = int(k) if k else self.tenants[tenant].spec.k
+        m = payload.shape[0]
+        out_i = np.full((m, want_k), -1, np.int32)
+        out_d = np.full((m, want_k), np.inf, np.float32)
+        kk = min(want_k, ids.shape[1])
+        out_i[:, :kk] = np.asarray(ids)[:, :kk]
+        out_d[:, :kk] = np.asarray(d2)[:, :kk]
+        return [Response(req_id=req_id, ok=True, ids=out_i, d2=out_d,
+                         arrived_at=now, completed_at=self.clock(),
+                         tenant=tenant)]
+
+    def _submit_sidecar(self, req_id, t: Tenant, kind, payload, k,
+                        now) -> List[Response]:
+        name = t.spec.name
+        if kind == "query":
+            kq = int(k) if k else t.spec.k
+            ids, d2 = t.sidecar.query(payload, kq)
+            self.served_rows[name] += payload.shape[0]
+            return [Response(req_id=req_id, ok=True, ids=ids, d2=d2,
+                             arrived_at=now, completed_at=self.clock(),
+                             tenant=name)]
+        if kind == "fof":
+            res = t.sidecar.fof(float(payload))
+            return [Response(req_id=req_id, ok=True,
+                             n_points=t.n_points, labels=res.labels,
+                             n_clusters=res.n_clusters, arrived_at=now,
+                             completed_at=self.clock(), tenant=name)]
+        if kind == "insert":
+            t.sidecar.insert(payload)
+        else:
+            t.sidecar.delete(payload)
+        t.maybe_promote_from_sidecar()
+        return [Response(req_id=req_id, ok=True, n_points=t.n_points,
+                         arrived_at=now, completed_at=self.clock(),
+                         tenant=name)]
+
+    def _submit_dense(self, req_id, t: Tenant, kind, payload, k,
+                      now) -> List[Response]:
+        name = t.spec.name
+        if kind == "query":
+            req = Request(req_id=req_id, queries=payload,
+                          k=int(k) if k else t.spec.k, arrived_at=now)
+            for batch in t.daemon.batcher.admit(req, now):
+                t.ready.append(batch)
+            return self.pump(now)
+        # mutation / fof barriers: THIS tenant's already-flushed batches
+        # execute first (they formed first -- per-tenant stream order),
+        # then its still-pending queries flush and execute through the
+        # fleet's own accounting (otherwise the daemon's internal barrier
+        # flush would run them outside batch_log/served_rows), then the
+        # daemon's barrier machinery runs the request with its containment
+        # law.  Other tenants are not barriered.
+        out = self._execute_ready(t)
+        pending = t.daemon.batcher.flush("barrier", now)
+        if pending is not None:
+            t.ready.append(pending)
+            out.extend(self._execute_ready(t))
+        responses = t.daemon.submit(req_id, kind, payload, k=k, now=now)
+        for r in responses:
+            r.tenant = name
+        out.extend(responses)
+        if kind in ("insert", "delete") and responses \
+                and responses[-1].ok:
+            t.commit_mutation(kind, payload,
+                              drop_from_log=self._fault == "drop-delta")
+        return out
+
+    # -- scheduling -----------------------------------------------------------
+
+    def _run_batch(self, t: Tenant, batch: Batch,
+                   accounting: Optional[dict] = None) -> List[Response]:
+        responses = t.daemon._execute(batch)
+        name = t.spec.name
+        for r in responses:
+            r.tenant = name
+            if r.ok and r.ids is not None:
+                self.served_rows[name] += r.ids.shape[0]
+        self.batch_log.append({
+            "tenant": name, "rows": batch.total,
+            "capacity": batch.capacity, "reason": batch.reason,
+            "slo": t.spec.slo,
+            **(accounting or {})})
+        self.n_batches += 1
+        return responses
+
+    def _execute_ready(self, t: Tenant) -> List[Response]:
+        """Drain ONE tenant's ready queue in FIFO order (the mutation
+        barrier path -- DRR does not reorder within a tenant anyway)."""
+        out: List[Response] = []
+        while t.ready:
+            out.extend(self._run_batch(t, t.ready.popleft(),
+                                       {"barrier": True}))
+        return out
+
+    def pump(self, now: Optional[float] = None) -> List[Response]:
+        """Execute every ready batch in deficit-round-robin order; each
+        dispatch's fairness accounting (deficit after, backlog snapshot)
+        is stamped into the per-batch stats."""
+        ready = {name: t.ready for name, t in self.tenants.items()
+                 if not t.is_sidecar}
+        out: List[Response] = []
+        for name, batch, disp in self.drr.select(ready):
+            out.extend(self._run_batch(
+                self.tenants[name], batch,
+                {"deficit_after": disp.deficit_after,
+                 "backlog": list(disp.backlog)}))
+        return out
+
+    def poll(self, now: Optional[float] = None) -> List[Response]:
+        """Deadline-trigger check across every dense tenant, then pump."""
+        now = self.clock() if now is None else now
+        for t in self.tenants.values():
+            if t.is_sidecar:
+                continue
+            batch = t.daemon.batcher.poll(now)
+            if batch is not None:
+                t.ready.append(batch)
+        return self.pump(now)
+
+    def drain(self, now: Optional[float] = None) -> List[Response]:
+        now = self.clock() if now is None else now
+        for t in self.tenants.values():
+            if t.is_sidecar:
+                continue
+            batch = t.daemon.batcher.flush("drain", now)
+            if batch is not None:
+                t.ready.append(batch)
+        return self.pump(now)
+
+    def next_deadline(self) -> Optional[float]:
+        deadlines = [t.daemon.next_deadline()
+                     for t in self.tenants.values() if not t.is_sidecar]
+        deadlines = [d for d in deadlines if d is not None]
+        return min(deadlines) if deadlines else None
+
+    # -- failover -------------------------------------------------------------
+
+    def failover(self, tenant: str) -> dict:
+        """Kill the named tenant's primary overlay state and promote its
+        most-caught-up replica (tenants.Tenant.failover; the seeded
+        stale-replica fault skips the re-ship)."""
+        return self.tenants[tenant].failover(
+            skip_reship=self._fault == "stale-replica")
+
+    # -- introspection --------------------------------------------------------
+
+    def stats_dict(self) -> dict:
+        from ...runtime import dispatch as _dispatch
+
+        return {
+            "tenants": {name: {**t.stats_dict(),
+                               **self.quota[name].stats_dict(),
+                               "refused": self.refused[name],
+                               "served_rows": self.served_rows[name]}
+                        for name, t in self.tenants.items()},
+            "fleet_batches": self.n_batches,
+            **self.drr.stats_dict(),
+            **_dispatch.EXEC_CACHE.stats_dict(),
+        }

@@ -1,0 +1,219 @@
+"""Multi-tenant open-loop load harness: the fleet's measurement surface.
+
+Same open-loop discipline as serve/loadgen.py (arrivals pre-scheduled by
+seeded Poisson processes, never gated on completions), extended across
+tenants: each tenant contributes its own arrival process and batch mix,
+the merged schedule drives the ONE front door, and the summary reports
+per-tenant latency percentiles, sustained QPS, refusals, the Jain
+fairness index over per-tenant completion ratios, SLO verdicts
+(p99 <= the tenant's class budget), and the fleet-wide recompile count --
+the numbers that become ``bench.py --serve`` fleet rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...config import DOMAIN_SIZE, SLO_CLASSES
+from ...runtime import dispatch as _dispatch
+from ..daemon import Response
+from ..loadgen import _percentiles
+from .admission import jain_index
+from .frontdoor import FleetDaemon
+from .tenants import TenantSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantLoad:
+    """One tenant's offered load (regenerable from the seed)."""
+
+    tenant: str
+    rate: float = 200.0
+    requests: int = 100
+    batch_mix: Tuple[Tuple[int, float], ...] = (
+        (1, 0.45), (4, 0.25), (16, 0.2), (64, 0.1))
+    mutation_ratio: float = 0.0
+    mutation_size: int = 8
+    k: Optional[int] = None
+    seed: int = 0
+
+
+def build_fleet_schedule(loads: List[TenantLoad],
+                         n_current: Dict[str, int],
+                         domain: float = DOMAIN_SIZE) -> List[dict]:
+    """The merged arrival-ordered schedule: [{t, tenant, kind, payload,
+    k}].  Per-tenant delete ids track that tenant's running cloud size, so
+    every scheduled mutation is legal at its arrival time (hostile streams
+    are the fuzz campaign's job)."""
+    out: List[dict] = []
+    for load in loads:
+        rng = np.random.default_rng(load.seed + 1)
+        arrivals = np.cumsum(np.random.default_rng(load.seed).exponential(
+            1.0 / max(load.rate, 1e-9), load.requests))
+        sizes = np.asarray([s for s, _ in load.batch_mix])
+        weights = np.asarray([w for _, w in load.batch_mix], np.float64)  # kntpu-ok: wide-dtype -- host-side sampling weights, never staged
+        weights = weights / weights.sum()
+        n = int(n_current[load.tenant])
+        for t in arrivals:
+            if load.mutation_ratio > 0 \
+                    and rng.random() < load.mutation_ratio:
+                if rng.random() < 0.5 or n <= load.mutation_size:
+                    pts = (rng.random((load.mutation_size, 3))
+                           * (domain * 0.98)
+                           + domain * 0.01).astype(np.float32)
+                    out.append({"t": float(t), "tenant": load.tenant,
+                                "kind": "insert", "payload": pts})
+                    n += load.mutation_size
+                else:
+                    ids = rng.choice(n, size=load.mutation_size,
+                                     replace=False)
+                    out.append({"t": float(t), "tenant": load.tenant,
+                                "kind": "delete",
+                                "payload": np.sort(ids).astype(np.int64)})  # kntpu-ok: wide-dtype -- host id payload, validated then used on host
+                    n -= load.mutation_size
+            else:
+                m = int(rng.choice(sizes, p=weights))
+                qs = (rng.random((m, 3)) * (domain * 0.98)
+                      + domain * 0.01).astype(np.float32)
+                out.append({"t": float(t), "tenant": load.tenant,
+                            "kind": "query", "payload": qs, "k": load.k})
+    out.sort(key=lambda item: item["t"])
+    return out
+
+
+def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
+                      clock=time.monotonic, sleep=time.sleep) -> dict:
+    """Drive one merged open-loop session; returns the fleet summary.
+
+    The recompile count is the fleet-wide ExecutableCache miss delta
+    across the measured window: every dense tenant warmed its buckets at
+    construction, so a mutation-free session must measure ZERO -- the
+    fleet steady-state law the __main__ --assert-steady gate and the
+    check.sh smoke enforce across >= 2 tenants at once."""
+    schedule = build_fleet_schedule(
+        loads, {name: t.n_points for name, t in fleet.tenants.items()},
+        domain=DOMAIN_SIZE)
+    cache0 = dict(_dispatch.EXEC_CACHE.stats_dict())
+    _dispatch.reset_stats()
+    responses: List[Response] = []
+    t0 = clock()
+    i = 0
+    pending = (lambda: any(t.ready or (not t.is_sidecar
+                                       and t.daemon.batcher.pending_queries)
+                           for t in fleet.tenants.values()))
+    while i < len(schedule) or pending():
+        now = clock()
+        if i < len(schedule) and t0 + schedule[i]["t"] <= now:
+            item = schedule[i]
+            i += 1
+            responses.extend(fleet.submit(
+                req_id=i, tenant=item["tenant"], kind=item["kind"],
+                payload=item["payload"], k=item.get("k"),
+                now=t0 + item["t"]))
+            continue
+        responses.extend(fleet.poll(now))
+        next_events = []
+        if i < len(schedule):
+            next_events.append(t0 + schedule[i]["t"])
+        deadline = fleet.next_deadline()
+        if deadline is not None:
+            next_events.append(deadline)
+        if not next_events:
+            break
+        wait = min(next_events) - clock()
+        if wait > 0:
+            sleep(min(wait, 0.005))
+    responses.extend(fleet.drain(clock()))
+    elapsed = max(clock() - t0, 1e-9)
+    cache1 = _dispatch.EXEC_CACHE.stats_dict()
+
+    per_tenant: Dict[str, dict] = {}
+    offered: Dict[str, int] = {load.tenant: 0 for load in loads}
+    for item in schedule:
+        if item["kind"] == "query":
+            offered[item["tenant"]] += item["payload"].shape[0]
+    completion = []
+    for load in loads:
+        name = load.tenant
+        mine = [r for r in responses if r.tenant == name]
+        ok_q = [r for r in mine if r.ok and r.ids is not None]
+        served = int(sum(r.ids.shape[0] for r in ok_q))
+        # percentiles over QUERY responses only: mutation acks are
+        # near-instant and would dilute the p99 the slo_ok gate checks
+        lat = [r.latency_s for r in ok_q]
+        slo = SLO_CLASSES[fleet.tenants[name].spec.slo]
+        pct = _percentiles(lat)
+        ratio = served / offered[name] if offered[name] else None
+        completion.append(ratio)
+        per_tenant[name] = {
+            "slo": slo.name,
+            "offered_rows": offered[name],
+            "served_rows": served,
+            "completion": (round(ratio, 6) if ratio is not None else None),
+            "refused": fleet.refused[name],
+            "failed": len([r for r in mine if not r.ok
+                           and r.failure_kind != "invalid-input"]),
+            "sustained_qps": round(served / elapsed, 1),
+            "sidecar": fleet.tenants[name].is_sidecar,
+            **pct,
+            "slo_p99_budget_ms": slo.p99_budget_ms,
+            "slo_ok": (pct["p99_ms"] is not None
+                       and pct["p99_ms"] <= slo.p99_budget_ms),
+        }
+    ok_all = [r for r in responses if r.ok and r.ids is not None]
+    total_served = int(sum(r.ids.shape[0] for r in ok_all))
+    occ = [b["rows"] / b["capacity"] for b in fleet.batch_log]
+    summary = {
+        "requests": len(schedule),
+        "responses": len(responses),
+        "completed_queries": total_served,
+        "failed_requests": len([r for r in responses if not r.ok
+                                and r.failure_kind != "invalid-input"]),
+        "refused_requests": int(sum(fleet.refused.values())),
+        "elapsed_s": round(elapsed, 4),
+        "sustained_qps": round(total_served / elapsed, 1),
+        "recompiles": int(cache1["exec_cache_misses"]
+                          - cache0["exec_cache_misses"]),
+        "exec_cache_enabled": _dispatch.EXEC_CACHE.enabled,
+        "occupancy_mean": (round(float(np.mean(occ)), 4) if occ else None),
+        "jain_fairness": jain_index(completion),
+        "n_tenants": len(fleet.tenants),
+        "slo_ok_all": all(per_tenant[n]["slo_ok"] or not offered[n]
+                          for n in per_tenant),
+        "per_tenant": per_tenant,
+        **{k: v for k, v in cache1.items()
+           if k != "exec_cache_disabled_by"},
+        **_dispatch.stats_dict(),
+        **{k: v for k, v in fleet.stats_dict().items()
+           if k not in ("tenants",)},
+    }
+    return summary
+
+
+def default_fleet_builds(n_tenants: int = 4, base_n: int = 6000,
+                         k: int = 8, seed: int = 0,
+                         sidecar_tenant: bool = True,
+                         replicas: int = 0):
+    """A mixed-SLO fleet build list for the smokes and bench rows:
+    tenants alternate latency/throughput classes; the LAST tenant (when
+    ``sidecar_tenant``) is tiny so it lands on the CPU sidecar; the first
+    two tenants share one cloud size so their executable signatures are
+    equal (the cross-tenant cache-sharing case is always present)."""
+    from ...io import generate_uniform
+
+    builds = []
+    for i in range(n_tenants):
+        tiny = sidecar_tenant and i == n_tenants - 1 and n_tenants > 1
+        n = 48 if tiny else base_n  # tenants 0 and 1 share a size
+        if not tiny and i >= 2:
+            n = base_n + 1024 * i
+        spec = TenantSpec(
+            name=f"t{i}", k=k,
+            slo="latency" if i % 2 == 0 else "throughput",
+            replicas=replicas if not tiny else 0)
+        builds.append((spec, generate_uniform(n, seed=seed + 17 * i)))
+    return builds

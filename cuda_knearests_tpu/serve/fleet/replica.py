@@ -1,0 +1,520 @@
+"""Replication + failover: the delta log as the fleet's durability story.
+
+The PR 6 delta overlay made mutations O(delta) and pinned overlay answers
+byte-identical to rebuild-from-scratch.  This module turns that same delta
+stream into a REPLICATION LOG (DESIGN.md section 17):
+
+* :class:`DeltaRecord` -- one committed mutation: a sequence number plus
+  the validated insert points / delete ids, exactly the payload
+  ``DeltaOverlay.insert``/``delete`` consumes.  Replicas apply records
+  through the SAME overlay machinery as the primary, so the byte-identity
+  pin (overlay == rebuild on the mutated cloud) transfers to replicas for
+  free -- there is no second apply path to diverge.
+* :class:`ReplicationLog` -- the authoritative ordered record of COMMITTED
+  mutations.  The commit law: a mutation is committed once the primary has
+  applied it AND its record is appended here; only committed mutations are
+  ever acked to the client.  Failover re-ships ``since(acked)`` from this
+  log, which is what makes "zero lost committed mutations" a structural
+  property rather than a race.
+* :class:`Replica` -- an in-process replica: its own ``DeltaOverlay`` over
+  the SHARED immutable base problem (prepare is not repeated; the overlay
+  is the only per-replica state), applying records strictly in sequence --
+  a gap or replay raises, never silently reorders.
+* :class:`ReplicaProcess` -- a replica in a CHILD PROCESS on the PR 2
+  supervisor transport (the framed one-line JSON protocol,
+  ``runtime.supervisor.RESULT_PREFIX``): ``python -m
+  cuda_knearests_tpu.serve.fleet.replica <spec.npz>`` builds the problem
+  from a banked spec and serves apply/query/seq/promote over stdio.
+* :class:`FailoverController` -- primary + replicas as ReplicaProcess
+  children.  Mutations commit through the primary, then ship to every
+  replica (per-replica acked sequence tracked).  ``kill_primary()`` is a
+  real SIGKILL; ``failover()`` promotes the most-caught-up replica after
+  re-shipping its log tail.  ``expected_points()`` replays the log on the
+  host (same np.delete/np.concatenate canonical indexing as the overlay),
+  so callers can machine-check both halves of the failover law: the
+  promoted replica's cloud equals the committed log's cloud exactly, and
+  its query answers are byte-identical to a rebuild oracle on it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import select
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...runtime.supervisor import _REPO_ROOT, RESULT_PREFIX
+from ...utils.memory import TransportError
+
+
+@dataclasses.dataclass(frozen=True)
+class DeltaRecord:
+    """One committed mutation of one tenant's cloud."""
+
+    seq: int                  # 1-based, dense: record i has seq == i + 1
+    kind: str                 # 'insert' | 'delete'
+    payload: np.ndarray       # (m, 3) f32 points | (m,) int ids
+
+    def to_json(self) -> dict:
+        return {"seq": self.seq, "kind": self.kind,
+                "payload": np.asarray(self.payload).tolist()}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "DeltaRecord":
+        dtype = np.float32 if d["kind"] == "insert" else np.int64  # kntpu-ok: wide-dtype -- host id payload, validated then used on host
+        return cls(seq=int(d["seq"]), kind=str(d["kind"]),
+                   payload=np.asarray(d["payload"], dtype))
+
+
+class ReplicationLog:
+    """The ordered committed-mutation record (one per tenant)."""
+
+    def __init__(self) -> None:
+        self.records: List[DeltaRecord] = []
+
+    @property
+    def committed_seq(self) -> int:
+        return len(self.records)
+
+    def append(self, kind: str, payload: np.ndarray) -> DeltaRecord:
+        rec = DeltaRecord(seq=self.committed_seq + 1, kind=kind,
+                          payload=np.asarray(payload))
+        self.records.append(rec)
+        return rec
+
+    def since(self, seq: int) -> List[DeltaRecord]:
+        """Records with sequence number > ``seq`` (the re-ship tail)."""
+        return self.records[max(0, int(seq)):]
+
+
+def replay_on_host(points: np.ndarray,
+                   records: List[DeltaRecord]) -> np.ndarray:
+    """The committed log's cloud, replayed with the overlay's canonical
+    indexing (np.delete + np.concatenate) -- the zero-lost-mutations
+    oracle."""
+    out = np.ascontiguousarray(points, np.float32).reshape(-1, 3)
+    for rec in records:
+        if rec.kind == "insert":
+            out = np.concatenate(
+                [out, np.asarray(rec.payload, np.float32).reshape(-1, 3)])  # kntpu-ok: host-sync-loop -- DeltaRecord payloads are host numpy by construction, no device array rides this loop
+        else:
+            out = np.delete(out, np.asarray(rec.payload).reshape(-1), axis=0)  # kntpu-ok: host-sync-loop -- DeltaRecord payloads are host numpy by construction, no device array rides this loop
+    return np.ascontiguousarray(out, dtype=np.float32)
+
+
+class Replica:
+    """In-process replica: one DeltaOverlay applying records in sequence."""
+
+    def __init__(self, problem, compact_threshold: int = 512):
+        from ..delta import DeltaOverlay
+
+        self.overlay = DeltaOverlay(problem,
+                                    compact_threshold=compact_threshold)
+        self.applied_seq = 0
+
+    def apply(self, record: DeltaRecord) -> int:
+        """Apply one record; strict sequencing (a gap means the shipper
+        lost a committed delta -- corrupting silently is the one
+        unacceptable outcome)."""
+        if record.seq != self.applied_seq + 1:
+            raise RuntimeError(
+                f"replication sequence gap: replica at seq "
+                f"{self.applied_seq}, record carries seq {record.seq} "
+                f"(committed deltas must apply densely in order)")
+        if record.kind == "insert":
+            self.overlay.insert(np.asarray(record.payload, np.float32))
+        else:
+            self.overlay.delete(np.asarray(record.payload))
+        self.applied_seq = record.seq
+        return self.applied_seq
+
+    def query(self, queries: np.ndarray, k: int):
+        return self.overlay.query(np.asarray(queries, np.float32), k)
+
+
+# -- the child-process replica (PR 2 framed-JSON transport) -------------------
+
+def _encode_rows(ids: np.ndarray, d2: np.ndarray) -> Tuple[list, list]:
+    """Wire form of result rows: pad slots (id -1) carry d2 null -- the
+    same RFC 8259 discipline as serve Response.to_wire."""
+    return (np.asarray(ids).tolist(),
+            [[float(v) if np.isfinite(v) else None for v in row]
+             for row in np.asarray(d2)])
+
+
+def _decode_d2(rows: list) -> np.ndarray:
+    arr = np.asarray([[np.inf if v is None else v for v in row]
+                      for row in rows], np.float32)
+    return arr.reshape(len(rows), -1) if rows else arr.reshape(0, 0)
+
+
+class ReplicaProcess:
+    """Parent-side handle of one replica child process.
+
+    The transport is the supervisor's framed protocol: one JSON request
+    line down stdin, one ``RESULT_PREFIX``-framed JSON reply line up
+    stdout (library chatter on stdout can never be mistaken for a reply).
+    A dead or wedged child surfaces as :class:`TransportError` -- the
+    taxonomy kind ('transport') the failover path keys on.
+    """
+
+    def __init__(self, spec_path: str, timeout_s: float = 120.0):
+        self.spec_path = spec_path
+        self.timeout_s = float(timeout_s)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "cuda_knearests_tpu.serve.fleet.replica",
+             spec_path],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env)
+        self._buf = ""      # our own stdout line buffer (see _recv)
+        self.acked_seq = 0
+        self.promoted = False
+        ready = self._recv()          # startup handshake
+        self.n_points = int(ready.get("n_points", 0))
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def _recv(self) -> dict:
+        """Read the next RESULT_PREFIX frame.  Reads raw chunks off the
+        pipe fd into OUR line buffer (never the TextIOWrapper's readline:
+        a frame that arrived in the same chunk as library chatter would
+        sit invisibly in Python's stdio buffer while select() blocks on
+        an empty OS pipe -- a false 'wedged child').  timeout_s <= 0
+        waits indefinitely."""
+        deadline = (None if self.timeout_s <= 0
+                    else time.monotonic() + self.timeout_s)
+        fd = self.proc.stdout.fileno()
+        while True:
+            while "\n" in self._buf:
+                line, self._buf = self._buf.split("\n", 1)
+                if not line.startswith(RESULT_PREFIX):
+                    continue          # library chatter on stdout
+                frame = json.loads(line[len(RESULT_PREFIX):])
+                if not frame.get("ok", False):
+                    raise TransportError(
+                        f"replica pid {self.pid} error frame: "
+                        f"{frame.get('error')}")
+                return frame
+            wait = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            ready, _, _ = select.select([fd], [], [], wait)
+            if not ready:
+                raise TransportError(
+                    f"replica pid {self.pid}: no reply within "
+                    f"{self.timeout_s:.0f}s (wedged child)")
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                raise TransportError(
+                    f"replica pid {self.pid}: stdout closed "
+                    f"(child exited rc {self.proc.poll()})")
+            self._buf += chunk.decode("utf-8", errors="replace")
+
+    def _call(self, req: dict) -> dict:
+        try:
+            self.proc.stdin.write(json.dumps(req) + "\n")
+            self.proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            raise TransportError(
+                f"replica pid {self.pid}: send failed ({e}) -- "
+                f"child dead") from e
+        return self._recv()
+
+    def apply(self, record: DeltaRecord) -> int:
+        frame = self._call({"op": "apply", **record.to_json()})
+        self.acked_seq = int(frame["seq"])
+        return self.acked_seq
+
+    def query(self, queries: np.ndarray, k: int):
+        frame = self._call({"op": "query",
+                            "queries": np.asarray(queries,
+                                                  np.float32).tolist(),
+                            "k": int(k)})
+        ids = np.asarray(frame["ids"], np.int32).reshape(
+            len(frame["ids"]), -1)
+        return ids, _decode_d2(frame["d2"])
+
+    def seq(self) -> int:
+        return int(self._call({"op": "seq"})["seq"])
+
+    def promote(self) -> None:
+        self._call({"op": "promote"})
+        self.promoted = True
+
+    def kill(self) -> None:
+        if self.alive:
+            os.kill(self.pid, signal.SIGKILL)
+        self.proc.wait()
+
+    def close(self) -> None:
+        if self.alive:
+            try:
+                self.proc.stdin.write(json.dumps({"op": "shutdown"}) + "\n")
+                self.proc.stdin.flush()
+                self.proc.wait(timeout=10)
+            except (BrokenPipeError, OSError,
+                    subprocess.TimeoutExpired):
+                self.proc.kill()
+                self.proc.wait()
+
+
+def bank_replica_spec(points: np.ndarray, k: int,
+                      compact_threshold: int = 512,
+                      path: Optional[str] = None) -> str:
+    """Write the replica-process bootstrap spec (the base cloud + config)
+    to an .npz the child rebuilds its problem from."""
+    if path is None:
+        fd, path = tempfile.mkstemp(prefix="kntpu-replica-", suffix=".npz")
+        os.close(fd)
+    np.savez_compressed(path,
+                        points=np.asarray(points, np.float32),
+                        k=np.int32(k),
+                        compact_threshold=np.int32(compact_threshold))
+    return path
+
+
+class FailoverController:
+    """Primary + N replicas as child processes; the failover protocol.
+
+    One controller serves one tenant's replicated stream.  Mutations
+    commit through the primary (apply + ack) before the record enters the
+    log and ships to replicas; queries route to the primary.  On primary
+    death (detected as TransportError, or forced by :meth:`kill_primary`'s
+    real SIGKILL), :meth:`failover` promotes the replica with the highest
+    acked sequence after re-shipping its tail from the log -- so every
+    COMMITTED mutation survives, and an in-flight uncommitted one was
+    never acked to the caller (retry-after-failover is the client
+    contract, exactly once-committed)."""
+
+    def __init__(self, points: np.ndarray, k: int, n_replicas: int = 1,
+                 compact_threshold: int = 512, timeout_s: float = 120.0):
+        self.initial_points = np.ascontiguousarray(points, np.float32)
+        self.k = int(k)
+        self.log = ReplicationLog()
+        self.spec_path = bank_replica_spec(points, k, compact_threshold)
+        self.procs = [ReplicaProcess(self.spec_path, timeout_s=timeout_s)
+                      for _ in range(1 + max(0, int(n_replicas)))]
+        self.primary = self.procs[0]
+        self.primary.promote()
+        self.failovers = 0
+
+    @property
+    def replicas(self) -> List[ReplicaProcess]:
+        return [p for p in self.procs if p is not self.primary]
+
+    def mutate(self, kind: str, payload: np.ndarray) -> DeltaRecord:
+        """One committed mutation: primary applies (ack = commit point),
+        the record enters the log, then ships to every live replica."""
+        rec = DeltaRecord(seq=self.log.committed_seq + 1, kind=kind,
+                          payload=np.asarray(payload))
+        self.primary.apply(rec)          # raises TransportError if dead
+        self.log.records.append(rec)     # COMMIT
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            try:
+                rep.apply(rec)
+            except TransportError:
+                pass  # a dead replica just stops being a failover target
+        return rec
+
+    def query(self, queries: np.ndarray, k: Optional[int] = None):
+        return self.primary.query(queries, self.k if k is None else k)
+
+    def kill_primary(self) -> int:
+        """A real SIGKILL -- the bench failover scenario's hammer."""
+        pid = self.primary.pid
+        self.primary.kill()
+        return pid
+
+    def failover(self) -> Dict[str, int]:
+        """Promote the most-caught-up replica: re-ship its committed tail,
+        then route to it.  Raises TransportError when no live replica
+        remains (total fleet loss is not silently absorbed)."""
+        live = [p for p in self.replicas if p.alive]
+        if not live:
+            raise TransportError(
+                "failover impossible: no live replica (committed log "
+                f"retains {self.log.committed_seq} mutation(s) for a "
+                f"future replica)")
+        target = max(live, key=lambda p: p.acked_seq)
+        replayed = 0
+        for rec in self.log.since(target.acked_seq):
+            target.apply(rec)
+            replayed += 1
+        target.promote()
+        self.primary = target
+        self.failovers += 1
+        return {"promoted_pid": target.pid, "replayed": replayed,
+                "committed_seq": self.log.committed_seq}
+
+    def expected_points(self) -> np.ndarray:
+        """The committed log's cloud (host replay) -- what the promoted
+        primary must hold exactly."""
+        return replay_on_host(self.initial_points, self.log.records)
+
+    def close(self) -> None:
+        for p in self.procs:
+            p.close()
+        try:
+            os.unlink(self.spec_path)
+        except OSError:
+            pass
+
+
+def failover_drill(n: int = 1500, k: int = 8, ops: int = 24,
+                   seed: int = 0, log=None) -> dict:
+    """The process-level failover proof, as one machine-checkable summary
+    (shared by ``python -m cuda_knearests_tpu.serve.fleet
+    --failover-smoke`` and the ``fleet_failover`` bench row).
+
+    A primary and one replica run as real child processes; a seeded
+    mutation+query stream commits through the primary; mid-stream the
+    primary takes a genuine SIGKILL; the controller fails over and the
+    stream finishes.  ``failover_ok`` requires (a) >= 1 failover happened,
+    (b) ZERO lost committed mutations -- the promoted replica's applied
+    sequence and cloud size equal the committed log's host replay exactly
+    -- and (c) post-failover query results BYTE-IDENTICAL to a
+    rebuild-from-scratch oracle on that cloud."""
+    from ... import KnnConfig, KnnProblem
+    from ...io import generate_uniform
+
+    log = log or (lambda s: None)
+    rng = np.random.default_rng(seed)
+    points = generate_uniform(n, seed=seed)
+    ctl = FailoverController(points, k, n_replicas=1)
+    killed_at = None
+    killed_pid = None
+    commits_acked = 0
+    try:
+        for i in range(ops):
+            if i == ops // 2:
+                killed_pid = ctl.kill_primary()
+                killed_at = i
+            roll = rng.random()
+            try:
+                if roll < 0.5:
+                    pts = (rng.random((4, 3)) * 980.0 + 10.0
+                           ).astype(np.float32)
+                    ctl.mutate("insert", pts)
+                    commits_acked += 1
+                elif roll < 0.7 and ctl.log.committed_seq:
+                    n_now = ctl.expected_points().shape[0]
+                    if n_now > 4:
+                        ids = np.sort(rng.choice(n_now, size=2,
+                                                 replace=False))
+                        ctl.mutate("delete", ids.astype(np.int64))  # kntpu-ok: wide-dtype -- host id payload
+                        commits_acked += 1
+                else:
+                    qs = (rng.random((8, 3)) * 980.0 + 10.0
+                          ).astype(np.float32)
+                    ctl.query(qs)
+            except TransportError:
+                # the dead primary surfaces here; the op was never
+                # committed (no ack), so failing over and moving on loses
+                # nothing the client was promised
+                info = ctl.failover()
+                log(f"failover: {info}")
+        expected = ctl.expected_points()
+        state = ctl.primary._call({"op": "seq"})
+        probe = (np.random.default_rng(seed + 9).random((32, 3))
+                 * 980.0 + 10.0).astype(np.float32)
+        got_i, got_d = ctl.query(probe)
+        oracle = KnnProblem.prepare(expected,
+                                    KnnConfig(k=k, adaptive=False))
+        ref_i, ref_d = oracle.query(probe, k)
+        zero_lost = (int(state["seq"]) == ctl.log.committed_seq
+                     and int(state["n_points"]) == expected.shape[0])
+        byte_identical = (np.array_equal(got_i, np.asarray(ref_i))
+                          and np.array_equal(
+                              got_d, np.asarray(ref_d, np.float32)))
+        return {
+            "n_points0": n, "k": k, "ops": ops, "seed": seed,
+            "killed_at_op": killed_at, "killed_pid": killed_pid,
+            "failovers": ctl.failovers,
+            "committed_mutations": ctl.log.committed_seq,
+            "commits_acked": commits_acked,
+            "zero_lost_committed": bool(zero_lost),
+            "post_failover_byte_identical": bool(byte_identical),
+            "failover_ok": bool(zero_lost and byte_identical
+                                and ctl.failovers >= 1),
+        }
+    finally:
+        ctl.close()
+
+
+# -- child entry: python -m cuda_knearests_tpu.serve.fleet.replica <spec> ----
+
+def _child_emit(obj: dict) -> None:
+    print(RESULT_PREFIX + json.dumps(obj), flush=True)
+
+
+def _child_main(argv) -> int:
+    """The replica worker loop (runs in the CHILD process only)."""
+    from ...utils.platform import enable_compile_cache, honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    enable_compile_cache()
+
+    from ... import KnnConfig, KnnProblem
+
+    with np.load(argv[0]) as z:
+        points = np.asarray(z["points"], np.float32)
+        k = int(z["k"])
+        compact_threshold = int(z["compact_threshold"])
+    problem = KnnProblem.prepare(points, KnnConfig(k=k, adaptive=False))
+    replica = Replica(problem, compact_threshold=compact_threshold)
+    _child_emit({"ok": True, "ready": True, "n_points": points.shape[0]})
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+            op = req.get("op")
+            if op == "shutdown":
+                _child_emit({"ok": True, "seq": replica.applied_seq})
+                return 0
+            if op == "apply":
+                seq = replica.apply(DeltaRecord.from_json(req))
+                _child_emit({"ok": True, "seq": seq,
+                             "n_points": replica.overlay.n_points})
+            elif op == "query":
+                ids, d2 = replica.query(
+                    np.asarray(req["queries"], np.float32),  # kntpu-ok: host-sync-loop -- JSON-decoded wire payload (host list), no device array rides this loop
+                    int(req.get("k") or k))
+                wire_ids, wire_d2 = _encode_rows(ids, d2)
+                _child_emit({"ok": True, "ids": wire_ids, "d2": wire_d2,
+                             "seq": replica.applied_seq})
+            elif op == "seq":
+                _child_emit({"ok": True, "seq": replica.applied_seq,
+                             "n_points": replica.overlay.n_points})
+            elif op == "promote":
+                _child_emit({"ok": True, "seq": replica.applied_seq})
+            else:
+                _child_emit({"ok": False,
+                             "error": f"unknown replica op {op!r}"})
+        except Exception as e:  # noqa: BLE001 -- the transport contract: any per-op failure becomes one typed error frame, the replica loop survives
+            _child_emit({"ok": False,
+                         "error": f"{type(e).__name__}: {e}"})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_child_main(sys.argv[1:]))

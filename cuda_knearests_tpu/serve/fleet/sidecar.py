@@ -1,0 +1,133 @@
+"""The brute CPU sidecar tier: tiny/degenerate tenants off the dense ladder.
+
+"Hybrid KNN-Join" (arXiv 1810.04758) splits work between the accelerator
+and the CPU by density; the fleet applies the same split by TENANT: a
+tenant whose cloud is under ``ServeFleetConfig.sidecar_threshold`` (or
+degenerate, n < k) is served by this pure-host brute worker instead of the
+dense batching ladder.  What that buys the fleet:
+
+* **No executable signatures.**  A 40-point tenant would otherwise mint
+  its own prepare plan and per-bucket launch signatures -- cache entries
+  that evict the dense tenants' hot executables while serving microscopic
+  work.  The sidecar touches neither the ExecutableCache nor the dispatch
+  layer (the ``fleet-sidecar`` syncflow window proves host_syncs = 0).
+* **No batching latency.**  Tiny tenants answer synchronously at
+  admission; the bucket ladder, deadline triggers, and DRR scheduling all
+  apply only to tenants whose work is worth batching.
+
+Semantics match the dense path's contracts: canonical CURRENT ids with
+``np.delete``/``np.concatenate`` mutation indexing (the same rebuild-oracle
+indexing as serve/delta.py), -1/inf row padding beyond the available
+neighbors, ascending distances with lower-id tie-break, f32 'diff'
+arithmetic.  Distances are host-numpy f32, NOT the XLA launch's bits --
+the sidecar's answers are exact under the TIE-AWARE comparison contract
+(fuzz/compare.check_route_result), which is the fleet fuzz oracle's
+discipline; byte-identity to XLA is a dense-path promise only
+(DESIGN.md section 17).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+from ...oracle import UnionFind
+
+
+@dataclasses.dataclass
+class SidecarFof:
+    """FoF answer over a sidecar tenant's cloud (daemon-compatible shape)."""
+
+    labels: np.ndarray
+    n_clusters: int
+
+
+class CpuSidecar:
+    """One tiny tenant's serving state: a host point array + brute answers.
+
+    Thread-unsafe by design, same as the dense overlay (the fleet front
+    door is single-threaded).
+    """
+
+    def __init__(self, points: np.ndarray, k: int):
+        self.points = np.ascontiguousarray(points, np.float32).reshape(-1, 3)
+        self.k_serve = int(k)
+        self.queries_served = 0
+        self.inserts = 0
+        self.deletes = 0
+
+    # -- state ----------------------------------------------------------------
+
+    @property
+    def n_points(self) -> int:
+        return int(self.points.shape[0])
+
+    def mutated_points(self) -> np.ndarray:
+        """The current cloud in canonical order (the rebuild oracle's
+        input) -- the sidecar stores exactly that order, so this is the
+        identity view."""
+        return self.points
+
+    # -- mutations (np.delete / np.concatenate canonical indexing) -----------
+
+    def insert(self, points: np.ndarray) -> None:
+        pts = np.asarray(points, np.float32).reshape(-1, 3)
+        if pts.shape[0]:
+            self.points = np.ascontiguousarray(
+                np.concatenate([self.points, pts]))
+            self.inserts += pts.shape[0]
+
+    def delete(self, ids: np.ndarray) -> None:
+        ids = np.asarray(ids).reshape(-1)
+        if ids.size:
+            self.points = np.ascontiguousarray(
+                np.delete(self.points, ids, axis=0))
+            self.deletes += ids.size
+
+    # -- queries --------------------------------------------------------------
+
+    def query(self, queries: np.ndarray, k: int) \
+            -> Tuple[np.ndarray, np.ndarray]:
+        """Exact brute kNN rows: (m, k) i32 ids (-1 pads) and (m, k) f32 d2
+        ascending (inf pads), lower-id tie-break -- the dense path's row
+        contract, computed entirely on the host."""
+        queries = np.asarray(queries, np.float32).reshape(-1, 3)
+        m, n = queries.shape[0], self.n_points
+        ids = np.full((m, k), -1, np.int32)
+        d2 = np.full((m, k), np.inf, np.float32)
+        self.queries_served += m
+        if m == 0 or n == 0:
+            return ids, d2
+        diff = queries[:, None, :] - self.points[None, :, :]
+        dd = (diff * diff).sum(axis=2)
+        kk = min(k, n)
+        # stable sort on distance keeps storage order within ties -> the
+        # lower-id tie-break of serve/delta._merge_rows for free
+        order = np.argsort(dd, axis=1, kind="stable")[:, :kk]
+        ids[:, :kk] = order.astype(np.int32)
+        d2[:, :kk] = np.take_along_axis(dd, order, axis=1)
+        return ids, d2
+
+    def fof(self, b: float) -> SidecarFof:
+        """Friends-of-friends labels under the engine's f32 'diff' edge
+        predicate (d2_f32 <= f32(b)^2), canonical min-member-id labels --
+        the same canonicalization contract as cluster/fof.py, via the
+        oracle's host union-find."""
+        n = self.n_points
+        uf = UnionFind(n)
+        b2 = np.float32(b) * np.float32(b)
+        for i in range(n - 1):
+            diff = self.points[i + 1:] - self.points[i]
+            dd = (diff * diff).sum(axis=1)
+            for j in np.nonzero(dd <= b2)[0]:
+                uf.union(i, i + 1 + int(j))
+        labels = uf.canonical_labels()
+        return SidecarFof(labels=labels,
+                          n_clusters=int(np.unique(labels).size) if n else 0)
+
+    def stats_dict(self) -> dict:
+        return {"sidecar": True, "n_points": self.n_points,
+                "queries_served": self.queries_served,
+                "inserts": self.inserts, "deletes": self.deletes}

@@ -1,0 +1,231 @@
+"""Tenant model: one prepared index + serving state per named tenant.
+
+A TENANT is the fleet's unit of isolation and accounting (DESIGN.md
+section 17): its own point cloud, its own serving k, its own SLO class,
+quota, and replication factor -- multiplexed with every other tenant onto
+ONE process (shared capacity-bucket ladder, shared ExecutableCache,
+shared DRR scheduler).  Two placements:
+
+* **Dense** (default): a prepared ``KnnProblem`` behind the PR 6
+  ``ServeDaemon`` (mutation overlay + dynamic batcher + containment),
+  whose ServeConfig is derived from the tenant's SLO class on the fleet's
+  shared ladder (config.ServeFleetConfig.serve_config_for).  Because the
+  executable-cache key is a pure shape census (problem signature x bucket
+  x k), tenants with equal signatures share compiled launches: the second
+  such tenant's warmup takes ZERO new compiles (tests/test_fleet.py).
+* **Sidecar**: clouds under ``sidecar_threshold`` (or degenerate, n < k)
+  serve from the brute CPU worker (serve/fleet/sidecar.py) -- no
+  executables, no batching, synchronous answers.  A sidecar tenant whose
+  cloud GROWS past the threshold promotes to a dense placement at the
+  mutation that crossed it (one prepare, the same cloud).
+
+Replication (dense tenants with ``replicas > 0``): committed mutations
+append to the tenant's :class:`~.replica.ReplicationLog` and ship to
+in-process :class:`~.replica.Replica` overlays over the SAME base problem.
+``ship_mode='sync'`` applies each record as it commits;
+``'lazy'`` defers everything to failover's re-ship -- both end at the same
+byte-identical state, and the fuzz campaign drives both.  ``failover()``
+promotes the most-caught-up replica (re-shipping its committed tail) into
+the primary slot; the daemon's FoF memo is invalidated because the
+overlay identity changed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from ...api import KnnProblem
+from ...config import (SLO_CLASSES, KnnConfig, ServeFleetConfig, SloClass)
+from ...utils.memory import InvalidConfigError, TransportError
+from ..daemon import ServeDaemon
+from .replica import Replica, ReplicationLog
+from .sidecar import CpuSidecar
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """Regenerable identity of one tenant (the fuzz/bench spec unit).
+
+    Attributes:
+      name: wire name (the request 'tenant' field).
+      k: the tenant's serving k (per-request k <= k truncates columns).
+      slo: SLO class name (config.SLO_CLASSES): 'latency' | 'throughput'.
+      quota_qps / quota_burst: token-bucket admission overrides (None ->
+        the fleet defaults; quota_qps None there = unmetered).
+      replicas: in-process replica count (0 = unreplicated).
+      ship_mode: 'sync' ships each committed record immediately; 'lazy'
+        defers to failover's re-ship (both converge; fuzz drives both).
+    """
+
+    name: str
+    k: int = 10
+    slo: str = "throughput"
+    quota_qps: Optional[float] = None
+    quota_burst: Optional[float] = None
+    replicas: int = 0
+    ship_mode: str = "sync"
+
+    def __post_init__(self):
+        if self.slo not in SLO_CLASSES:
+            raise InvalidConfigError(
+                f"tenant {self.name!r}: unknown SLO class {self.slo!r} "
+                f"(expected one of {tuple(SLO_CLASSES)})")
+        if self.ship_mode not in ("sync", "lazy"):
+            raise InvalidConfigError(
+                f"tenant {self.name!r}: unknown ship_mode "
+                f"{self.ship_mode!r} (expected 'sync' or 'lazy')")
+        if self.k < 1:
+            raise InvalidConfigError(
+                f"tenant {self.name!r}: serving k must be >= 1, "
+                f"got {self.k}")
+
+    @property
+    def slo_class(self) -> SloClass:
+        return SLO_CLASSES[self.slo]
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "TenantSpec":
+        return cls(**d)
+
+
+class Tenant:
+    """One tenant's runtime state inside the fleet front door."""
+
+    def __init__(self, spec: TenantSpec, points: np.ndarray,
+                 fleet: ServeFleetConfig, clock):
+        self.spec = spec
+        self.fleet = fleet
+        self.clock = clock
+        self.ready: "Deque" = deque()    # flushed batches awaiting DRR
+        self.daemon: Optional[ServeDaemon] = None
+        self.sidecar: Optional[CpuSidecar] = None
+        self.log: Optional[ReplicationLog] = None
+        self.replica_pool: List[Replica] = []
+        self.promotions = 0
+        self.failovers = 0
+        points = np.ascontiguousarray(points, np.float32).reshape(-1, 3)
+        if self._wants_sidecar(points.shape[0]):
+            self.sidecar = CpuSidecar(points, spec.k)
+        else:
+            self._build_dense(points)
+
+    # -- placement ------------------------------------------------------------
+
+    def _wants_sidecar(self, n: int) -> bool:
+        return n < self.fleet.sidecar_threshold or n < self.spec.k
+
+    def _build_dense(self, points: np.ndarray) -> None:
+        problem = KnnProblem.prepare(
+            points, KnnConfig(k=self.spec.k, adaptive=False))
+        self.daemon = ServeDaemon(
+            problem, self.fleet.serve_config_for(self.spec.slo_class),
+            clock=self.clock)
+        if self.spec.replicas > 0:
+            self.log = ReplicationLog()
+            self.replica_pool = [
+                Replica(problem,
+                        compact_threshold=self.fleet.compact_threshold)
+                for _ in range(self.spec.replicas)]
+
+    def maybe_promote_from_sidecar(self) -> bool:
+        """Promote a grown sidecar tenant to a dense placement (one
+        prepare of the same cloud; canonical ids are preserved because
+        both placements use the identical np.delete/concatenate
+        indexing).  Returns True when a promotion happened."""
+        if self.sidecar is None or self._wants_sidecar(
+                self.sidecar.n_points):
+            return False
+        points = self.sidecar.mutated_points()
+        self.sidecar = None
+        self._build_dense(points)
+        self.promotions += 1
+        return True
+
+    # -- state ----------------------------------------------------------------
+
+    @property
+    def is_sidecar(self) -> bool:
+        return self.sidecar is not None
+
+    @property
+    def n_points(self) -> int:
+        if self.sidecar is not None:
+            return self.sidecar.n_points
+        return self.daemon.overlay.n_points
+
+    def mutated_points(self) -> np.ndarray:
+        """The tenant's CURRENT cloud in canonical order (the per-tenant
+        rebuild oracle's input)."""
+        if self.sidecar is not None:
+            return self.sidecar.mutated_points()
+        return self.daemon.overlay.mutated_points()
+
+    # -- replication ----------------------------------------------------------
+
+    def commit_mutation(self, kind: str, payload, *,
+                        drop_from_log: bool = False) -> None:
+        """Record one mutation the primary ALREADY applied successfully.
+        The record enters the log (the commit), then ships to replicas
+        under ship_mode='sync'.  ``drop_from_log`` is the seeded
+        drop-delta fault's hook (fuzz/fleet.py): a committed delta that
+        never reaches the log is exactly the corruption the campaign must
+        detect."""
+        if self.log is None:
+            return
+        if drop_from_log:
+            return
+        rec = self.log.append(kind, np.asarray(payload))
+        if self.spec.ship_mode == "sync":
+            for rep in self.replica_pool:
+                rep.apply(rec)
+
+    def failover(self, *, skip_reship: bool = False) -> dict:
+        """Kill the primary overlay and promote the most-caught-up
+        replica: re-ship its committed tail from the log, swap its overlay
+        into the daemon, invalidate the FoF memo (the overlay identity
+        changed).  ``skip_reship`` is the seeded stale-replica fault's
+        hook.  Raises TransportError when the tenant has no replica to
+        promote."""
+        if self.daemon is None or not self.replica_pool:
+            raise TransportError(
+                f"tenant {self.spec.name!r}: failover impossible "
+                f"(replicas={len(self.replica_pool)})")
+        target = max(self.replica_pool, key=lambda r: r.applied_seq)
+        replayed = 0
+        if not skip_reship:
+            for rec in self.log.since(target.applied_seq):
+                target.apply(rec)
+                replayed += 1
+        self.replica_pool.remove(target)
+        self.daemon.overlay = target.overlay
+        self.daemon.invalidate_fof_memo()   # memo keyed on the old overlay
+        self.failovers += 1
+        return {"tenant": self.spec.name, "replayed": replayed,
+                "committed_seq": self.log.committed_seq,
+                "remaining_replicas": len(self.replica_pool)}
+
+    # -- introspection --------------------------------------------------------
+
+    def stats_dict(self) -> dict:
+        base = {"slo": self.spec.slo, "k": self.spec.k,
+                "n_points": self.n_points,
+                "replicas": len(self.replica_pool),
+                "committed_seq": (self.log.committed_seq
+                                  if self.log is not None else 0),
+                "failovers": self.failovers,
+                "promotions": self.promotions}
+        if self.sidecar is not None:
+            base.update(self.sidecar.stats_dict())
+        else:
+            base["sidecar"] = False
+            base["batches"] = self.daemon.batches_executed
+            base["failed_batches"] = self.daemon.failed_batches
+            base["occupancies"] = len(self.daemon.occupancies)
+        return base

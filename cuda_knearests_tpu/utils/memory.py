@@ -144,6 +144,22 @@ class InvalidRequestError(InputContractError):
     DESIGN.md section 13) instead of letting it crash a batch."""
 
 
+class UnknownTenantError(InvalidRequestError):
+    """A fleet request addressed a tenant the front door does not serve
+    (serve/fleet, DESIGN.md section 17).  Deterministic caller error: the
+    tenant field is part of the wire contract, and routing a request to a
+    'nearest' tenant instead of refusing would silently answer it against
+    the wrong point cloud."""
+
+
+class OverQuotaError(InvalidRequestError):
+    """A fleet request exceeded its tenant's token-bucket admission quota
+    (serve/fleet/admission.py).  Typed refusal rather than silent queueing:
+    over-quota load must surface to the CALLER (back-pressure), never
+    convert into unbounded queue depth that starves the other tenants --
+    the admission half of the fleet fairness law (DESIGN.md section 17)."""
+
+
 # Lowercased substrings that identify a transient transport fault in backend
 # error text.  UNAVAILABLE is the gRPC status the dead tunnel produces
 # (r5_tpu_all_rows.json: every post-crash device_put failed UNAVAILABLE);
@@ -170,7 +186,8 @@ _INVALID_INPUT_RE = re.compile(
     r"inputcontracterror|invalidshapeerror|nonfiniteinputerror"
     r"|domainboundserror|degenerateextenterror|invalidkerror"
     r"|corruptinputerror|invalidconfigerror|invalidrequesterror"
-    r"|input contract")
+    r"|unknowntenanterror|overquotaerror"
+    r"|input contract|request contract|unknown tenant|over quota")
 
 
 def classify_fault_text(text: str) -> Optional[str]:

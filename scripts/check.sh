@@ -103,6 +103,46 @@ echo "== mutation fuzz smoke (delta overlay vs rebuild oracle, CPU-only) =="
 JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
     --mutations "${KNTPU_MUT_CASES:-4}" --seed 0 --budget 60s || rc=1
 
+# Fleet smoke (DESIGN.md section 17): a short mixed-SLO multi-tenant
+# open-loop session -- 2 dense tenants (equal executable signatures on the
+# shared bucket ladder) + the tiny CPU-sidecar tenant -- gated by
+# --assert-steady (>= 2 dense tenants served, ZERO fleet-wide steady-state
+# recompiles, defined Jain fairness index), then the process-level failover
+# proof: a REAL SIGKILL of the primary mid-stream, zero lost committed
+# mutations, post-failover answers byte-identical to the rebuild oracle.
+echo "== fleet smoke (2 tenants + sidecar, steady-state gate, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.serve.fleet --loadgen \
+    --tenants 3 --points 3000 --requests 40 --rate 300 --seed 0 \
+    --assert-steady || rc=1
+echo "== fleet failover smoke (SIGKILL the primary, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.serve.fleet \
+    --failover-smoke --failover-points 800 --failover-ops 16 --seed 0 || rc=1
+
+# Fleet fuzz smoke (DESIGN.md section 17): seeded multi-tenant op streams
+# (queries + mutations + mid-stream replica failover, duplicate/cluster
+# hazards per tenant) through the fleet front door vs per-tenant rebuild
+# oracles with the tie-aware comparison.  KNTPU_FLEET_CASES deepens it.
+echo "== fleet fuzz smoke (multi-tenant streams vs per-tenant oracles, ${KNTPU_FLEET_CASES:-8} cases, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
+    --fleet --cases "${KNTPU_FLEET_CASES:-8}" --seed 0 --budget 60s || rc=1
+
+# Fleet seeded-fault self-tests (DESIGN.md section 17): each of the three
+# fleet corruptions -- answering against the wrong tenant's cloud, dropping
+# a committed delta from the replication log, promoting a stale replica
+# without the re-ship -- must yield a banked failure (rc != 0), diverted
+# away from the real corpus.
+echo "== fleet seeded-fault self-tests (cross-tenant / drop-delta / stale-replica) =="
+for fault in cross-tenant drop-delta stale-replica; do
+    if KNTPU_FLEET_FAULT=$fault JAX_PLATFORMS=cpu \
+        python -m cuda_knearests_tpu.fuzz --fleet --cases 4 --seed 0 \
+        --no-minimize >/dev/null 2>&1; then
+        echo "   FAIL: seeded fleet fault '$fault' was not detected (rc 0)"
+        rc=1
+    else
+        echo "   ok: '$fault' detected"
+    fi
+done
+
 # MXU smoke (DESIGN.md section 16): the blocked-matmul subsystem's three
 # CPU-checkable claims -- the recall_target=1.0 byte-identity pin vs the
 # exact elementwise path (the blocked-exactness pin's CPU form), one

@@ -110,6 +110,15 @@ def _artifact_good(path: str, allow_partial: bool = False) -> bool:
         return False
     if allow_partial:
         return any("error" not in ln for ln in lines)
+    # fleet failover rows (ISSUE 11 satellite) are accepted as their own
+    # row kind: unit 'failover_ok' with the machine-checked law true.  A
+    # failover row whose law FAILED (lost committed mutations or
+    # non-byte-identical post-failover answers) poisons the artifact --
+    # a record banked over a broken failover is not a record.
+    for ln in lines:
+        if str(ln.get("unit", "")) == "failover_ok" \
+                and not ln.get("failover_ok"):
+            return False
     # every kNN-throughput row of a FULL bench artifact must carry the
     # recall stamp (ISSUE 10 satellite): frontier rows trade recall for
     # QPS, so a throughput number without its recall is not comparable

@@ -1,0 +1,710 @@
+"""Serving-fleet acceptance: multi-tenant isolation, cross-tenant executable
+sharing, SLO/admission/fairness laws, replication + failover (DESIGN.md
+section 17).
+
+The ISSUE 11 gates pinned here:
+
+  * two tenants of equal executable signature share the ExecutableCache --
+    the second tenant's warmup takes ZERO new compiles, and LRU eviction
+    pressure from one tenant never corrupts another tenant's answers
+    (extends the ISSUE 8 eviction test);
+  * the wire contract's tenant field refuses typed: unknown-tenant,
+    over-quota, and tenant-mismatched k all surface as
+    InvalidRequestError subclasses that classify_fault_text stamps
+    'invalid-input';
+  * token-bucket admission and deficit-round-robin scheduling enforce the
+    fairness law (a flooding tenant cannot starve the rest), with the
+    accounting stamped per dispatch;
+  * replication commits through the delta log and failover (in-process
+    AND process-level with a real SIGKILL) loses zero committed
+    mutations, with post-failover answers byte-identical to the rebuild
+    oracle on the mutated cloud;
+  * tiny/degenerate tenants land on the CPU sidecar and promote to dense
+    placements when they grow past the threshold;
+  * every banked ``tests/corpus/*-fleet.npz`` repro replays clean, and
+    each ``KNTPU_FLEET_FAULT`` corruption provably yields a detected
+    failure that never pollutes the real corpus.
+"""
+
+import glob
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.config import (SLO_CLASSES, ServeFleetConfig,
+                                       SloClass)
+from cuda_knearests_tpu.fuzz.compare import check_route_result
+from cuda_knearests_tpu.io import generate_uniform, validate_request
+from cuda_knearests_tpu.runtime import dispatch
+from cuda_knearests_tpu.serve.daemon import Response
+from cuda_knearests_tpu.serve.fleet import (CpuSidecar, DrrScheduler,
+                                            FleetDaemon, Replica,
+                                            ReplicationLog, Tenant,
+                                            TenantSpec, TokenBucket,
+                                            failover_drill, jain_index)
+from cuda_knearests_tpu.utils.memory import (InvalidConfigError,
+                                             InvalidKError, OverQuotaError,
+                                             TransportError,
+                                             UnknownTenantError,
+                                             classify_fault_text)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(REPO, "tests", "corpus")
+
+# Small fleets keep tier-1 fast; the threshold sits between the tiny and
+# dense sizes so both placements are always exercised.
+CFG = ServeFleetConfig(min_bucket=8, max_batch=64, compact_threshold=64,
+                      warmup=True, sidecar_threshold=192, drr_quantum=16)
+
+
+def _mk_points(n, seed):
+    return generate_uniform(n, seed=seed)
+
+
+def _responses_for(responses, req_id):
+    return [r for r in responses if r.req_id == req_id]
+
+
+def _query_through(fleet, req_id, tenant, queries, k=None):
+    """Submit one query and flush everything; returns its one response."""
+    out = fleet.submit(req_id, tenant, "query", queries, k=k)
+    out += fleet.drain()
+    mine = _responses_for(out, req_id)
+    assert len(mine) == 1, [r.error for r in out if not r.ok]
+    return mine[0]
+
+
+# -- config: SLO classes + fleet tunables -------------------------------------
+
+def test_slo_class_table():
+    assert set(SLO_CLASSES) == {"latency", "throughput"}
+    lat, thr = SLO_CLASSES["latency"], SLO_CLASSES["throughput"]
+    assert lat.max_delay_s < thr.max_delay_s       # latency flushes fast
+    assert lat.max_batch <= thr.max_batch          # throughput rides deep
+    assert lat.p99_budget_ms < thr.p99_budget_ms
+
+
+def test_serve_config_for_clamps_to_fleet_ladder():
+    fleet = ServeFleetConfig(min_bucket=8, max_batch=32)
+    sc = fleet.serve_config_for(SLO_CLASSES["throughput"])
+    assert sc.max_batch == 32        # class depth clamps to the ladder cap
+    assert sc.min_bucket == 8
+    sc_lat = fleet.serve_config_for(SloClass("x", 0.001, 16, 100.0))
+    assert sc_lat.max_batch == 16
+
+
+def test_tenant_spec_validation_typed():
+    with pytest.raises(InvalidConfigError):
+        TenantSpec(name="t", slo="goldplated")
+    with pytest.raises(InvalidConfigError):
+        TenantSpec(name="t", ship_mode="osmosis")
+    with pytest.raises(InvalidConfigError):
+        TenantSpec(name="t", k=0)
+    spec = TenantSpec(name="t", k=4, slo="latency")
+    assert TenantSpec.from_json(spec.to_json()) == spec
+
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError):
+        ServeFleetConfig(min_bucket=0)
+    with pytest.raises(ValueError):
+        ServeFleetConfig(min_bucket=16, max_batch=8)
+    with pytest.raises(ValueError):
+        ServeFleetConfig(drr_quantum=0)
+    with pytest.raises(ValueError):
+        ServeFleetConfig(quota_qps=0.0)
+    with pytest.raises(ValueError):
+        ServeFleetConfig(sidecar_threshold=-1)
+
+
+# -- admission: token bucket + DRR fairness -----------------------------------
+
+def test_token_bucket_refill_and_refusal():
+    tb = TokenBucket(rate=10.0, burst=20.0, now=0.0)
+    assert tb.try_take(20, now=0.0)          # the whole burst
+    assert not tb.try_take(1, now=0.0)       # empty -> refuse, not queue
+    assert tb.refusals == 1
+    assert tb.try_take(5, now=0.5)           # 0.5s * 10/s = 5 tokens back
+    assert not tb.try_take(1, now=0.5)
+    # refill caps at burst, never beyond
+    assert tb.try_take(20, now=1e9)
+    assert not tb.try_take(21, now=1e9 + 2.0)
+
+
+def test_token_bucket_unmetered():
+    tb = TokenBucket(rate=None, burst=8.0, now=0.0)
+    assert all(tb.try_take(10 ** 6, now=0.0) for _ in range(3))
+    assert tb.refusals == 0
+
+
+class _B:
+    """Minimal batch stand-in: DRR reads only .total."""
+
+    def __init__(self, total):
+        self.total = total
+
+
+def test_drr_no_starvation_under_flood():
+    """The DRR law: a flooding tenant cannot starve a light one -- the
+    light tenant's whole backlog dispatches while the hog is still paying
+    for its deep batches, and every batch eventually dispatches."""
+    from collections import deque
+
+    drr = DrrScheduler(quantum=16)
+    drr.register("hog")
+    drr.register("light")
+    ready = {"hog": deque(_B(64) for _ in range(6)),
+             "light": deque(_B(8) for _ in range(2))}
+    order = drr.select(ready)
+    assert not ready["hog"] and not ready["light"]     # full drain
+    tenants = [name for name, _batch, _disp in order]
+    # the light tenant's 8-row batches are affordable within one quantum;
+    # the hog's 64-row batches need four -- light finishes first
+    assert tenants[:2] == ["light", "light"]
+    assert tenants.count("hog") == 6
+    assert drr.served_rows == {"hog": 384, "light": 16}
+    # fairness accounting is stamped on every dispatch
+    assert len(drr.dispatches) == 8
+    for d in drr.dispatches:
+        assert d.rows > 0 and d.deficit_after >= 0
+    # an emptied queue resets its deficit (no banked credit while idle)
+    assert drr.deficit["light"] == 0.0 and drr.deficit["hog"] == 0.0
+
+
+def test_drr_rows_served_within_fairness_bound():
+    """While both tenants stay backlogged, served rows differ by at most
+    one quantum plus one max batch (the classic DRR bound)."""
+    from collections import deque
+
+    drr = DrrScheduler(quantum=16)
+    drr.register("a")
+    drr.register("b")
+    ready = {"a": deque(_B(32) for _ in range(8)),
+             "b": deque(_B(32) for _ in range(8))}
+    drr.select(ready)
+    a = b = 0
+    for d in list(drr.dispatches)[:-1]:   # both backlogged until the last
+        if d.tenant == "a":
+            a += d.rows
+        else:
+            b += d.rows
+        assert abs(a - b) <= 16 + 32, (a, b)
+
+
+def test_slo_percentiles_are_query_only():
+    """A mutation-only tenant has NO latency samples: its percentiles must
+    come back None (mutation acks are near-instant and would dilute the
+    p99 the slo_ok gate checks -- regression test)."""
+    from cuda_knearests_tpu.serve.fleet import TenantLoad, run_fleet_session
+
+    dispatch.EXEC_CACHE.clear()
+    fleet = FleetDaemon(
+        [(TenantSpec(name="w", k=4, slo="latency"), _mk_points(400, 70))],
+        ServeFleetConfig(min_bucket=8, max_batch=64, warmup=False,
+                         sidecar_threshold=192, drr_quantum=16))
+    summary = run_fleet_session(fleet, [TenantLoad(
+        tenant="w", rate=500.0, requests=4, mutation_ratio=1.0, seed=5)])
+    pt = summary["per_tenant"]["w"]
+    assert pt["offered_rows"] == 0 and pt["p99_ms"] is None
+    assert not pt["slo_ok"]
+    assert summary["slo_ok_all"]          # no offered queries -> excluded
+
+
+def test_jain_index():
+    assert jain_index([1.0, 1.0, 1.0, 1.0]) == 1.0
+    assert jain_index([1.0, 0.0, 0.0, 0.0]) == 0.25   # one tenant took all
+    assert jain_index([]) is None
+    assert jain_index([0.0, 0.0]) is None
+    assert jain_index([1.0, None, 1.0]) == 1.0        # absent != starved
+
+
+# -- the wire contract's tenant field (typed refusals) ------------------------
+
+def test_validate_request_unknown_tenant_typed():
+    q = np.full((2, 3), 500.0, np.float32)
+    with pytest.raises(UnknownTenantError) as ei:
+        validate_request("query", q, tenant="ghost", tenants=("a", "b"))
+    assert ei.value.kind == "invalid-input"
+    assert classify_fault_text(
+        f"{type(ei.value).__name__}: {ei.value}") == "invalid-input"
+
+
+def test_validate_request_over_quota_typed():
+    q = np.full((2, 3), 500.0, np.float32)
+    with pytest.raises(OverQuotaError) as ei:
+        validate_request("query", q, tenant="a", tenants=("a",),
+                         quota_ok=False)
+    assert ei.value.kind == "invalid-input"
+    assert classify_fault_text(
+        f"{type(ei.value).__name__}: {ei.value}") == "invalid-input"
+    # quota verdicts never mask the tenant check
+    with pytest.raises(UnknownTenantError):
+        validate_request("query", q, tenant="ghost", tenants=("a",),
+                         quota_ok=False)
+
+
+def test_validate_request_tenant_k_mismatch_names_tenant():
+    q = np.full((1, 3), 500.0, np.float32)
+    with pytest.raises(InvalidKError) as ei:
+        validate_request("query", q, k=32, k_max=8, tenant="acme",
+                         tenants=("acme",))
+    assert "acme" in str(ei.value)
+    assert ei.value.kind == "invalid-input"
+
+
+@pytest.fixture(scope="module")
+def two_tenant_fleet():
+    """Two dense tenants of EQUAL executable signature (same n, k, SLO)
+    plus one sidecar tenant -- the fleet most tests drive."""
+    dispatch.EXEC_CACHE.clear()
+    builds = [
+        (TenantSpec(name="a", k=8, slo="latency"), _mk_points(1200, 0)),
+        (TenantSpec(name="b", k=8, slo="latency"), _mk_points(1200, 1)),
+        (TenantSpec(name="tiny", k=8, slo="latency"), _mk_points(24, 2)),
+    ]
+    return FleetDaemon(builds, CFG)
+
+
+def test_frontdoor_unknown_tenant_refused(two_tenant_fleet):
+    [r] = two_tenant_fleet.submit(900, "ghost", "query",
+                                  np.full((1, 3), 5.0, np.float32))
+    assert not r.ok and r.failure_kind == "invalid-input"
+    assert "unknown tenant" in r.error
+    assert r.tenant == "ghost"
+
+
+def test_frontdoor_over_quota_refused():
+    dispatch.EXEC_CACHE.clear()
+    fleet = FleetDaemon(
+        [(TenantSpec(name="metered", k=4, slo="latency", quota_qps=1.0,
+                     quota_burst=4.0), _mk_points(400, 3))], CFG)
+    q8 = np.full((8, 3), 500.0, np.float32)
+    [r] = fleet.submit(1, "metered", "query", q8)   # 8 rows > burst 4
+    assert not r.ok and r.failure_kind == "invalid-input"
+    assert "over quota" in r.error
+    assert fleet.refused["metered"] == 1
+    # within-burst traffic still admits
+    r2 = _query_through(fleet, 2, "metered",
+                        np.full((2, 3), 500.0, np.float32))
+    assert r2.ok, r2.error
+
+
+def test_frontdoor_k_mismatch_refused(two_tenant_fleet):
+    [r] = two_tenant_fleet.submit(901, "a", "query",
+                                  np.full((1, 3), 5.0, np.float32), k=64)
+    assert not r.ok and r.failure_kind == "invalid-input"
+    assert "serving k" in r.error
+
+
+def test_oversized_query_refused_at_tenant_ladder_depth():
+    """A query larger than the TENANT's SLO-clamped max_batch must refuse
+    typed at admission -- not crash the front door when the tenant's
+    batcher meets a batch its own ladder cannot bucket (regression: the
+    front door used to validate against the fleet-global cap)."""
+    dispatch.EXEC_CACHE.clear()
+    fleet = FleetDaemon(
+        [(TenantSpec(name="lat", k=4, slo="latency"), _mk_points(400, 60))],
+        ServeFleetConfig(min_bucket=8, max_batch=256, warmup=False,
+                         sidecar_threshold=192, drr_quantum=16))
+    assert fleet._max_batch(fleet.tenants["lat"]) == 64  # class-clamped
+    [r] = fleet.submit(1, "lat", "query",
+                       np.full((100, 3), 500.0, np.float32))
+    assert not r.ok and r.failure_kind == "invalid-input"
+    # the daemon survives and keeps serving
+    r2 = _query_through(fleet, 2, "lat", _mk_points(3, 61))
+    assert r2.ok, r2.error
+
+
+def test_drr_drains_deep_batch_behind_cheap_head():
+    """A large batch queued BEHIND a cheap head must still drain (the
+    rotation guard budgets on the biggest batch anywhere in the queues,
+    not just current heads -- regression test)."""
+    from collections import deque
+
+    drr = DrrScheduler(quantum=1)
+    drr.register("t")
+    ready = {"t": deque([_B(1), _B(256)])}
+    order = drr.select(ready)          # must not raise the invariant guard
+    assert [b.total for _n, b, _d in order] == [1, 256]
+    assert drr.served_rows["t"] == 257
+
+
+def test_barrier_flushed_queries_ride_fleet_accounting():
+    """Queries pending at a mutation barrier must execute through the
+    fleet's own accounting (batch_log / served_rows), not vanish into the
+    daemon's internal barrier flush (regression test)."""
+    dispatch.EXEC_CACHE.clear()
+    fleet = FleetDaemon(
+        [(TenantSpec(name="m", k=4, slo="throughput"),
+          _mk_points(400, 62))],
+        ServeFleetConfig(min_bucket=8, max_batch=64, warmup=False,
+                         sidecar_threshold=192, drr_quantum=16))
+    out = fleet.submit(1, "m", "query", _mk_points(3, 63))   # stays pending
+    assert out == []
+    out = fleet.submit(2, "m", "insert",
+                       np.full((2, 3), 400.0, np.float32))   # barrier
+    assert all(r.ok for r in out), [r.error for r in out if not r.ok]
+    assert {r.req_id for r in out} == {1, 2}
+    assert any(b["reason"] == "barrier" for b in fleet.batch_log)
+    assert fleet.served_rows["m"] == 3
+
+
+# -- cross-tenant ExecutableCache sharing (the zero-recompile fleet law) ------
+
+def test_second_equal_signature_tenant_warms_free():
+    """Two tenants on the same ladder bucket set with equal problem
+    signatures: the second tenant's warmup takes ZERO new compiles -- the
+    whole point of coalescing the fleet onto one capacity ladder."""
+    cache = dispatch.EXEC_CACHE
+    cache.clear()
+    t_a = Tenant(TenantSpec(name="a", k=8, slo="latency"),
+                 _mk_points(1200, 10), CFG, time.monotonic)
+    assert not t_a.is_sidecar
+    misses_after_first = cache.misses
+    assert misses_after_first > 0          # tenant a minted the buckets
+    t_b = Tenant(TenantSpec(name="b", k=8, slo="latency"),
+                 _mk_points(1200, 11), CFG, time.monotonic)
+    assert not t_b.is_sidecar
+    assert cache.misses == misses_after_first, \
+        "second equal-signature tenant recompiled during warmup"
+    assert cache.hits > 0
+
+
+def test_fleet_steady_queries_zero_recompiles(two_tenant_fleet):
+    """After fleet warmup, on-ladder queries across every dense tenant hit
+    only cached executables."""
+    misses0 = dispatch.EXEC_CACHE.misses
+    for i, name in enumerate(("a", "b", "a", "b")):
+        r = _query_through(two_tenant_fleet, 100 + i, name,
+                           _mk_points(5, 40 + i))
+        assert r.ok, r.error
+    assert dispatch.EXEC_CACHE.misses == misses0
+
+
+def test_eviction_pressure_never_corrupts_other_tenant():
+    """Extends the ISSUE 8 eviction test across tenants: tenant A thrashes
+    a tiny cache through differently-bucketed batches; tenant B's answers
+    must re-mint executables and stay byte-identical to its own rebuild
+    oracle -- eviction costs recompiles, never correctness or isolation."""
+    cache = dispatch.EXEC_CACHE
+    cache.clear()
+    pts_a, pts_b = _mk_points(800, 20), _mk_points(800, 21)
+    fleet = FleetDaemon(
+        [(TenantSpec(name="a", k=8, slo="latency"), pts_a),
+         (TenantSpec(name="b", k=8, slo="latency"), pts_b)],
+        ServeFleetConfig(min_bucket=8, max_batch=64, warmup=False,
+                         sidecar_threshold=192, drr_quantum=16))
+    probe = _mk_points(6, 22)
+    before = np.asarray(_query_through(fleet, 1, "b", probe).ids)
+    old_cap = cache.maxsize
+    try:
+        cache.maxsize = 2                   # thrashing is now guaranteed
+        for i, m in enumerate((1, 9, 17, 33)):
+            r = _query_through(fleet, 10 + i, "a",
+                               np.full((m, 3), 500.0, np.float32))
+            assert r.ok, r.error
+        assert cache.evictions > 0
+        rb = _query_through(fleet, 50, "b", probe)
+        assert rb.ok, rb.error
+        oracle = KnnProblem.prepare(pts_b, KnnConfig(k=8, adaptive=False))
+        ref_i, ref_d = oracle.query(probe, 8)
+        np.testing.assert_array_equal(np.asarray(rb.ids),
+                                      np.asarray(ref_i))
+        np.testing.assert_array_equal(np.asarray(rb.d2),
+                                      np.asarray(ref_d, np.float32))
+        np.testing.assert_array_equal(np.asarray(rb.ids), before)
+    finally:
+        cache.maxsize = old_cap
+        cache.clear()
+
+
+# -- tenant isolation (answers come from the RIGHT cloud) ---------------------
+
+def test_tenant_answers_its_own_cloud(two_tenant_fleet):
+    """The same probe through tenants a and b must answer against each
+    tenant's own points -- byte-identical to per-tenant rebuild oracles
+    (dense path), different from each other (different clouds)."""
+    probe = _mk_points(4, 30)
+    for name in ("a", "b"):
+        r = _query_through(two_tenant_fleet, 200 + ord(name), name, probe)
+        assert r.ok and r.tenant == name
+        oracle = KnnProblem.prepare(
+            two_tenant_fleet.tenants[name].mutated_points(),
+            KnnConfig(k=8, adaptive=False))
+        ref_i, ref_d = oracle.query(probe, 8)
+        np.testing.assert_array_equal(np.asarray(r.ids),
+                                      np.asarray(ref_i))
+        np.testing.assert_array_equal(np.asarray(r.d2),
+                                      np.asarray(ref_d, np.float32))
+
+
+# -- the CPU sidecar tier -----------------------------------------------------
+
+def test_tiny_tenant_lands_on_sidecar(two_tenant_fleet):
+    t = two_tenant_fleet.tenants["tiny"]
+    assert t.is_sidecar and t.n_points == 24
+    probe = _mk_points(3, 31)
+    r = _query_through(two_tenant_fleet, 300, "tiny", probe)
+    assert r.ok and r.tenant == "tiny"
+    # exact under the tie-aware contract (host-numpy bits, not XLA bits)
+    oracle = KnnProblem.prepare(t.mutated_points(),
+                                KnnConfig(k=8, adaptive=False))
+    _ref_i, ref_d = oracle.query(probe, 8)
+    bad = check_route_result(t.mutated_points(), probe,
+                             np.asarray(r.ids), np.asarray(r.d2),
+                             np.asarray(ref_d), 8)
+    assert bad is None, bad.render()
+
+
+def test_degenerate_tenant_pads_like_dense():
+    """n < k is a sidecar placement by definition; rows pad -1/inf beyond
+    the available neighbors (the front door's degraded-mode contract)."""
+    side = CpuSidecar(_mk_points(3, 32), k=8)
+    ids, d2 = side.query(_mk_points(2, 33), 8)
+    assert ids.shape == (2, 8) and d2.shape == (2, 8)
+    assert (ids[:, 3:] == -1).all() and np.isinf(d2[:, 3:]).all()
+    assert (ids[:, :3] >= 0).all() and np.isfinite(d2[:, :3]).all()
+    assert (np.diff(d2[:, :3], axis=1) >= 0).all()
+
+
+def test_sidecar_promotes_to_dense_on_growth():
+    """A sidecar tenant whose cloud grows past the threshold promotes to a
+    dense placement at the crossing mutation, preserving canonical ids
+    (both placements use the identical np.delete/np.concatenate
+    indexing)."""
+    dispatch.EXEC_CACHE.clear()
+    fleet = FleetDaemon(
+        [(TenantSpec(name="g", k=4, slo="latency"), _mk_points(40, 34))],
+        ServeFleetConfig(min_bucket=8, max_batch=64, warmup=False,
+                         sidecar_threshold=64, drr_quantum=16))
+    t = fleet.tenants["g"]
+    assert t.is_sidecar
+    grown = _mk_points(48, 35) + np.float32(1.0)
+    [r] = fleet.submit(1, "g", "insert", grown)
+    assert r.ok and r.n_points == 88
+    assert not t.is_sidecar and t.promotions == 1
+    probe = _mk_points(4, 36)
+    r2 = _query_through(fleet, 2, "g", probe)
+    assert r2.ok
+    expected = np.concatenate([_mk_points(40, 34), grown])
+    oracle = KnnProblem.prepare(expected, KnnConfig(k=4, adaptive=False))
+    ref_i, ref_d = oracle.query(probe, 4)
+    np.testing.assert_array_equal(np.asarray(r2.ids), np.asarray(ref_i))
+    np.testing.assert_array_equal(np.asarray(r2.d2),
+                                  np.asarray(ref_d, np.float32))
+
+
+# -- replication + failover ---------------------------------------------------
+
+def test_replication_log_sequencing():
+    log = ReplicationLog()
+    r1 = log.append("insert", np.zeros((2, 3), np.float32))
+    r2 = log.append("delete", np.asarray([0]))
+    assert (r1.seq, r2.seq, log.committed_seq) == (1, 2, 2)
+    assert [r.seq for r in log.since(0)] == [1, 2]
+    assert [r.seq for r in log.since(1)] == [2]
+    assert log.since(2) == []
+
+
+def test_replica_refuses_sequence_gap():
+    """A gap means the shipper lost a committed delta: the replica must
+    raise, never silently reorder or skip."""
+    from cuda_knearests_tpu.serve.fleet.replica import DeltaRecord
+
+    problem = KnnProblem.prepare(_mk_points(300, 50),
+                                 KnnConfig(k=4, adaptive=False))
+    rep = Replica(problem, compact_threshold=64)
+    pts = np.full((2, 3), 123.0, np.float32)
+    rep.apply(DeltaRecord(seq=1, kind="insert", payload=pts))
+    with pytest.raises(RuntimeError, match="sequence gap"):
+        rep.apply(DeltaRecord(seq=3, kind="insert", payload=pts))
+    with pytest.raises(RuntimeError, match="sequence gap"):
+        rep.apply(DeltaRecord(seq=1, kind="insert", payload=pts))  # replay
+
+
+@pytest.mark.parametrize("ship_mode", ["sync", "lazy"])
+def test_in_process_failover_zero_lost_byte_identical(ship_mode):
+    """Mutations commit through the log, the primary dies (overlay swap),
+    the promoted replica answers byte-identically to a rebuild oracle on
+    the committed cloud -- under both ship modes (sync ships each commit;
+    lazy defers everything to failover's re-ship)."""
+    dispatch.EXEC_CACHE.clear()
+    pts0 = _mk_points(600, 51)
+    fleet = FleetDaemon(
+        [(TenantSpec(name="r", k=6, slo="throughput", replicas=1,
+                     ship_mode=ship_mode), pts0)],
+        ServeFleetConfig(min_bucket=8, max_batch=64, warmup=False,
+                         sidecar_threshold=192, compact_threshold=64,
+                         drr_quantum=16))
+    ins = _mk_points(5, 52)
+    [r1] = fleet.submit(1, "r", "insert", ins)
+    assert r1.ok
+    [r2] = fleet.submit(2, "r", "delete", np.asarray([3, 7, 11]))
+    assert r2.ok
+    t = fleet.tenants["r"]
+    assert t.log.committed_seq == 2
+    if ship_mode == "sync":
+        assert t.replica_pool[0].applied_seq == 2
+    else:
+        assert t.replica_pool[0].applied_seq == 0    # nothing shipped yet
+    info = fleet.failover("r")
+    assert info["committed_seq"] == 2
+    assert info["replayed"] == (0 if ship_mode == "sync" else 2)
+    expected = np.delete(np.concatenate([pts0, ins]), [3, 7, 11], axis=0)
+    assert t.daemon.overlay.n_points == expected.shape[0]  # zero lost
+    probe = _mk_points(6, 53)
+    r3 = _query_through(fleet, 3, "r", probe)
+    assert r3.ok
+    oracle = KnnProblem.prepare(expected, KnnConfig(k=6, adaptive=False))
+    ref_i, ref_d = oracle.query(probe, 6)
+    np.testing.assert_array_equal(np.asarray(r3.ids), np.asarray(ref_i))
+    np.testing.assert_array_equal(np.asarray(r3.d2),
+                                  np.asarray(ref_d, np.float32))
+
+
+def test_failover_without_replica_is_typed():
+    dispatch.EXEC_CACHE.clear()
+    fleet = FleetDaemon(
+        [(TenantSpec(name="solo", k=4, slo="latency"),
+          _mk_points(300, 54))],
+        ServeFleetConfig(min_bucket=8, max_batch=64, warmup=False,
+                         sidecar_threshold=192, drr_quantum=16))
+    with pytest.raises(TransportError):
+        fleet.failover("solo")
+
+
+def test_process_level_failover_drill():
+    """The acceptance law end to end with REAL child processes: a genuine
+    SIGKILL mid-stream, zero lost committed mutations, post-failover
+    answers byte-identical to the rebuild oracle (shared by the
+    --failover-smoke CLI and the fleet_failover bench row)."""
+    drill = failover_drill(n=400, k=6, ops=12, seed=1)
+    assert drill["failovers"] >= 1
+    assert drill["zero_lost_committed"], drill
+    assert drill["post_failover_byte_identical"], drill
+    assert drill["failover_ok"]
+    assert drill["committed_mutations"] == drill["commits_acked"]
+
+
+# -- the wire stamp -----------------------------------------------------------
+
+def test_response_tenant_stamp_on_wire():
+    r = Response(req_id=1, ok=True, ids=np.zeros((1, 2), np.int32),
+                 d2=np.zeros((1, 2), np.float32), tenant="acme")
+    assert r.to_wire()["tenant"] == "acme"
+    r2 = Response(req_id=2, ok=True, ids=np.zeros((1, 2), np.int32),
+                  d2=np.zeros((1, 2), np.float32))
+    assert "tenant" not in r2.to_wire()   # single-tenant wires unchanged
+
+
+# -- static proof hooks (the fleet syncflow windows) --------------------------
+
+def test_fleet_syncflow_windows_proved():
+    from cuda_knearests_tpu.analysis import syncflow
+
+    worst = syncflow.worst_case_env()
+    batch = syncflow.WINDOWS["fleet-batch"]
+    assert syncflow.evaluate(batch.syncs, worst) <= 4   # like serve today
+    assert "serve-batch" in batch.includes
+    assert syncflow.evaluate(
+        syncflow.WINDOWS["fleet-replica-apply"].syncs, worst) == 0
+    assert syncflow.evaluate(
+        syncflow.WINDOWS["fleet-sidecar"].syncs, worst) == 0
+    for route in ("fleet-batch", "fleet-replica-apply", "fleet-sidecar"):
+        assert route in syncflow.ROUTE_WINDOWS
+
+
+# -- fuzz: seeded faults + corpus replay --------------------------------------
+
+# each corruption with a spec shaped so the fault MUST bite: cross-tenant
+# needs >= 2 tenants; drop-delta needs a committed mutation shipped before
+# failover (sync); stale-replica needs a behind replica (lazy) whose
+# re-ship is skipped
+_FLEET_FAULT_SPECS = {
+    "cross-tenant": dict(replicated=-1, ship_mode="sync"),
+    "drop-delta": dict(replicated=1, ship_mode="sync"),
+    "stale-replica": dict(replicated=1, ship_mode="lazy"),
+}
+
+
+@pytest.mark.parametrize("fault", sorted(_FLEET_FAULT_SPECS))
+def test_fleet_fault_provably_detected(fault, tmp_path, monkeypatch):
+    """Each KNTPU_FLEET_FAULT corruption yields a detected, banked failure
+    on a stream shaped to reach it -- the campaign's detectors are alive."""
+    from cuda_knearests_tpu.fuzz.fleet import FleetSpec, run_fleet_case
+
+    monkeypatch.setenv("KNTPU_FLEET_FAULT", fault)
+    spec = FleetSpec(seed=5, n0s=(90, 150), ks=(4, 4), n_ops=6,
+                     **_FLEET_FAULT_SPECS[fault])
+    failure = run_fleet_case(spec, bank_dir=str(tmp_path), minimize=False)
+    assert failure is not None, f"fault {fault} went undetected"
+    assert failure.banked and os.path.exists(failure.banked)
+    assert failure.banked.endswith("-fleet.npz")
+
+
+def test_faulted_run_never_banks_into_real_corpus(monkeypatch):
+    from cuda_knearests_tpu.fuzz import CORPUS_DIR
+    from cuda_knearests_tpu.fuzz.fleet import _safe_bank_dir
+
+    monkeypatch.delenv("KNTPU_FLEET_FAULT", raising=False)
+    assert _safe_bank_dir(CORPUS_DIR) == CORPUS_DIR
+    monkeypatch.setenv("KNTPU_FLEET_FAULT", "cross-tenant")
+    diverted = _safe_bank_dir(CORPUS_DIR)
+    assert os.path.abspath(diverted) != os.path.abspath(CORPUS_DIR)
+
+
+def test_fleet_case_bank_roundtrip(tmp_path):
+    from cuda_knearests_tpu.fuzz.fleet import (FleetSpec, bank_fleet_case,
+                                               generate_ops,
+                                               load_fleet_case)
+
+    spec = FleetSpec(seed=7, n0s=(36, 150), ks=(4, 8), n_ops=5,
+                     replicated=1, ship_mode="lazy")
+    ops = generate_ops(spec)
+    path = bank_fleet_case(str(tmp_path), spec, "mismatch", "why", ops)
+    b = load_fleet_case(path)
+    assert b["spec"] == spec and b["kind"] == "mismatch"
+    assert [o["op"] for o in b["ops"]] == [o["op"] for o in ops]
+    for got, want in zip(b["ops"], ops):
+        for key in ("points", "ids", "queries"):
+            if key in want:
+                np.testing.assert_array_equal(got[key], want[key])
+
+
+def _fleet_corpus_entries():
+    return sorted(glob.glob(os.path.join(CORPUS, "*-fleet.npz")))
+
+
+@pytest.mark.parametrize("path", _fleet_corpus_entries() or ["<empty>"],
+                         ids=[os.path.basename(p)
+                              for p in _fleet_corpus_entries()] or ["none"])
+def test_fleet_corpus_replays_clean(path):
+    """Every banked fleet repro must stay fixed (regression pin; the
+    corpus is currently allowed to be empty -- the campaign's dev runs
+    found no real isolation violations)."""
+    if path == "<empty>":
+        pytest.skip("no banked fleet repros (campaign clean)")
+    from cuda_knearests_tpu.fuzz.fleet import load_fleet_case, replay_ops
+
+    b = load_fleet_case(path)
+    got = replay_ops(b["spec"], b["ops"])
+    assert got is None, (f"{os.path.basename(path)} regressed: {got} "
+                        f"(originally: {b['reason']})")
+
+
+def test_fleet_campaign_manifest_shape():
+    """A tiny clean campaign: manifest fields the smoke and bench stamps
+    rely on (rc-0 bar == manifest['ok'])."""
+    from cuda_knearests_tpu.fuzz.fleet import run_fleet_campaign
+
+    manifest = run_fleet_campaign(n_cases=2, seed=3, bank_dir=None,
+                                  minimize=False, log=None)
+    assert manifest["ok"] is True and manifest["failures"] == []
+    for key in ("flavor", "requested_cases", "completed_cases", "seed",
+                "fault", "elapsed_s", "corpus_size"):
+        assert key in manifest
+    assert manifest["flavor"] == "fleet-stream"
+    assert manifest["fault"] is None

@@ -187,11 +187,13 @@ def test_k_gt_n_keeps_certificates_intact():
 # -- corpus replay ------------------------------------------------------------
 
 def _corpus_entries():
-    # point-case repros only: mutation-stream (*-mutation.npz) and FoF
-    # (*-fof.npz) repros have their own schemas and replay via their own
-    # loaders (below / tests/test_cluster.py)
+    # point-case repros only: mutation-stream (*-mutation.npz), FoF
+    # (*-fof.npz), approx (*-approx.npz) and fleet (*-fleet.npz) repros
+    # have their own schemas and replay via their own loaders (below /
+    # tests/test_cluster.py / test_mxu.py / test_fleet.py)
     return sorted(p for p in glob.glob(os.path.join(CORPUS, "*.npz"))
-                  if not p.endswith(("-mutation.npz", "-fof.npz")))
+                  if not p.endswith(("-mutation.npz", "-fof.npz",
+                                     "-approx.npz", "-fleet.npz")))
 
 
 def _mutation_corpus_entries():
