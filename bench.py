@@ -973,6 +973,19 @@ def main(argv=None) -> int:
                             "like --all: each session runs in an isolated "
                             "worker, so a daemon process death costs one "
                             "typed failure row")
+    group.add_argument("--pod-scaling", action="store_true",
+                       help="measure the pod weak-scaling row family "
+                            "instead: fixed points-per-chip across a "
+                            "device ladder (BENCH_POD_DEVICES, default "
+                            "1,2,4,8 -- forced host devices on CPU, real "
+                            "chips on hardware), one JSON row per mesh "
+                            "size emitting queries/sec/chip, halo_bytes, "
+                            "ring depth, per-chip HBM high-water vs "
+                            "budget, recall and the proven sync bound.  "
+                            "Each mesh size runs in its own child process "
+                            "(the device count must be fixed before jax "
+                            "initializes).  rc 0 iff every row lands with "
+                            "sync_bound_ok and recall >= 0.999")
     group.add_argument("--frontier", action="store_true",
                        help="measure the recall-vs-QPS frontier of the "
                             "brute/MXU route instead: one JSON row per "
@@ -1056,6 +1069,66 @@ def main(argv=None) -> int:
                                                    honor_jax_platforms_env)
     honor_jax_platforms_env()
     enable_compile_cache()  # remote-tunnel compiles persist across runs
+
+    if args.pod_scaling:
+        # Pod weak-scaling rows (ISSUE 12): fixed points-per-chip across a
+        # device ladder.  Each mesh size MUST run in its own child process
+        # -- the (forced or real) device count is fixed at jax init -- so
+        # the parent only spawns `python -m cuda_knearests_tpu.pod --bench`
+        # children with the ladder's device count in XLA_FLAGS and stamps
+        # their rows with the tree provenance.  On CPU the ladder runs on
+        # forced host devices (an emulation: tpu_watch refuses such rows as
+        # north-star records by their platform stamp); the first genuine
+        # on-chip capture of this family is the ISSUE 12 deliverable.
+        import re
+        import subprocess
+
+        # tree provenance only: the child stamps its OWN platform and
+        # n_devices (it is the process that saw the forced/real mesh)
+        env_fields = _analysis_fields()
+        env_fields.update(_fuzz_fields())
+        ladder = [int(x) for x in os.environ.get(
+            "BENCH_POD_DEVICES", "1,2,4,8").split(",") if x.strip()]
+        ppc = int(os.environ.get("BENCH_POD_PPC", "20000"))
+        rc = 0
+        for nd in ladder:
+            _watchdog.heartbeat()
+            child_env = dict(os.environ)
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                child_env.get("XLA_FLAGS", ""))
+            child_env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={nd}"
+            ).strip()
+            argv_i = [sys.executable, "-m", "cuda_knearests_tpu.pod",
+                      "--bench", "--devices", str(nd),
+                      "--points-per-chip", str(ppc), "--k", "10"]
+            try:
+                r = subprocess.run(argv_i, capture_output=True, text=True,
+                                   timeout=float(os.environ.get(
+                                       "BENCH_POD_TIMEOUT_S", "900")),
+                                   env=child_env)
+                row = None
+                for line in r.stdout.splitlines():
+                    line = line.strip()
+                    if line.startswith("{"):
+                        row = json.loads(line)
+                if row is None:
+                    row = {"config": f"pod weak-scaling ({nd} devices)",
+                           "error": f"child rc {r.returncode}: "
+                                    f"{r.stderr[-500:]}"}
+            except subprocess.TimeoutExpired:
+                row = {"config": f"pod weak-scaling ({nd} devices)",
+                       "error": "child timeout"}
+            row.update(env_fields)
+            recall = row.get("recall")
+            if ("error" in row or not row.get("sync_bound_ok", False)
+                    or not (isinstance(recall, (int, float))
+                            and recall >= 0.999)):
+                rc = 1
+            print(json.dumps(row), flush=True)
+        state["emitted"] = True
+        return rc
 
     if args.frontier:
         # Frontier rows (ISSUE 10): in-process like --only -- the rows are

@@ -613,6 +613,101 @@ def _check_sharded(ck: _Checker, points: np.ndarray, k: int,
                          ccap=cp.ccap, k=k)
 
 
+_POD_FIXTURE_CACHE: Dict[Tuple, tuple] = {}
+
+
+def _pod_fixture(points: np.ndarray, k: int, supercell: int):
+    """(cfg, abstract chip-ready state, chip plan, meta) for the
+    pod-partitioned per-chip route -- the fixture this engine and the
+    equivalence engine trace ``_chip_solve`` against over a POD-built
+    window (Morton cell ranges + ring halo layout, ndev=2), with no
+    jitted program executed.  The pod route launches the SAME shared
+    per-chip solve program as the z-slab route; what this fixture pins is
+    the partitioned plan SHAPE feeding it.
+
+    Memoized per (points, k, supercell): one gate run consumes this
+    fixture from three engines (contracts' route check, verify's
+    signature census, the equivalence pod section), and the planning +
+    abstract prepack are deterministic in the key."""
+    key = (hash(points.tobytes()), points.shape[0], k, supercell)
+    if key in _POD_FIXTURE_CACHE:
+        return _POD_FIXTURE_CACHE[key]
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import KnnConfig, grid_dim_for
+    from ..pod.partition import build_pod_plan
+    from ..pod.solve import _pod_ready_state
+
+    # hbm_budget_bytes=-1 pins the budget to unbounded: the default
+    # resolves from the DEVICE's reported memory, which forced-host-device
+    # test meshes split by device count -- the fixture's class routing
+    # (and therefore the committed pod certificate) must not depend on
+    # how many devices the checking process happens to emulate
+    cfg = KnnConfig(k=k, supercell=supercell, interpret=True,
+                    hbm_budget_bytes=-1)
+    dim = grid_dim_for(points.shape[0], cfg.density)
+    plan = build_pod_plan(points, 2, cfg, dim, on_kernel_platform=True)
+    meta = plan.meta
+    chip = max(plan.chips, key=lambda c: len(c.classes))
+    sd = jax.ShapeDtypeStruct
+    f32, i32 = jnp.float32, jnp.int32
+    args = (sd((meta.pcap, 3), f32), sd((meta.pcap,), i32),
+            sd((2 * meta.steps, meta.hcap, 3), f32),
+            sd((2 * meta.steps, meta.hcap), i32),
+            sd(chip.ext_starts.shape, i32), sd(chip.ext_counts.shape, i32))
+    state = jax.eval_shape(functools.partial(
+        _pod_ready_state, k=k), *args, classes=chip.classes)
+    _POD_FIXTURE_CACHE[key] = (cfg, state, chip, meta)
+    return _POD_FIXTURE_CACHE[key]
+
+
+def _check_pod(ck: _Checker, points: np.ndarray, k: int,
+               supercell: int) -> None:
+    """The pod-partitioned per-chip route: result contract, both
+    epilogues, tile alignment, value-free jaxpr -- same coverage as the
+    z-slab sharded route, over the Morton-range window layout."""
+    import jax
+
+    from ..config import DOMAIN_SIZE
+    from ..parallel.sharded import _chip_solve
+
+    route = "pod-chip"
+    label = f"k={k},s={supercell}"
+    try:
+        cfg, state, chip, meta = _pod_fixture(points, k, supercell)
+    except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+        ck.fail("route-shape", route,
+                f"[{label}] ready-state trace failed: "
+                f"{type(e).__name__}: {e}",
+                subject=f"{route}:ready")
+        return
+    outs = {}
+    for ep in ("gather", "scatter"):
+        fn = functools.partial(_chip_solve, k=k, exclude_self=True,
+                               domain=DOMAIN_SIZE, interpret=False,
+                               tile=cfg.stream_tile, kernel="kpass",
+                               epilogue=ep)
+        try:
+            outs[ep] = jax.eval_shape(fn, *state)
+        except Exception as e:  # noqa: BLE001 -- a failed trace IS the finding
+            ck.fail("route-shape", route,
+                    f"[{label},ep={ep}] abstract trace failed: "
+                    f"{type(e).__name__}: {e}",
+                    subject=f"{route}:trace:{ep}")
+            continue
+        _expect_result(ck, route, f"{label},ep={ep}", outs[ep], meta.pcap,
+                       k, with_count=False)
+    if len(outs) == 2 and _sig(outs["gather"]) != _sig(outs["scatter"]):
+        ck.fail("epilogue-agree", route,
+                f"[{label}] scatter and gather epilogues disagree abstractly",
+                subject=f"{route}:epilogue")
+    for ci, cp in enumerate(chip.classes):
+        if cp.route == "pallas":
+            _check_tiles(ck, route, f"{label},class={ci}", qcap=cp.qcap_pad,
+                         ccap=cp.ccap, k=k)
+
+
 def _mxu_fixture(points: np.ndarray, k: int, supercell: int,
                  recall_target: float = 0.9):
     """(cfg, grid, plan) for the adaptive route under ``scorer='mxu'`` --
@@ -899,9 +994,12 @@ def run_contracts(fault: Optional[str] = None) -> List[Finding]:
                 collapsed += len(skip)
                 checker(ck, pts, k, supercell, skip_eps=skip)
             _check_mxu_adaptive(ck, pts, k, supercell)
-            traced += 4  # the legacy representative + adaptive-mxu always
-            #              trace both epilogues (no mxu certificate collapse:
-            #              the MXU core has no legacy twin to be equivalent to)
+            _check_pod(ck, pts, k, supercell)
+            traced += 6  # the legacy representative + adaptive-mxu +
+            #              pod-chip always trace both epilogues (no
+            #              certificate collapse: the MXU core has no legacy
+            #              twin, and the pod window layout is its own plan
+            #              shape pinned by the equivalence 'pod' section)
     for k in (8, 50):
         for d in (3, 6):
             _check_mxu_brute(ck, k, d)

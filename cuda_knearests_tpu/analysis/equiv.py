@@ -51,7 +51,10 @@ EQUIV_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "equivalence.json")
 # schema 2: cells gained the "mxu" section (the adaptive-mxu plan shape's
 # full-trace hashes + per-class blocked-matmul core hashes, DESIGN.md s16)
-EQUIV_SCHEMA = 2
+# schema 3: cells gained the "pod" section (the pod-partitioned window's
+# plan shape: full _chip_solve trace hashes over the Morton-range layout,
+# decomposition facts, per-class capacities -- DESIGN.md s18)
+EQUIV_SCHEMA = 3
 
 # The (k, supercell) plan-shape matrix -- matches contracts.run_contracts.
 MATRIX: Tuple[Tuple[int, int], ...] = ((8, 2), (8, 3), (50, 2), (50, 3))
@@ -368,6 +371,44 @@ def _mxu_cell(points: np.ndarray, k: int, supercell: int) -> Dict[str, Any]:
     return out
 
 
+def _pod_cell(points: np.ndarray, k: int, supercell: int) -> Dict[str, Any]:
+    """The pod-partitioned plan shape's certificate section (DESIGN.md
+    section 18).  The pod route launches THE shared ``_chip_solve``
+    program (the binding the sharded-chip pairs already certify); what
+    can silently drift is the partitioned WINDOW feeding it -- the Morton
+    range split, ring depth, ext layout, and per-chip classes -- so this
+    section pins the full-trace hash of ``_chip_solve`` over the
+    pod-built window (both epilogue families) plus the decomposition
+    facts.  An uncertified edit to the partitioner gates as
+    ``route-diverge`` exactly like a core drift."""
+    import functools as _ft
+
+    import jax
+
+    from ..config import DOMAIN_SIZE
+    from ..parallel.sharded import _chip_solve
+    from .contracts import _pod_fixture
+
+    cfg, state, chip, meta = _pod_fixture(points, k, supercell)
+    out: Dict[str, Any] = {
+        "ndev": meta.ndev, "steps": meta.steps,
+        "trace_hashes": {}, "classes": [],
+    }
+    for epilogue in ("gather", "scatter"):
+        fn = _ft.partial(_chip_solve, k=k, exclude_self=True,
+                         domain=DOMAIN_SIZE, interpret=False,
+                         tile=cfg.stream_tile, kernel="kpass",
+                         epilogue=epilogue)
+        jx = jax.make_jaxpr(fn)(*state)
+        out["trace_hashes"][epilogue] = canonical_hash(jx)
+    for cp in chip.classes:
+        out["classes"].append({
+            "qcap": int(cp.qcap_pad), "ccap": int(cp.ccap),
+            "radius": int(cp.radius), "route": cp.route,
+        })
+    return out
+
+
 def build_certificates(fault: Optional[str] = None) -> Dict[str, Any]:
     """The full certificate object (the content of equivalence.json).
 
@@ -427,6 +468,7 @@ def build_certificates(fault: Optional[str] = None) -> Dict[str, Any]:
                 "pairs": pairs,
             }
         cell["mxu"] = _mxu_cell(points, k, supercell)
+        cell["pod"] = _pod_cell(points, k, supercell)
         cells.append(cell)
     return {"schema": EQUIV_SCHEMA, "cells": cells}
 

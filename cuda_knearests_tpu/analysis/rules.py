@@ -230,7 +230,8 @@ def _r_tracer_leak(ctx: FileContext) -> Iterator[Finding]:
                    "cuda_knearests_tpu/utils/", "cuda_knearests_tpu/api.py",
                    "cuda_knearests_tpu/cluster/",
                    "cuda_knearests_tpu/oracle.py",
-                   "cuda_knearests_tpu/mxu/"))
+                   "cuda_knearests_tpu/mxu/",
+                   "cuda_knearests_tpu/pod/"))
 def _r_wide_dtype(ctx: FileContext) -> Iterator[Finding]:
     """f64/i64 on the host is silent 2x width -- fine when chosen (margin
     certificates accumulate in f64 deliberately; cell linearizations need
@@ -338,7 +339,8 @@ def _r_broad_except(ctx: FileContext) -> Iterator[Finding]:
                    "cuda_knearests_tpu/parallel/",
                    "cuda_knearests_tpu/serve/",
                    "cuda_knearests_tpu/cluster/",
-                   "cuda_knearests_tpu/mxu/"))
+                   "cuda_knearests_tpu/mxu/",
+                   "cuda_knearests_tpu/pod/"))
 def _r_bare_valueerror(ctx: FileContext) -> Iterator[Finding]:
     """The input front door (io.validate_or_raise) exists so that illegal
     input is refused with the TYPED taxonomy (utils/memory.py

@@ -54,7 +54,8 @@ _PKG_NAME = os.path.basename(_PKG_ROOT)
 # solve window.  analysis/ itself, the fuzz/bench harnesses, and the CLI
 # surfaces are out of scope (they *wrap* solve windows; their own fetches
 # would double-count the windows they measure).
-SCOPE = ("api.py", "ops", "parallel", "cluster", "serve", "runtime", "mxu")
+SCOPE = ("api.py", "ops", "parallel", "cluster", "serve", "runtime", "mxu",
+         "pod")
 
 _ANNOT_RE = re.compile(r"#\s*syncflow:\s*([A-Za-z0-9_-]+)")
 _DISPATCH_ALIASES = ("_dispatch", "dispatch")
@@ -67,7 +68,7 @@ class DiscoveredSite:
     path: str        # repo-relative, forward slashes
     line: int
     qualname: str    # module-dotted, e.g. 'ops.query.query_knn'
-    kind: str        # 'fetch' | 'stage' | 'raw'
+    kind: str        # 'fetch' | 'stage' | 'ici' | 'raw'
     site_id: Optional[str]   # the `# syncflow:` annotation, if any
     in_loop: bool    # lexically inside a for/while loop
 
@@ -75,9 +76,13 @@ class DiscoveredSite:
 @dataclasses.dataclass(frozen=True)
 class SiteSpec:
     """A window's claim on one site: how often it fires per window and how
-    many bytes ride it, symbolically in the window parameters."""
+    many bytes ride it, symbolically in the window parameters.  Kind
+    'ici' is chip-to-chip interconnect traffic (``dispatch.ici``, the pod
+    halo exchange): counted bytes, NEVER a host sync -- it contributes to
+    a window's byte model but can never appear in its ``syncs``
+    expression."""
 
-    kind: str        # 'fetch' | 'stage'
+    kind: str        # 'fetch' | 'stage' | 'ici'
     mult: str        # symbolic count per window, e.g. '1', 'fb', 'rounds'
     bytes: str       # symbolic byte volume per window
 
@@ -116,8 +121,12 @@ class Window:
 #   rounds   FoF pointer-jumping rounds until convergence
 #   tomb     1 when a serving row touched a deleted point
 #   delta    1 when the dirty-cell bound could not prune the delta launch
+#   steps    pod halo-exchange ring depth (ppermute rounds per direction)
+#   hcap     pod export-block capacity (points per halo block)
+#   ndev     chips in the pod mesh
+#   xchg     1 on the solve that runs the (cached) pod halo exchange
 PARAMS = ("n", "q", "k", "chunks", "classes", "kern", "fb", "u_pad", "u_q",
-          "rounds", "tomb", "delta")
+          "rounds", "tomb", "delta", "steps", "hcap", "ndev", "xchg")
 
 WINDOWS: Dict[str, Window] = {
     # KnnProblem.solve() -- shared by the adaptive and legacy-pack routes:
@@ -257,6 +266,37 @@ WINDOWS: Dict[str, Window] = {
         entries=("serve.fleet.sidecar.CpuSidecar.query",),
         sites={},
         syncs="0", budget="0"),
+    # Pod-partitioned solve (pod/, DESIGN.md section 18): ONE batched
+    # fetch assembles every chip's rows; uncertified rows resolve against
+    # the HOST kd-tree (zero syncs).  The halo exchange is the pod-ici
+    # site: ``xchg`` (1 on the first solve, cached after) ppermute rounds
+    # whose exact wire volume -- per ring step and direction, every link
+    # of the chip chain ships one hcap-point block (16 bytes/point) -- is
+    # ICI traffic, counted in ici_bytes and NEVER in host_syncs.  That
+    # accounting split is this window's central claim: halos are
+    # interconnect, not host traffic, so host_syncs stays at 1 <= 2.
+    "pod-solve": Window(
+        entries=("pod.solve.PodKnnProblem.solve",),
+        sites={
+            "pod-solve-final": SiteSpec("fetch", "1", "0"),
+            "pod-ici": SiteSpec("ici", "xchg",
+                                "32*hcap*steps*(ndev - 1)"),
+        },
+        syncs="1", budget="2"),
+    # Pod external query: per-chip per-class launches (the shared
+    # launch_class_query front half) collect in one batched fetch;
+    # classless/uncertified rows resolve on the host oracle.  A query on
+    # a never-solved problem triggers the cached exchange, so pod-ici is
+    # claimed here too.
+    "pod-query": Window(
+        entries=("pod.solve.PodKnnProblem.query",),
+        sites={
+            "pod-query-final": SiteSpec("fetch", "1", "0"),
+            "query-class-stage": SiteSpec("stage", "5*classes", "0"),
+            "pod-ici": SiteSpec("ici", "xchg",
+                                "32*hcap*steps*(ndev - 1)"),
+        },
+        syncs="1", budget="2"),
 }
 
 # Which model window proves each runtime route's bound -- the route names
@@ -274,6 +314,8 @@ ROUTE_WINDOWS: Dict[str, str] = {
     "fleet-batch": "fleet-batch",
     "fleet-replica-apply": "fleet-replica-apply",
     "fleet-sidecar": "fleet-sidecar",
+    "pod-solve": "pod-solve",
+    "pod-query": "pod-query",
 }
 
 # Sanctioned dispatch sites that live OUTSIDE every solve window: lazy
@@ -289,6 +331,11 @@ NONWINDOW: Dict[str, str] = {
                         "readback of the (host-resident) result plus the "
                         "permutation -- outside the solve window by the "
                         "timing contract",
+    "pod-prepare-stage": "pod prepare's streamed slab staging: each "
+                         "chip's bucket rides its own counted async H2D "
+                         "transfer (the HBM auto-splitter's whole point, "
+                         "DESIGN.md section 18) -- prepare-time traffic, "
+                         "zero syncs, outside every solve window",
 }
 
 # Raw readbacks (jax.device_get / from_device) the model accepts, by
@@ -333,7 +380,8 @@ def evaluate(expr: str, env: Dict[str, int]) -> int:
 def worst_case_env(rounds: int = 64) -> Dict[str, int]:
     """Indicator variables at their maxima -- what the budget proof binds."""
     return dict(fb=1, tomb=1, delta=1, kern=1, rounds=rounds,
-                chunks=8, classes=8, n=1, q=1, k=1, u_pad=1, u_q=1)
+                chunks=8, classes=8, n=1, q=1, k=1, u_pad=1, u_q=1,
+                steps=8, hcap=1, ndev=8, xchg=1)
 
 
 # -- discovery ----------------------------------------------------------------
@@ -410,7 +458,7 @@ class _SiteVisitor(ast.NodeVisitor):
             base = f.value
             if isinstance(base, ast.Name):
                 if base.id in _DISPATCH_ALIASES \
-                        and f.attr in ("fetch", "stage"):
+                        and f.attr in ("fetch", "stage", "ici"):
                     self._add(node, f.attr)
                 elif base.id == "jax" and f.attr == "device_get":
                     self._add(node, "raw")

@@ -251,6 +251,12 @@ def _route_signatures(seed: int) -> Dict[str, tuple]:
     args, statics = _mxu_brute_abstract(k, 3)
     out["mxu-brute"] = signature(args, statics["k"], statics["m"],
                                  statics["qc"])
+    from .contracts import _pod_fixture
+
+    _pcfg, pstate, pchip, _pmeta = _pod_fixture(pts, k, supercell)
+    out["pod-chip"] = signature(
+        pstate, *(cp.qcap_pad for cp in pchip.classes),
+        *(cp.ccap for cp in pchip.classes), k)
     return out
 
 
@@ -336,6 +342,8 @@ def check_equivalence(fault: Optional[str] = None) -> List[Finding]:
                         f"k={fc['k']},s={fc['supercell']},{fam}")
             if fc.get("mxu") != cc.get("mxu"):
                 diverged.append(f"k={fc['k']},s={fc['supercell']},mxu")
+            if fc.get("pod") != cc.get("pod"):
+                diverged.append(f"k={fc['k']},s={fc['supercell']},pod")
         _fail(findings, "route-diverge", "equivalence",
               f"regenerated certificates diverge from the committed "
               f"analysis/equivalence.json at {diverged or ['<structure>']}"
@@ -381,6 +389,26 @@ def check_equivalence(fault: Optional[str] = None) -> List[Finding]:
                        "to the MXU scorer, or an epilogue trace failed; "
                        "fix and re-bless with --write-equivalence",
                   subject=f"equiv:mxu:{label}")
+        pod = cell.get("pod") or {}
+        pod_eps = sorted(pod.get("trace_hashes", {}))
+        if pod.get("classes") and len(pod_eps) == 2:
+            _info(findings, "route-equiv", "equivalence",
+                  f"[{label}] pod plan shape pinned: "
+                  f"{len(pod['classes'])} class(es) over the "
+                  f"ndev={pod.get('ndev')} Morton-range window (ring "
+                  f"depth {pod.get('steps')}) + both epilogue traces "
+                  f"(drift gates as route-diverge)",
+                  subject=f"equiv:pod:{label}")
+        else:
+            _fail(findings, "route-diverge", "equivalence",
+                  f"[{label}] pod certificate section is empty or partial "
+                  f"(classes={len(pod.get('classes', ()))}, "
+                  f"epilogues={pod_eps}): the partitioned plan shape lost "
+                  f"its drift pin",
+                  hint="the pod fixture stopped planning classes over the "
+                       "Morton-range window, or an epilogue trace failed; "
+                       "fix and re-bless with --write-equivalence",
+                  subject=f"equiv:pod:{label}")
     return findings
 
 

@@ -67,11 +67,24 @@ def edges_from_neighbors(nbrs: np.ndarray, symmetric: bool = False
     return edges
 
 
-def _config_adaptive_eligible(cfg) -> bool:
+def _config_adaptive_eligible(cfg, per_chip: bool = False) -> bool:
     """THE adaptive-route predicate: prepare's fail-fast scorer guard and
     solve-time routing must agree on it, or a scorer='mxu' config that
     passes the refusal can still route legacy and silently score
-    elementwise (the exact case the guard exists to prevent)."""
+    elementwise (the exact case the guard exists to prevent).
+
+    ``per_chip=True`` is the sharded/pod form of the same agreement: the
+    per-chip solves ALWAYS run the adaptive class machinery (build_class_
+    specs routes eligible classes to the MXU scorer under
+    ``resolved_scorer() == 'mxu'``, with the per-chip recall_target pools
+    of DESIGN.md section 18), so only the arithmetic contract matters --
+    the class scorers realize distances in 'diff' arithmetic, and the
+    single-chip routing knobs (adaptive, backend) are not consulted by
+    the per-chip route.  Both prepare-time guards (ShardedKnnProblem /
+    PodKnnProblem) and build_class_specs' routing read THIS predicate, so
+    they cannot disagree."""
+    if per_chip:
+        return cfg.dist_method == "diff"
     if not (cfg.adaptive and cfg.dist_method == "diff"):
         return False
     if cfg.backend == "auto":

@@ -75,6 +75,16 @@ def main(argv=None) -> int:
                          "the tie-aware comparison; failures ddmin over "
                          "the op stream and bank as *-fleet.npz -- see "
                          "fuzz/fleet.py")
+    ap.add_argument("--pod", action="store_true",
+                    help="run the POD campaign instead: --cases "
+                         "boundary-weighted zoo clouds (power-law clusters "
+                         "and grid-plane-aligned cases -- population-"
+                         "balanced Morton splits put range boundaries "
+                         "inside the dense regions) through the cell-"
+                         "partitioned route on an emulated multi-chip mesh "
+                         "vs the kd-tree oracle AND the single-chip "
+                         "adaptive route, tie-aware; failures minimized "
+                         "and banked as *-pod.npz -- see fuzz/pod.py")
     ap.add_argument("--fof", action="store_true",
                     help="run the FoF campaign instead: --cases clustering "
                          "cases (the same adversarial zoo + seeded linking "
@@ -116,26 +126,43 @@ def main(argv=None) -> int:
 
     # Emulated mesh BEFORE any jax import: the sharded route needs > 1
     # device to exercise its halo exchange on CPU-only hosts (same
-    # mechanism as tests/conftest.py).
+    # mechanism as tests/conftest.py).  The pod campaign partitions CELLS
+    # across chips, so it forces at least 4 devices -- fewer would leave
+    # most range boundaries (and the ring exchange) unexercised.
+    n_dev = max(1, args.devices)
+    if args.pod:
+        n_dev = max(4, n_dev)
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count="
-            f"{max(1, args.devices)}").strip()
+            f"{n_dev}").strip()
 
     flavors = [f for f, on in (("--fof", args.fof),
                                ("--approx", args.approx),
                                ("--fleet", args.fleet),
+                               ("--pod", args.pod),
                                ("--mutations", args.mutations is not None))
                if on]
     if len(flavors) > 1:
         ap.error(f"{' and '.join(flavors)} are mutually exclusive campaigns")
-    if (args.fof or args.approx or args.fleet) and args.routes:
+    single_route = args.fof or args.approx or args.fleet or args.pod
+    if single_route and args.routes:
         ap.error("--routes applies to the point-case campaign only; the "
-                 "FoF, approx and fleet campaigns each have a single route")
-    if (args.fof or args.approx or args.fleet) and args.isolation != "auto":
+                 "FoF, approx, fleet and pod campaigns each have a single "
+                 "route")
+    if single_route and args.isolation != "auto":
         ap.error("--isolation applies to the point-case campaign only; "
-                 "FoF, approx and fleet cases run in-process")
+                 "FoF, approx, fleet and pod cases run in-process")
+
+    if args.pod:
+        from .pod import run_pod_campaign
+
+        kwargs = {} if args.bank_dir is None else {"bank_dir": args.bank_dir}
+        manifest = run_pod_campaign(
+            n_cases=args.cases, seed=args.seed, budget_s=budget,
+            minimize=not args.no_minimize, ndev=n_dev, **kwargs)
+        return _finish_campaign(manifest, args, "POD FUZZ FAILED")
 
     if args.fleet:
         from .fleet import run_fleet_campaign

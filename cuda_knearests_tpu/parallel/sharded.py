@@ -450,11 +450,12 @@ def _chip_ready_state(spts, sids, counts, lo_pts, lo_ids, lo_counts,
 
 @functools.partial(jax.jit, static_argnames=("k", "exclude_self", "domain",
                                              "interpret", "tile", "kernel",
-                                             "epilogue"))
+                                             "epilogue", "recall_target"))
 def _chip_solve(spts, ext_pts, ext_ids, ext_starts, ext_counts,
                 classes: Tuple[ClassPlan, ...], inv_loc, lo_rows, hi_rows,
                 k: int, exclude_self: bool, domain: float, interpret: bool,
-                tile: int, kernel: str = "kpass", epilogue: str = "gather"):
+                tile: int, kernel: str = "kpass", epilogue: str = "gather",
+                recall_target: float = 1.0):
     """One chip's steady-state solve over its prepared state: per-class
     launches (prepacked kernel inputs for pallas routes), the local-row
     un-pad (epilogue='gather': row-major concat + one gather through
@@ -471,12 +472,13 @@ def _chip_solve(spts, ext_pts, ext_ids, ext_starts, ext_counts,
 
         row_d, row_i = _scatter_classes(
             ext_pts, ext_starts, ext_counts, classes, pcap, k, exclude_self,
-            tile, interpret, kernel)
+            tile, interpret, kernel, recall_target)
     else:
         flats_d, flats_i = [], []
         for cp in classes:
             fd, fi = _class_flat(ext_pts, ext_starts, ext_counts, cp, k,
-                                 exclude_self, tile, interpret, kernel)
+                                 exclude_self, tile, interpret, kernel,
+                                 recall_target)
             flats_d.append(fd)
             flats_i.append(fi)
         all_d, all_i = _rows2d(flats_d, flats_i, classes, k)
@@ -592,18 +594,24 @@ class ShardedKnnProblem:
                 "backend='oracle' is a single-chip host engine; the sharded "
                 "path runs grid engines only ('auto'/'pallas'/'xla')")
         if config.resolved_scorer() == "mxu":
-            # same fail-fast rule as KnnProblem.prepare's scorer guard: the
-            # per-chip class solves have no recall_target plumbing, so an
-            # mxu config here would silently run exact selection and ignore
-            # the configured approximation budget
-            raise InvalidConfigError(
-                f"scorer='mxu' (recall_target={config.recall_target}) has "
-                f"no sharded implementation: per-chip class solves would "
-                f"silently run exact selection, ignoring the approximation "
-                f"budget -- use the single-chip adaptive route "
-                f"(KnnProblem.prepare) or the brute/MXU route "
-                f"(cuda_knearests_tpu.mxu.solve_general); sharded solves "
-                f"stay elementwise-exact")
+            # the PR 9 typed refusal is LIFTED (ISSUE 12): per-chip class
+            # solves now thread recall_target into the shared class
+            # machinery (build_class_specs routes eligible classes to the
+            # MXU scorer; _chip_solve passes the per-chip G*m pool budget
+            # through _class_flat/_scatter_classes), so the approximate
+            # frontier and pod scale multiply.  Only the arithmetic
+            # contract still gates, via the SAME shared predicate the
+            # single-chip guard reads -- prepare-time guard and solve-time
+            # routing cannot disagree.
+            from ..api import _config_adaptive_eligible
+
+            if not _config_adaptive_eligible(config, per_chip=True):
+                raise InvalidConfigError(
+                    f"scorer='mxu' (recall_target={config.recall_target}) "
+                    f"composes with the per-chip class solves only under "
+                    f"dist_method='diff' (got {config.dist_method!r}): "
+                    f"the class scorers realize distances in diff "
+                    f"arithmetic")
         if mesh is None:
             n_devices = n_devices or len(jax.devices())
             mesh = jax.make_mesh((n_devices,), ("z",))
@@ -788,7 +796,8 @@ class ShardedKnnProblem:
                 spts, ext_pts, ext_ids, ext_starts,
                 ext_counts, classes, inv_loc, lo_rows, hi_rows,
                 cfg.k, cfg.exclude_self, meta.domain, cfg.interpret,
-                cfg.stream_tile, cfg.effective_kernel(), epilogue)
+                cfg.stream_tile, cfg.effective_kernel(), epilogue,
+                float(cfg.recall_target))
         # memoized for stats() margin telemetry (released by drop_ready)
         self._device_out_cache = outs
         return outs

@@ -1,0 +1,40 @@
+"""Pod-partitioned grid subsystem: the cell-sharded index (DESIGN.md s18).
+
+Every distributed surface before this one (parallel/sharded.py) splits the
+cloud into z-slabs whose halo is a fixed +-1 layer; this package is the
+SNIPPETS.md target statement done literally: grid cells are partitioned
+across chips as contiguous **z-order (Morton) ranges** balanced by point
+population, each chip builds and owns only its range's CSR, and only
+**boundary-cell candidates** move between chips -- over ICI, via
+``jax.lax.ppermute`` ring steps that widen exactly as far as the
+unconverged queries' candidate rings demand.  A 100M-point cloud never
+materializes on any single chip: per-chip (not per-pod) HBM is the limit,
+and the ``hbm_bytes_estimate`` preflight acts as the automatic splitter
+(clouds beyond one chip's budget stream through the partitioner in
+slab-sized host-to-device stages instead of refusing -- "Memory Safe
+Computations with XLA", arXiv 2206.14148).
+
+Layout:
+
+* :mod:`.partition` -- prepare-time planning, all host numpy: Morton cell
+  ranges, the replicated cell->chip directory, per-chip CSR layouts,
+  per-chip adaptive classes (the shared ops/adaptive machinery), export
+  blocks, and the measured ring depth.
+* :mod:`.halo`      -- the ICI exchange: one ``shard_map`` program whose
+  only communication is ``lax.ppermute`` ring steps; halo bytes and ring
+  depth are stamped as counters (``runtime.dispatch.ici``).
+* :mod:`.stream`    -- HBM auto-splitting: the per-chip footprint model
+  the preflight gates, and the streamed slab-by-slab staging.
+* :mod:`.solve`     -- :class:`PodKnnProblem`: prepare / solve / query,
+  composing with the PR 9 MXU scorer (``KnnConfig.scorer='mxu'`` with
+  per-chip ``recall_target`` pools).
+
+``python -m cuda_knearests_tpu.pod`` runs the CPU smoke (forced host
+devices): partitioned == single-chip pin, the streamed-prepare budget
+case, and the sync/ICI counter reconciliation -- wired into
+``scripts/check.sh``.
+"""
+
+from .solve import PodKnnProblem
+
+__all__ = ["PodKnnProblem"]

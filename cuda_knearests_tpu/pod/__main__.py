@@ -1,0 +1,253 @@
+"""Pod smoke + weak-scaling bench child.
+
+Smoke (default; wired into scripts/check.sh, CPU-only on forced host
+devices):
+
+  1. **Partition pin** -- the partitioned solve on the 20k fixture must be
+     tie-aware-identical to both the exact oracle and the single-chip
+     adaptive route, including ``scorer='mxu'`` at recall_target < 1.0
+     and = 1.0, and boundary-straddling external queries.
+  2. **Streamed prepare** -- under a budget between the per-chip high
+     water and the full-cloud model, prepare must stream (not refuse),
+     the per-chip model must stay under the budget while the full cloud
+     exceeds it, and the result must stay exact; a budget below any slab
+     must refuse with the typed oom taxonomy.
+  3. **Sync/ICI reconciliation** -- one solve window: host_syncs <= the
+     proven pod-solve bound, and the recorded ici_bytes must EQUAL the
+     decomposition's halo-byte model (the syncflow window's expression).
+
+``--bench`` runs one weak-scaling measurement (fixed points per chip on
+THIS process's device count -- the parent ``bench.py --pod-scaling``
+forces the device count per child via XLA_FLAGS) and emits one JSON row.
+
+Exit codes: 0 = all checks passed, 1 = a check failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _force_devices(n: int) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}").strip()
+
+
+def _sync_proof(route: str, host_syncs: int, env=None) -> dict:
+    from cuda_knearests_tpu.analysis.syncflow import (ROUTE_WINDOWS,
+                                                      WINDOWS,
+                                                      worst_case_env)
+
+    win = WINDOWS[ROUTE_WINDOWS[route]]
+    bound = win.syncs_bound({**worst_case_env(), **(env or {})})
+    return {"sync_bound_proved": bound, "sync_bound_expr": win.syncs,
+            "sync_bound_ok": host_syncs <= bound}
+
+
+def _smoke(n: int) -> int:
+    import numpy as np
+
+    from cuda_knearests_tpu import KnnConfig, KnnProblem
+    from cuda_knearests_tpu.fuzz.compare import check_route_result
+    from cuda_knearests_tpu.io import get_dataset, generate_uniform
+    from cuda_knearests_tpu.pod import PodKnnProblem
+    from cuda_knearests_tpu.runtime import dispatch as _dispatch
+    from cuda_knearests_tpu.utils.memory import LaunchBudgetError
+
+    import jax
+
+    ndev = len(jax.devices())
+    rc = 0
+
+    def row(check: str, ok: bool, **extra) -> None:
+        nonlocal rc
+        rc |= 0 if ok else 1
+        print(json.dumps({"check": check, "ok": bool(ok), **extra}),
+              flush=True)
+
+    try:
+        points = get_dataset("pts20K.xyz")
+    except Exception:  # noqa: BLE001 -- fixture-less checkout: synthesize
+        points = generate_uniform(20_000, seed=20)
+    if n and n < points.shape[0]:
+        points = np.ascontiguousarray(points[:n])
+    k = 10
+
+    # 1a. partitioned == oracle == single-chip (tie-aware)
+    _dispatch.reset_stats()
+    pp = PodKnnProblem.prepare(points, n_devices=ndev,
+                               config=KnnConfig(k=k))
+    ids, d2, _cert = pp.solve()
+    stats = _dispatch.stats()
+    ref_i, ref_d = pp._oracle().knn_all_points(k)
+    mm = check_route_result(points, points, ids, d2, ref_d, k)
+    sp = KnnProblem.prepare(points, KnnConfig(k=k))
+    sp.solve()
+    sd2 = np.empty_like(sp.get_dists_sq())
+    sd2[sp.get_permutation()] = sp.get_dists_sq()
+    mm2 = check_route_result(points, points, ids, d2, sd2, k)
+    row("pod-vs-single-chip-pin", mm is None and mm2 is None,
+        n=int(points.shape[0]), n_devices=ndev,
+        ring_depth=pp.meta.steps,
+        mismatch=None if mm is None else mm.render(),
+        single_chip_mismatch=None if mm2 is None else mm2.render())
+
+    # 1b. boundary-straddling external queries (jittered stored points:
+    # dense near every range boundary by construction)
+    rng = np.random.default_rng(3)
+    q = np.clip(points[rng.integers(0, points.shape[0], 512)]
+                + rng.normal(0, 1.0, (512, 3)).astype(np.float32),
+                0.0, 1000.0).astype(np.float32)
+    qi, qd = pp.query(q)
+    _qri, qrd = pp._oracle().knn(q, k)
+    mmq = check_route_result(points, q, qi, qd, qrd, k)
+    row("pod-query-pin", mmq is None,
+        mismatch=None if mmq is None else mmq.render())
+
+    # 1c. MXU composition: per-chip recall_target pools, both tiers
+    sub = np.ascontiguousarray(points[:4000])
+    sref_d = None
+    for rt in (0.9, 1.0):
+        pm = PodKnnProblem.prepare(sub, n_devices=ndev,
+                                   config=KnnConfig(k=k, scorer="mxu",
+                                                    recall_target=rt))
+        mi, md, _mc = pm.solve()
+        if sref_d is None:
+            o_i, sref_d = pm._oracle().knn_all_points(k)
+        mmm = check_route_result(sub, sub, mi, md, sref_d, k)
+        n_mxu = sum(cp.route == "mxu" for c in pm.chip_plans
+                    for cp in c.classes)
+        row(f"pod-mxu-rt{rt:g}", mmm is None and n_mxu > 0,
+            mxu_classes=n_mxu,
+            mismatch=None if mmm is None else mmm.render())
+
+    # 2. streamed prepare under a budget the full cloud exceeds
+    high = pp.hbm["hbm_high_water_bytes"]
+    full = pp.hbm["hbm_full_cloud_bytes"]
+    budget = (high + full) // 2
+    try:
+        ps = PodKnnProblem.prepare(points, n_devices=ndev,
+                                   config=KnnConfig(
+                                       k=k, hbm_budget_bytes=budget))
+        si, s_d2, _sc = ps.solve()
+        mms = check_route_result(points, points, si, s_d2, ref_d, k)
+        ok = (ps.hbm["streamed_prepare"]
+              and ps.hbm["hbm_high_water_bytes"] <= budget < full
+              and mms is None)
+        row("pod-streamed-prepare", ok, **ps.hbm)
+    except LaunchBudgetError as e:
+        row("pod-streamed-prepare", False, error=str(e))
+    try:
+        PodKnnProblem.prepare(points, n_devices=max(1, ndev // 2),
+                              config=KnnConfig(k=k,
+                                               hbm_budget_bytes=high // 8))
+        row("pod-budget-refusal", False,
+            error="undersized budget was not refused")
+    except LaunchBudgetError as e:
+        row("pod-budget-refusal", e.kind == "oom", kind=e.kind)
+
+    # 3. sync budget + ICI reconciliation (window around prepare+solve:
+    # prepare stages asynchronously and the exchange is ICI, so the only
+    # host syncs are the solve's)
+    proof = _sync_proof("pod-solve", stats.host_syncs)
+    ici_ok = stats.ici_bytes == pp.meta.halo_bytes()
+    row("pod-sync-ici", proof["sync_bound_ok"] and ici_ok,
+        host_syncs=stats.host_syncs, ici_bytes=stats.ici_bytes,
+        ici_model=pp.meta.halo_bytes(), halo_hcap=pp.meta.hcap, **proof)
+    return rc
+
+
+def _bench(points_per_chip: int, k: int) -> int:
+    import numpy as np
+
+    import jax
+
+    from cuda_knearests_tpu import KnnConfig
+    from cuda_knearests_tpu.cli import set_recall
+    from cuda_knearests_tpu.io import generate_uniform
+    from cuda_knearests_tpu.pod import PodKnnProblem
+    from cuda_knearests_tpu.runtime import dispatch as _dispatch
+
+    ndev = len(jax.devices())
+    n = points_per_chip * ndev
+    points = generate_uniform(n, seed=12)
+    _dispatch.reset_stats()
+    pp = PodKnnProblem.prepare(points, n_devices=ndev,
+                               config=KnnConfig(k=k))
+
+    def run():
+        jax.block_until_ready(
+            [o for o in pp.solve_device().values() if o is not None])
+
+    run()  # compile + warmup (runs the cached exchange too)
+    # the exchange fires once (cached after): its recorded wire volume
+    # lives in the prepare+warmup counter window
+    ici_bytes = _dispatch.stats().ici_bytes
+    iters = 2
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run()
+    s = (time.perf_counter() - t0) / iters
+    _dispatch.reset_stats()
+    neighbors, _d2, cert = pp.solve()
+    sync = _dispatch.stats()
+    sample = np.random.default_rng(8).permutation(n)[
+        : min(2000, n)].astype(np.int32)
+    ref_ids, _ = pp._oracle().knn(points[sample], k, exclude_ids=sample)
+    recall = set_recall(neighbors[sample], ref_ids)
+    row = {
+        "config": f"pod weak-scaling: {points_per_chip} points/chip over "
+                  f"{ndev} chip(s) (k={k}, cell-partitioned)",
+        "pod_scaling": True,
+        "value": round(n / s / ndev, 1), "unit": "queries/sec/chip",
+        "total_qps": round(n / s, 1), "n_devices": ndev,
+        "points_per_chip": points_per_chip, "n_points": n,
+        "solve_s": round(s, 4),
+        "recall": round(recall, 6),
+        "backend": pp.config.backend,
+        "ring_depth": pp.meta.steps,
+        "halo_bytes": pp.meta.halo_bytes(),
+        "ici_bytes": ici_bytes,
+        "certified_fraction": float(np.asarray(cert).mean()),
+        **pp.hbm,
+        "host_syncs": sync.host_syncs,
+        "d2h_bytes": sync.d2h_bytes,
+        **_sync_proof("pod-solve", sync.host_syncs),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(row), flush=True)
+    return 0 if row["sync_bound_ok"] and recall >= 0.999 else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cuda_knearests_tpu.pod",
+        description="Pod-partitioned grid smoke / weak-scaling bench "
+                    "child (DESIGN.md section 18).")
+    ap.add_argument("--bench", action="store_true",
+                    help="emit one weak-scaling JSON row instead of the "
+                         "smoke (the bench.py --pod-scaling child)")
+    ap.add_argument("--points-per-chip", type=int,
+                    default=int(os.environ.get("BENCH_POD_PPC", "20000")))
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--devices", type=int, default=4,
+                    help="forced host device count when no accelerator "
+                         "is attached (must be set before jax init)")
+    ap.add_argument("--smoke-n", type=int,
+                    default=int(os.environ.get("KNTPU_POD_SMOKE_N", "0")),
+                    help="cap the smoke fixture size (0 = full 20k)")
+    args = ap.parse_args(argv)
+    _force_devices(max(1, args.devices))
+    if args.bench:
+        return _bench(max(1, args.points_per_chip), max(1, args.k))
+    return _smoke(args.smoke_n)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

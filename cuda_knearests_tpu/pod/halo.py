@@ -1,0 +1,106 @@
+"""ICI halo exchange: ``lax.ppermute`` ring steps over the chip chain.
+
+The partitioner (partition.py) already decided, on the host, which cells
+cross chip boundaries and where every remote cell's points land inside
+each receiver's window -- so the device side of the exchange is pure data
+movement: each chip gathers its export block (the points of its cells
+that ANY other chip's candidate boxes reach) and the block rides the ring
+``steps`` times in each direction.  After step ``s`` of the forward ring
+a chip holds the export block of the chip ``s`` ranks below it; the
+backward ring mirrors it.  ``steps`` is the measured maximum ring
+distance any candidate box reaches (partition.py) -- queries whose rings
+stay chip-local are converged before the first step, and each additional
+step exists only because some still-unconverged query's ring crosses
+another range boundary (the widening rule; DESIGN.md section 18 has the
+convergence argument: after ``steps`` rounds every candidate cell of
+every query is resident, so the single-chip certificates apply verbatim).
+
+Everything here is ICI traffic: ``ppermute`` moves blocks chip-to-chip
+without touching the host.  The exchange's exact wire volume
+(``PodMeta.halo_bytes``) is recorded through ``runtime.dispatch.ici`` by
+the solve wrapper -- counted as ``ici_bytes``, never as a host sync,
+which is what keeps the pod-solve window inside the <= 2 host-round-trip
+budget (analysis/syncflow.py window 'pod-solve').
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.solve import _FAR
+from .partition import PodMeta
+
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+AXIS = "pod"
+
+
+def _make_exchange_fn(meta: PodMeta):
+    ndev, steps, hcap = meta.ndev, meta.steps, meta.hcap
+    fwd = [(i, i + 1) for i in range(ndev - 1)]   # block of d lands on d+1
+    bwd = [(i + 1, i) for i in range(ndev - 1)]   # block of d lands on d-1
+
+    def exchange(bucket_pts, bucket_ids, export_idx):
+        pts, ids, idx = bucket_pts[0], bucket_ids[0], export_idx[0]
+        ok = idx >= 0
+        safe = jnp.clip(idx, 0, pts.shape[0] - 1)
+        blk_p = jnp.where(ok[:, None], jnp.take(pts, safe, axis=0), _FAR)
+        blk_i = jnp.where(ok, jnp.take(ids, safe), -1)
+        halo_p, halo_i = [], []
+        cur_p, cur_i = blk_p, blk_i
+        for _ in range(steps):
+            # forward ring: after s steps this chip holds chip (d-s)'s
+            # block; edge chips with no left neighbor receive zeros, whose
+            # rows no ext cell ever references (the directory knows there
+            # is no owner below chip 0)
+            cur_p = jax.lax.ppermute(cur_p, AXIS, fwd)
+            cur_i = jax.lax.ppermute(cur_i, AXIS, fwd)
+            halo_p.append(cur_p)
+            halo_i.append(cur_i)
+        cur_p, cur_i = blk_p, blk_i
+        for _ in range(steps):
+            cur_p = jax.lax.ppermute(cur_p, AXIS, bwd)
+            cur_i = jax.lax.ppermute(cur_i, AXIS, bwd)
+            halo_p.append(cur_p)
+            halo_i.append(cur_i)
+        if halo_p:
+            hp = jnp.stack(halo_p)                      # (2*steps, hcap, 3)
+            hi = jnp.stack(halo_i)                      # (2*steps, hcap)
+        else:  # single chip / fully local: an empty halo region
+            hp = jnp.zeros((0, hcap, 3), jnp.float32)
+            hi = jnp.zeros((0, hcap), jnp.int32)
+        return hp[None], hi[None]
+
+    return exchange
+
+
+@functools.lru_cache(maxsize=32)
+def exchange_program(meta: PodMeta, mesh: Mesh):
+    """Jitted shard_map exchange, cached by the (hashable) decomposition."""
+    spec = P(AXIS)
+    return jax.jit(_shard_map(
+        _make_exchange_fn(meta), mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=(spec, spec)))
+
+
+def stage_sharded(host_arrays, mesh: Mesh, stage_one):
+    """Stage a (ndev, ...) host array slab by slab: each chip's block rides
+    its own counted H2D transfer (``stage_one`` = the dispatch.stage
+    closure the caller annotates), and the full array exists on device only
+    as the sharded assembly of per-chip blocks -- the streamed-prepare
+    contract (stream.py): no monolithic upload, per-chip HBM the limit."""
+    devices = list(mesh.devices.ravel())
+    sharding = NamedSharding(mesh, P(AXIS))
+    out = []
+    for arr in host_arrays:
+        shards = [stage_one(arr[d: d + 1], devices[d])
+                  for d in range(len(devices))]
+        out.append(jax.make_array_from_single_device_arrays(
+            arr.shape, sharding, shards))
+    return tuple(out)

@@ -1,0 +1,458 @@
+"""Prepare-time grid partitioner: Morton cell ranges, directory, CSR layout.
+
+All host numpy (the same planning-on-host / solving-on-device split as
+every other planner in this tree): the partitioner reads one global cell
+histogram -- O(cells) host work, the exact census ``ops/rings`` already
+computes for the single-chip adaptive planner -- and from it derives
+
+* the **z-order partition**: supercells sorted by Morton code, split into
+  ``ndev`` contiguous rank ranges balanced by point population (a chip
+  owns every cell of every supercell in its range -- supercells partition
+  cells, so this IS a contiguous z-order cell-range partition at
+  supercell granularity, and the per-query adaptive machinery applies per
+  chip unchanged);
+* the **directory**: the (ndev+1,) Morton-rank bounds array -- the small
+  replicated cell->chip map (owner of a cell = ``searchsorted(bounds,
+  rank_of[supercell_of(cell)])``), staged replicated onto every chip and
+  kept as the host twin that routes external queries;
+* each chip's **ext window layout**: its own cells' CSR (starts/counts
+  over the chip-local sorted point array) followed by every remote cell
+  any of its candidate boxes reaches, each remote cell resolving to a
+  fixed offset inside the owning chip's export block -- the whole
+  exchange schedule is static, so the device-side halo exchange is
+  nothing but ``ppermute`` of fixed-size blocks (halo.py);
+* each chip's **adaptive classes** over that window (the shared
+  ``ops/adaptive.build_class_specs``), including MXU-routed classes when
+  ``cfg.resolved_scorer() == 'mxu'`` -- the per-chip recall_target
+  composition ISSUE 12 lifts the sharded refusal for.
+
+Ring depth is MEASURED, not assumed: ``steps`` = the maximum Morton-rank
+ring distance between any chip and the owner of any cell its queries'
+candidate boxes reach.  Queries whose rings stay chip-local cost zero
+exchange; the widening steps exist exactly for the boundary-crossing
+(statically "unconverged") queries -- see DESIGN.md section 18 for the
+convergence argument.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import DOMAIN_SIZE, KnnConfig
+from ..ops.adaptive import ClassPlan, build_class_specs, select_radii
+from ..ops.rings import ring_occupancy
+from ..ops.solve import _FAR, _round_up
+
+
+def morton3(coords: np.ndarray) -> np.ndarray:
+    """Morton (z-order) codes of (m, 3) integer coords, host i64.
+
+    Bits of x/y/z interleave x-minor; 21 bits per axis of headroom (the
+    supercell grid tops out near 10^3 per axis at the roadmap's scale, so
+    the interleave can never collide).  Host-only: the codes exist to sort
+    and split the supercell list; nothing i64 is ever staged."""
+    c = coords.astype(np.int64)  # kntpu-ok: wide-dtype -- 3x21-bit interleave headroom, host-only
+    out = np.zeros(c.shape[0], dtype=np.int64)  # kntpu-ok: wide-dtype -- 3x21-bit interleave headroom, host-only
+    for bit in range(21):
+        for ax in range(3):
+            out |= ((c[:, ax] >> bit) & 1) << (3 * bit + ax)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class PodDirectory:
+    """The replicated cell->chip ownership map (host twin).
+
+    ``order``  -- (n_sc_total,) global supercell id per Morton rank.
+    ``rank_of`` -- (n_sc_total,) Morton rank per global supercell id.
+    ``bounds`` -- (ndev+1,) i32 rank boundaries: chip d owns Morton ranks
+    [bounds[d], bounds[d+1]).  This tiny array IS the directory -- it is
+    what prepare stages replicated onto every chip (solve.PodKnnProblem's
+    ``dev['directory']``), and what routes every external query to its
+    owning chip on the host."""
+
+    order: np.ndarray
+    rank_of: np.ndarray
+    bounds: np.ndarray
+
+    def chip_of_rank(self, rank: np.ndarray) -> np.ndarray:
+        return (np.searchsorted(self.bounds, rank, side="right") - 1) \
+            .astype(np.int32)
+
+    def chip_of_sc(self, sc_id: np.ndarray) -> np.ndarray:
+        return self.chip_of_rank(self.rank_of[sc_id])
+
+
+@dataclasses.dataclass(frozen=True)
+class PodMeta:
+    """Hashable static decomposition metadata (keys the exchange program
+    cache, halo.py)."""
+
+    ndev: int
+    dim: int
+    supercell: int
+    pcap: int       # per-chip own-point capacity (max population, 8-padded)
+    hcap: int       # export-block capacity (max export population, 8-padded)
+    steps: int      # measured ring depth (ppermute rounds per direction)
+    domain: float
+
+    @property
+    def n_ext(self) -> int:
+        """Rows of one chip's halo-extended point window."""
+        return self.pcap + 2 * self.steps * self.hcap
+
+    def halo_base(self, receiver: int, owner: int) -> int:
+        """Ext-row offset of ``owner``'s export block inside ``receiver``'s
+        window: forward-ring blocks (owners below) at slots 0..steps-1,
+        backward-ring blocks (owners above) at slots steps..2*steps-1 --
+        the exact landing order halo.py's ppermute pipeline produces."""
+        if owner < receiver:
+            slot = receiver - owner - 1
+        else:
+            slot = self.steps + (owner - receiver - 1)
+        return self.pcap + slot * self.hcap
+
+    def halo_bytes(self) -> int:
+        """Exact wire volume of the exchange: per ring step and direction,
+        every link of the (non-wrapping) chip chain ships one export block
+        -- hcap points (12 B) + ids (4 B).  The same expression the
+        pod-solve syncflow window declares; dispatch.ici records exactly
+        this, and tests/test_pod.py reconciles the two."""
+        return 32 * self.hcap * self.steps * (self.ndev - 1)
+
+
+@dataclasses.dataclass
+class PodChipPlan:
+    """One chip's static schedule: classes over its ext window + layout."""
+
+    classes: Tuple[ClassPlan, ...]
+    class_of: np.ndarray    # (n_sc_local,) class per owned supercell (-1)
+    row_of: np.ndarray      # (n_sc_local,) row within the class's tables
+    sc_ids: np.ndarray      # (n_sc_local,) global supercell ids (Morton order)
+    ext_starts: np.ndarray  # (n_ext_cells,) i32 ext-row start per ext cell
+    ext_counts: np.ndarray  # (n_ext_cells,) i32 points per ext cell
+    export_idx: np.ndarray  # (hcap,) i32 own-region rows to export, -1 pad
+    n_local: int            # real points on this chip
+    remote_cells: int       # halo cells this chip's boxes reach
+    max_owner_dist: int     # ring distance to the farthest needed owner
+
+
+@dataclasses.dataclass
+class PodPlan:
+    """Everything prepare computed on the host, pre-staging."""
+
+    meta: PodMeta
+    directory: PodDirectory
+    chips: List[PodChipPlan]
+    bucket_pts: np.ndarray   # (ndev, pcap, 3) f32, FAR-pad, local cell order
+    bucket_ids: np.ndarray   # (ndev, pcap) i32 original index, -1 pad
+    chip_of_point: np.ndarray  # (n,) i32 owning chip per original point
+
+
+def _sc_cells(sc: np.ndarray, s: int, dim: int) -> np.ndarray:
+    """(m, s^3) global cell ids of each supercell's own cells, -1 where the
+    cell falls outside the grid (edge supercells)."""
+    offs = np.arange(s, dtype=np.int64)  # kntpu-ok: wide-dtype -- dim^2 linearization headroom, host-only
+    ax = sc[:, :, None].astype(np.int64) * s + offs[None, None, :]  # kntpu-ok: wide-dtype -- dim^2 linearization headroom, host-only
+    x, y, z = ax[:, 0], ax[:, 1], ax[:, 2]
+    ok = ((x[:, None, None, :] < dim) & (y[:, None, :, None] < dim)
+          & (z[:, :, None, None] < dim))
+    lin = (np.clip(x, 0, dim - 1)[:, None, None, :]
+           + dim * np.clip(y, 0, dim - 1)[:, None, :, None]
+           + dim * dim * np.clip(z, 0, dim - 1)[:, :, None, None])
+    return np.where(ok, lin, -1).reshape(sc.shape[0], s ** 3).astype(np.int64)  # kntpu-ok: wide-dtype -- cell ids reach dim^3, host-only
+
+
+def _box_cells(sc: np.ndarray, radius: int, s: int, dim: int) -> np.ndarray:
+    """(m, (s+2r)^3) global cell ids of each supercell's dilated candidate
+    box clamped to the grid, -1 outside -- same geometry as the single-chip
+    planner's candidate tables (ops/adaptive), in global cell ids."""
+    side = s + 2 * radius
+    offs = np.arange(-radius, s + radius, dtype=np.int64)  # kntpu-ok: wide-dtype -- dim^2 linearization headroom, host-only
+    ax = sc[:, :, None].astype(np.int64) * s + offs[None, None, :]  # kntpu-ok: wide-dtype -- dim^2 linearization headroom, host-only
+    x, y, z = ax[:, 0], ax[:, 1], ax[:, 2]
+    ok = ((x[:, None, None, :] >= 0) & (x[:, None, None, :] < dim)
+          & (y[:, None, :, None] >= 0) & (y[:, None, :, None] < dim)
+          & (z[:, :, None, None] >= 0) & (z[:, :, None, None] < dim))
+    lin = (np.clip(x, 0, dim - 1)[:, None, None, :]
+           + dim * np.clip(y, 0, dim - 1)[:, None, :, None]
+           + dim * dim * np.clip(z, 0, dim - 1)[:, :, None, None])
+    return np.where(ok, lin, -1).reshape(sc.shape[0], side ** 3).astype(np.int64)  # kntpu-ok: wide-dtype -- cell ids reach dim^3, host-only
+
+
+def build_directory(counts_sc: np.ndarray, sc_coords: np.ndarray,
+                    ndev: int) -> PodDirectory:
+    """Morton-sort the supercells and split into ndev contiguous rank
+    ranges balanced by point population (prefix split on the cumulative
+    counts; degenerate clouds may leave trailing chips empty -- an empty
+    range is a legal slab, like the sharded route's empty z-slabs)."""
+    codes = morton3(sc_coords)
+    order = np.argsort(codes, kind="stable").astype(np.int32)
+    rank_of = np.empty_like(order)
+    rank_of[order] = np.arange(order.size, dtype=np.int32)
+    cum = np.cumsum(counts_sc[order])  # i64 population prefix sums
+    total = int(cum[-1]) if cum.size else 0
+    targets = [total * d // ndev for d in range(1, ndev)]
+    inner = np.searchsorted(cum, targets, side="left") + 1
+    inner = np.minimum(np.maximum.accumulate(inner), order.size)
+    bounds = np.concatenate([[0], inner, [order.size]]).astype(np.int32)
+    return PodDirectory(order=order, rank_of=rank_of, bounds=bounds)
+
+
+def build_pod_plan(points: np.ndarray, ndev: int, cfg: KnnConfig, dim: int,
+                   on_kernel_platform: bool) -> PodPlan:
+    """The whole prepare-time decomposition (see module docstring)."""
+    n = points.shape[0]
+    s = cfg.supercell
+    n_sc_side = -(-dim // s)
+    w = DOMAIN_SIZE / dim
+
+    coords = np.clip((points * (dim / DOMAIN_SIZE)).astype(np.int64),  # kntpu-ok: wide-dtype -- dim^2 linearization headroom, host-only
+                     0, dim - 1)
+    cell_of = coords[:, 0] + dim * coords[:, 1] + dim * dim * coords[:, 2]
+    counts3 = np.bincount(cell_of, minlength=dim ** 3) \
+        .reshape(dim, dim, dim)
+    scc = coords // s
+    sc_of = (scc[:, 0] + n_sc_side * scc[:, 1]
+             + n_sc_side * n_sc_side * scc[:, 2])
+    counts_sc = np.bincount(sc_of, minlength=n_sc_side ** 3)
+
+    r = np.arange(n_sc_side, dtype=np.int32)
+    zz, yy, xx = np.meshgrid(r, r, r, indexing="ij")
+    sc_all = np.stack([xx.ravel(), yy.ravel(), zz.ravel()], axis=1)
+
+    directory = build_directory(counts_sc, sc_all, ndev)
+    chip_of_sc_all = directory.chip_of_rank(directory.rank_of)
+
+    # global ring occupancy + radii: the identical signal the single-chip
+    # planner reads, computed once and sliced per chip, so per-supercell
+    # radii (and therefore halo reach) agree with single-chip planning
+    if cfg.ring_radius is not None:
+        rmax = max(1, int(cfg.ring_radius))
+    else:
+        from ..config import default_ring_radius
+
+        rmax = int(min(dim, max(6, 2 * default_ring_radius(cfg.k,
+                                                           cfg.density))))
+    pts_cum, cells_cum = ring_occupancy(counts3, sc_all, s, rmax)
+    if cfg.ring_radius is not None:
+        radii_all = np.full((sc_all.shape[0],), rmax, np.int32)
+    else:
+        radii_all = select_radii(pts_cum, cells_cum, cfg.k, rmax)
+
+    # -- pass A: per-chip supercells, classes, candidate boxes, halo needs --
+    cnt_flat = counts3.reshape(-1)
+    per_chip: List[dict] = []
+    needed: Dict[int, set] = {o: set() for o in range(ndev)}  # owner -> cells
+    for d in range(ndev):
+        ranks = np.arange(directory.bounds[d], directory.bounds[d + 1])
+        sc_ids = directory.order[ranks]
+        sc_d = sc_all[sc_ids]
+        own_n = counts_sc[sc_ids]
+        if own_n.sum() == 0:
+            per_chip.append(dict(sc_ids=sc_ids, specs=(), boxes={},
+                                 box_reach={},
+                                 own_cells=np.empty((0,), np.int64),  # kntpu-ok: wide-dtype -- cell-id table, host-only
+                                 own_tab=np.empty((0, s ** 3), np.int64)))  # kntpu-ok: wide-dtype -- cell-id table, host-only
+            continue
+        specs = build_class_specs(own_n, pts_cum[sc_ids], radii_all[sc_ids],
+                                  cfg, on_kernel_platform)
+        own_tab = _sc_cells(sc_d, s, dim)          # (n_sc_local, s^3)
+        flat = own_tab.reshape(-1)
+        own_cells = flat[flat >= 0]                # chip-local cell order
+        def owners_of(cells: np.ndarray) -> np.ndarray:
+            """cell id -> owning chip, via the directory (ONE home for the
+            cell -> supercell -> owner mapping: the partition, ring depth,
+            and ext layout all read the pairs computed here)."""
+            return chip_of_sc_all[
+                (cells // (dim * dim)) // s * (n_sc_side ** 2)
+                + ((cells // dim) % dim) // s * n_sc_side
+                + (cells % dim) // s]
+
+        boxes = {}
+        box_reach = {}  # ci -> (occupied box cells, their owner chips)
+        for ci, spec in enumerate(specs):
+            box = _box_cells(sc_d[spec.rows], spec.radius, s, dim)
+            boxes[ci] = box
+            cells = np.unique(box[box >= 0])
+            # empty cells never ride the exchange: a zero-population cell
+            # contributes no candidates, so receivers record (start=0,
+            # count=0) for it and the owner exports nothing
+            cells = cells[cnt_flat[cells] > 0]
+            owners = owners_of(cells)
+            box_reach[ci] = (cells, owners)
+            for o in np.unique(owners):
+                if int(o) != d:
+                    needed[int(o)].update(
+                        cells[owners == o].tolist())
+        per_chip.append(dict(sc_ids=sc_ids, specs=specs, boxes=boxes,
+                             box_reach=box_reach,
+                             own_cells=own_cells, own_tab=own_tab))
+
+    # -- pass B: export blocks + ring depth + capacities --
+    exports: List[np.ndarray] = []
+    export_prefix: List[Dict[int, int]] = []
+    hmax = 1
+    for o in range(ndev):
+        cells_o = np.array(sorted(needed[o]), dtype=np.int64)  # kntpu-ok: wide-dtype -- cell-id table, host-only
+        exports.append(cells_o)
+        pref: Dict[int, int] = {}
+        off = 0
+        for c in cells_o.tolist():
+            pref[c] = off
+            off += int(cnt_flat[c])
+        export_prefix.append(pref)
+        hmax = max(hmax, off)
+    hcap = _round_up(hmax, 8)
+
+    steps = 0
+    for d in range(ndev):
+        for _cells, owners in per_chip[d]["box_reach"].values():
+            if owners.size == 0:
+                continue
+            far = np.abs(owners.astype(np.int64) - d)  # kntpu-ok: wide-dtype -- ring-distance arithmetic, host-only
+            steps = max(steps, int(far.max()))
+
+    chip_of_point = chip_of_sc_all[sc_of].astype(np.int32)
+    pop = np.bincount(chip_of_point, minlength=ndev)
+    pcap = _round_up(int(pop.max()) if n else 1, 8)
+    meta = PodMeta(ndev=ndev, dim=dim, supercell=s, pcap=pcap, hcap=hcap,
+                   steps=steps, domain=DOMAIN_SIZE)
+
+    # -- point buckets in (chip, own-cell slot, original id) order --
+    # own-cell slot per point: rank of its cell within its chip's own-cell
+    # list; the bucket IS the chip-local counting sort, staged slab by slab
+    slot_map = np.full(dim ** 3, -1, np.int32)
+    own_starts_by_chip: List[np.ndarray] = []
+    for d in range(ndev):
+        oc = per_chip[d]["own_cells"]
+        slot_map[oc] = np.arange(oc.size, dtype=np.int32)
+        own_starts_by_chip.append(
+            np.concatenate([[0], np.cumsum(cnt_flat[oc])[:-1]])
+            .astype(np.int32) if oc.size else np.empty((0,), np.int32))
+    slot_of_point = slot_map[cell_of]
+    order = np.lexsort((np.arange(n), slot_of_point, chip_of_point))
+    bucket_pts = np.full((ndev, pcap, 3), _FAR, np.float32)
+    bucket_ids = np.full((ndev, pcap), -1, np.int32)
+    starts_pt = np.concatenate([[0], np.cumsum(pop)[:-1]])
+    for d in range(ndev):
+        rows = order[starts_pt[d]: starts_pt[d] + pop[d]]
+        bucket_pts[d, : pop[d]] = points[rows]
+        bucket_ids[d, : pop[d]] = rows.astype(np.int32)
+
+    # -- pass C: per-chip ext layout + class tables --
+    # clear the scratch wholesale first: the bucketing fill above left
+    # every chip's own-slot values in place, and a stale entry (another
+    # chip's slot, or a zero-count cell skipped by the exchange filter)
+    # would alias a cand-table cell onto the wrong own-region slot --
+    # duplicated candidates that still certify (the dev-found
+    # pod-uniform-s10 corpus case pins this)
+    slot_map[:] = -1
+    chips: List[PodChipPlan] = []
+    for d in range(ndev):
+        info = per_chip[d]
+        oc = info["own_cells"]
+        own_starts = own_starts_by_chip[d]
+        # remote cells this chip's boxes reach, each resolving into the
+        # owner's export block at its host-known offset
+        remote: Dict[int, Tuple[int, int]] = {}  # cell -> (start, count)
+        max_dist = 0
+        # zero-population remote cells were filtered from box_reach in
+        # pass A, so they stay unmapped here and their cand slots resolve
+        # to -1 pads -- one filter, three consumers
+        for cells, owners in info["box_reach"].values():
+            for c, o in zip(cells.tolist(), owners.tolist()):
+                if o == d or c in remote:
+                    continue
+                remote[c] = (meta.halo_base(d, o) + export_prefix[o][c],
+                             int(cnt_flat[c]))
+                max_dist = max(max_dist, abs(o - d))
+        remote_cells = np.array(sorted(remote), dtype=np.int64)  # kntpu-ok: wide-dtype -- cell-id table, host-only
+
+        # cell -> ext slot map (own slots first, then remote), built in the
+        # shared slot_map scratch and reset after use
+        slot_map[oc] = np.arange(oc.size, dtype=np.int32)
+        slot_map[remote_cells] = (oc.size
+                                  + np.arange(remote_cells.size,
+                                              dtype=np.int32))
+        ext_starts = np.empty(oc.size + remote_cells.size, np.int32)
+        ext_counts = np.empty_like(ext_starts)
+        ext_starts[: oc.size] = own_starts
+        ext_counts[: oc.size] = cnt_flat[oc].astype(np.int32)
+        for c in remote_cells.tolist():
+            slot = slot_map[c]
+            ext_starts[slot], ext_counts[slot] = remote[c]
+
+        export_idx = np.full((hcap,), -1, np.int32)
+        off = 0
+        for c in exports[d].tolist():
+            cc = int(cnt_flat[c])
+            export_idx[off: off + cc] = (own_starts[slot_map[c]]
+                                         + np.arange(cc, dtype=np.int32))
+            off += cc
+
+        classes: List[ClassPlan] = []
+        class_of = np.full((info["sc_ids"].size,), -1, np.int32)
+        row_of = np.zeros_like(class_of)
+        specs = info["specs"]
+        for ci, spec in enumerate(specs):
+            class_of[spec.rows] = ci
+            row_of[spec.rows] = np.arange(spec.rows.size, dtype=np.int32)
+        import jax.numpy as jnp
+
+        for ci, spec in enumerate(specs):
+            own_g = info["own_tab"][spec.rows]
+            box = info["boxes"][ci]
+            own_slots = np.where(own_g >= 0,
+                                 slot_map[np.clip(own_g, 0, None)],
+                                 -1).astype(np.int32)
+            cand_slots = np.where(box >= 0,
+                                  slot_map[np.clip(box, 0, None)],
+                                  -1).astype(np.int32)
+            gsc = sc_all[info["sc_ids"][spec.rows]]
+            lo = ((gsc * s - spec.radius) * w).astype(np.float32)
+            hi = ((gsc * s + s + spec.radius) * w).astype(np.float32)
+            classes.append(ClassPlan(
+                own=jnp.asarray(own_slots), cand=jnp.asarray(cand_slots),  # kntpu-ok: jnp-in-loop -- prepare-time, <= max_classes tables per chip
+                lo=jnp.asarray(lo), hi=jnp.asarray(hi),                    # kntpu-ok: jnp-in-loop -- prepare-time, <= max_classes tables per chip
+                radius=spec.radius, qcap=spec.qcap, qcap_pad=spec.qcap_pad,
+                ccap=spec.ccap, route=spec.route))
+
+        # reset the shared scratch for the next chip
+        slot_map[oc] = -1
+        if remote_cells.size:
+            slot_map[remote_cells] = -1
+
+        chips.append(PodChipPlan(
+            classes=tuple(classes), class_of=class_of, row_of=row_of,
+            sc_ids=info["sc_ids"], ext_starts=ext_starts,
+            ext_counts=ext_counts, export_idx=export_idx,
+            n_local=int(pop[d]), remote_cells=int(remote_cells.size),
+            max_owner_dist=max_dist))
+
+    return PodPlan(meta=meta, directory=directory, chips=chips,
+                   bucket_pts=bucket_pts, bucket_ids=bucket_ids,
+                   chip_of_point=chip_of_point)
+
+
+def route_queries(directory: PodDirectory, meta: PodMeta,
+                  queries: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(owning chip, local supercell rank) per query, via the directory --
+    the host twin of the replicated device directory.  A query routed to
+    chip d has its whole candidate box inside d's ext window (the window
+    was sized from exactly these boxes), so single-chip certificates hold
+    verbatim for boundary-straddling queries too."""
+    dim, s = meta.dim, meta.supercell
+    n_sc_side = -(-dim // s)
+    coords = np.clip((queries * (dim / meta.domain)).astype(np.int64),  # kntpu-ok: wide-dtype -- dim^2 linearization headroom, host-only
+                     0, dim - 1)
+    scc = coords // s
+    sc_id = (scc[:, 0] + n_sc_side * scc[:, 1]
+             + n_sc_side * n_sc_side * scc[:, 2])
+    rank = directory.rank_of[sc_id]
+    chip = directory.chip_of_rank(rank)
+    local = (rank - directory.bounds[chip]).astype(np.int32)
+    return chip, local

@@ -1,0 +1,424 @@
+"""PodKnnProblem: the cell-sharded kNN index (prepare / solve / query).
+
+The pod analog of ``api.KnnProblem`` / ``parallel.sharded``: one prepared
+problem whose grid cells are partitioned across a chip mesh as contiguous
+Morton ranges (partition.py), whose boundary candidates move over ICI
+(halo.py), and whose per-chip HBM is the only memory limit (stream.py).
+
+Solve shape (the pod-solve syncflow window, analysis/syncflow.py):
+
+* prepare  -- host planning + slab-by-slab counted staging (each chip's
+  bucket rides its own ``dispatch.stage``; the full cloud never rides one
+  transfer).  Zero host syncs: planning reads the host census, not the
+  device.
+* exchange -- one ``shard_map`` program of ``ppermute`` ring steps, run
+  lazily at the first solve and cached; its exact wire volume is recorded
+  as ``ici_bytes`` (a counter, never a host sync).
+* solve    -- per-chip adaptive class solves (the SAME ``_chip_solve``
+  program the z-slab route runs, including MXU-routed classes with
+  per-chip ``recall_target`` pools), then ONE batched fetch assembles
+  every chip's rows; uncertified rows resolve against the host kd-tree
+  (zero further syncs).  ``host_syncs <= 2`` proven and reconciled.
+
+Results are pinned tie-aware-identical to the single-chip adaptive route
+(tests/test_pod.py, fuzz ``--pod``): certificates + exact resolution make
+both routes exact, so they may differ only among equal-distance ties.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import KnnConfig
+from ..ops.adaptive import (ClassPlan, _class_inverse_update,
+                            _prepack_kernel_inputs, launch_class_query)
+from ..ops.topk import INVALID_ID
+from ..parallel.sharded import _chip_solve
+from ..runtime import dispatch as _dispatch
+from ..utils.memory import (InvalidConfigError, InvalidKError,
+                            LaunchBudgetError)
+from . import halo as _halo
+from .partition import (PodChipPlan, PodDirectory, PodMeta, PodPlan,
+                        build_pod_plan, route_queries)
+from .stream import preflight_pod
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _pod_ready_state(spts, sids, halo_pts, halo_ids, ext_starts, ext_counts,
+                     classes: Tuple[ClassPlan, ...], k: int):
+    """One chip's static solve state over its halo-extended window.
+
+    Assembles ext arrays ([own slab | ring blocks in slot order] -- the
+    exact layout partition.py's ext_starts address), prepacks pallas-routed
+    classes, and inverts the slot partition for the LOCAL rows (the first
+    pcap).  Returns the same 9-tuple ``parallel.sharded._chip_solve``
+    consumes -- the pod route launches THE shared per-chip solve program,
+    not a twin (the equivalence engine pins this: analysis/equiv.py)."""
+    pcap = spts.shape[0]
+    ext_pts = jnp.concatenate([spts, halo_pts.reshape(-1, 3)], axis=0)
+    ext_ids = jnp.concatenate([sids, halo_ids.reshape(-1)], axis=0)
+    n_ext = ext_pts.shape[0]
+    inv_row = jnp.zeros((n_ext,), jnp.int32)
+    inv_box = jnp.zeros((n_ext,), jnp.int32)
+    row_off = box_off = 0
+    packed = []
+    for cp in classes:
+        if cp.route == "pallas":
+            cp = dataclasses.replace(cp, pk=_prepack_kernel_inputs(
+                ext_pts, ext_starts, ext_counts, cp.own, cp.cand,
+                cp.qcap_pad, cp.ccap))
+        # own cells live in the own region ([0, pcap)) by construction --
+        # supercells partition cells, a chip owns whole supercells -- so
+        # tgt needs no base shift; the n_ext sentinel lands past pcap and
+        # the (pcap, k) scatter drops it
+        inv_row, inv_box, row_off, box_off, tgt = (
+            _class_inverse_update(inv_row, inv_box, cp,
+                                  ext_starts, ext_counts, n_ext,
+                                  row_off, box_off))
+        packed.append(dataclasses.replace(cp, tgt=tgt))
+    loc = slice(0, pcap)
+    box_loc = inv_box[loc]
+    lo_rows = jnp.take(jnp.concatenate([cp.lo for cp in classes], axis=0),
+                       box_loc, axis=0)
+    hi_rows = jnp.take(jnp.concatenate([cp.hi for cp in classes], axis=0),
+                       box_loc, axis=0)
+    return (spts, ext_pts, ext_ids, ext_starts, ext_counts, tuple(packed),
+            inv_row[loc], lo_rows, hi_rows)
+
+
+@dataclasses.dataclass
+class PodKnnProblem:
+    """One prepared cell-sharded kNN problem over a chip mesh."""
+
+    config: KnnConfig
+    mesh: Mesh
+    meta: PodMeta
+    directory: PodDirectory
+    n_points: int
+    chip_plans: List[PodChipPlan]
+    hbm: dict
+    # device state: per-chip buckets (sharded, leading axis = chip) + the
+    # replicated directory bounds; halo blocks appear after the exchange
+    dev: Dict[str, jax.Array] = dataclasses.field(default_factory=dict,
+                                                  repr=False)
+    _points_host: Optional[np.ndarray] = dataclasses.field(default=None,
+                                                           repr=False)
+    _bucket_ids_host: Optional[np.ndarray] = dataclasses.field(default=None,
+                                                               repr=False)
+    _chip_of_point: Optional[np.ndarray] = dataclasses.field(default=None,
+                                                             repr=False)
+    _oracle_cache: Optional[object] = dataclasses.field(default=None,
+                                                        repr=False)
+    _ready_cache: Dict[int, tuple] = dataclasses.field(default_factory=dict,
+                                                       repr=False)
+    _exchanged: bool = dataclasses.field(default=False, repr=False)
+
+    # -- prepare ----------------------------------------------------------
+
+    @classmethod
+    def prepare(cls, points, n_devices: Optional[int] = None,
+                config: Optional[KnnConfig] = None,
+                mesh: Optional[Mesh] = None,
+                dim: Optional[int] = None) -> "PodKnnProblem":
+        from ..api import _config_adaptive_eligible
+        from ..config import grid_dim_for
+        from ..io import validate_or_raise
+        from .stream import auto_devices
+
+        config = config or KnnConfig()
+        if config.backend == "oracle":
+            raise InvalidConfigError(
+                "backend='oracle' is a single-chip host engine; the pod "
+                "path runs grid engines only ('auto'/'pallas'/'xla')")
+        if config.resolved_scorer() == "mxu" \
+                and not _config_adaptive_eligible(config, per_chip=True):
+            # same shared predicate as the single-chip guard and the
+            # (lifted) sharded refusal: the per-chip class solves score in
+            # 'diff' arithmetic, so an mxu config that overrides it would
+            # silently benchmark the wrong arithmetic
+            raise InvalidConfigError(
+                f"scorer='mxu' (recall_target={config.recall_target}) "
+                f"composes with the per-chip class solves only under "
+                f"dist_method='diff' (got {config.dist_method!r}): the "
+                f"class scorers realize distances in diff arithmetic")
+        points = validate_or_raise(points, k=config.k)
+        n = points.shape[0]
+        requested = n_devices
+        if mesh is None:
+            if n_devices is None:
+                n_devices = (auto_devices(n, config.k, config,
+                                          len(jax.devices()))
+                             or len(jax.devices()))
+            n_devices = max(1, min(int(n_devices), len(jax.devices())))
+            mesh = jax.make_mesh((n_devices,), (_halo.AXIS,))
+        ndev = mesh.devices.size
+        if dim is None:
+            dim = grid_dim_for(n, config.density)
+        dim = int(dim)
+
+        if n == 0:
+            # degraded mode: nothing to partition; solve()/query() short-
+            # circuit to empty / all-invalid results (DESIGN.md s11)
+            meta = PodMeta(ndev=ndev, dim=dim, supercell=config.supercell,
+                           pcap=8, hcap=8, steps=0, domain=1000.0)
+            return cls(config=config, mesh=mesh, meta=meta,
+                       directory=PodDirectory(
+                           order=np.empty(0, np.int32),
+                           rank_of=np.empty(0, np.int32),
+                           bounds=np.zeros(ndev + 1, np.int32)),
+                       n_points=0, chip_plans=[], hbm={}, dev={},
+                       _points_host=points)
+
+        on_kernel = (config.backend != "xla"
+                     and (jax.devices()[0].platform == "tpu"
+                          or config.interpret))
+        auto = requested is None and ndev < len(jax.devices())
+        while True:
+            plan: PodPlan = build_pod_plan(points, ndev, config, dim,
+                                           on_kernel)
+            try:
+                hbm = preflight_pod(plan.meta, plan.chips, config.k,
+                                    config, n)
+                break
+            except LaunchBudgetError:
+                # the auto-splitter's widening arm: the pre-partition
+                # estimate (stream.auto_devices) is optimistic -- halo
+                # blocks and class outputs only exist after planning -- so
+                # a failed per-chip preflight splits across more chips and
+                # replans, refusing only when the widest split still
+                # cannot fit one chip
+                if not auto or ndev >= len(jax.devices()):
+                    raise
+                ndev = min(ndev * 2, len(jax.devices()))
+                mesh = jax.make_mesh((ndev,), (_halo.AXIS,))
+
+        # streamed staging: each chip's slab rides its own counted H2D
+        # transfer (halo.stage_sharded) -- the full cloud exists on device
+        # only as the sharded assembly of per-chip blocks
+        def stage_one(block, device):
+            return _dispatch.stage(block, device=device)  # syncflow: pod-prepare-stage
+
+        bucket_pts, bucket_ids, export_idx = _halo.stage_sharded(
+            (plan.bucket_pts, plan.bucket_ids,
+             np.stack([c.export_idx for c in plan.chips])),
+            mesh, stage_one)
+        # the replicated cell->chip directory: every chip carries the same
+        # (ndev+1,) Morton-rank bounds -- the authoritative owner map a
+        # future device-side router would consult; every CURRENT routing
+        # decision reads the host twin (route_queries).  Staged through
+        # the counted primitive like every other prepare transfer.
+        bounds_dev = _dispatch.stage(  # syncflow: pod-prepare-stage
+            plan.directory.bounds.astype(np.int32),
+            device=NamedSharding(mesh, P()))
+        dev = {"bucket_pts": bucket_pts, "bucket_ids": bucket_ids,
+               "export_idx": export_idx, "directory": bounds_dev}
+        return cls(config=config, mesh=mesh, meta=plan.meta,
+                   directory=plan.directory, n_points=n,
+                   chip_plans=plan.chips, hbm=hbm, dev=dev,
+                   _points_host=points,
+                   _bucket_ids_host=plan.bucket_ids,
+                   _chip_of_point=plan.chip_of_point)
+
+    # -- internals --------------------------------------------------------
+
+    def _oracle(self):
+        if self._oracle_cache is None:
+            from ..oracle import KdTreeOracle
+
+            self._oracle_cache = KdTreeOracle(self._points_host)
+        return self._oracle_cache
+
+    def _exchange(self) -> None:
+        """Run the ICI halo exchange once (cached): ppermute ring steps
+        ship every export block ``steps`` chips in each direction.  The
+        exact wire volume is recorded as ici_bytes -- interconnect
+        traffic, not a host sync (the pod-solve window's central claim)."""
+        if self._exchanged:
+            return
+        program = _halo.exchange_program(self.meta, self.mesh)
+        halo_pts, halo_ids = program(self.dev["bucket_pts"],
+                                     self.dev["bucket_ids"],
+                                     self.dev["export_idx"])
+        self.dev["halo_pts"] = halo_pts
+        self.dev["halo_ids"] = halo_ids
+        if self.meta.steps and self.meta.ndev > 1:
+            _dispatch.ici(self.meta.halo_bytes())  # syncflow: pod-ici
+        self._exchanged = True
+
+    def _chip_inputs(self, d: int):
+        out = {}
+        for name in ("bucket_pts", "bucket_ids", "halo_pts", "halo_ids"):
+            arr = self.dev[name]
+            shard = next(sh for sh in arr.addressable_shards
+                         if int(sh.index[0].start or 0) == d)
+            out[name] = shard.data.reshape(shard.data.shape[1:])
+        return out
+
+    def _chip_ready(self, d: int):
+        if d not in self._ready_cache:
+            self._exchange()
+            inp = self._chip_inputs(d)
+            plan = self.chip_plans[d]
+            self._ready_cache[d] = _pod_ready_state(
+                inp["bucket_pts"], inp["bucket_ids"],
+                inp["halo_pts"], inp["halo_ids"],
+                plan.ext_starts, plan.ext_counts, plan.classes,
+                k=self.config.k)
+        return self._ready_cache[d]
+
+    # -- solve ------------------------------------------------------------
+
+    def solve_device(self) -> Dict[int, Optional[tuple]]:
+        """Per-chip adaptive solves over the halo-extended windows, results
+        device-resident ({chip: (orig_ids (pcap, k), d2 (pcap, k),
+        cert (pcap,)) or None for empty slabs}).  Dispatch is a host loop
+        but execution overlaps (async jit dispatch, one program per chip);
+        no host sync happens here."""
+        cfg = self.config
+        outs: Dict[int, Optional[tuple]] = {}
+        for d in range(self.meta.ndev):
+            if not self.chip_plans[d].classes:
+                outs[d] = None
+                continue
+            state = self._chip_ready(d)
+            outs[d] = _chip_solve(
+                *state, cfg.k, cfg.exclude_self, self.meta.domain,
+                cfg.interpret, cfg.stream_tile, cfg.effective_kernel(),
+                cfg.resolved_epilogue(), float(cfg.recall_target))
+        return outs
+
+    def solve(self, device_out=None
+              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The partitioned all-points solve, assembled in ORIGINAL
+        indexing: (neighbors (n, k), dists_sq (n, k), certified (n,)).
+
+        ONE batched fetch collects every chip's rows (the host already
+        knows each slab's original ids -- the partitioner built the
+        buckets); uncertified rows resolve exactly against the host
+        kd-tree (zero further syncs).  host_syncs <= 2 proven by the
+        pod-solve window and reconciled by tests/test_pod.py."""
+        cfg = self.config
+        n, k = self.n_points, cfg.k
+        neighbors = np.full((n, k), INVALID_ID, np.int32)
+        d2 = np.full((n, k), np.inf, np.float32)
+        cert = np.zeros((n,), bool)
+        if n == 0:
+            return (np.empty((0, k), np.int32),
+                    np.empty((0, k), np.float32), np.empty((0,), bool))
+        outs = device_out if device_out is not None else self.solve_device()
+        live = [d for d in sorted(outs) if outs[d] is not None]
+        fetched = _dispatch.fetch(  # syncflow: pod-solve-final
+            [tuple(outs[d]) for d in live])
+        for d, (o_i, o_d, o_c) in zip(live, fetched):
+            sids = self._bucket_ids_host[d]
+            rows = sids >= 0
+            neighbors[sids[rows]] = o_i[rows]
+            d2[sids[rows]] = o_d[rows]
+            cert[sids[rows]] = o_c[rows]
+        if cfg.fallback == "brute" and not cert.all():
+            bad = np.nonzero(~cert)[0].astype(np.int32)
+            b_ids, b_d2 = self._oracle().knn(
+                self._points_host[bad], k,
+                exclude_ids=bad if cfg.exclude_self else None)
+            neighbors[bad] = b_ids
+            d2[bad] = b_d2
+            cert[bad] = True
+        return neighbors, d2, cert
+
+    # -- external queries -------------------------------------------------
+
+    def query(self, queries, k: Optional[int] = None
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact kNN of arbitrary coordinates against the partitioned set.
+
+        Each query routes through the directory to the chip owning its
+        supercell and rides that chip's class schedule over its
+        halo-extended window -- a boundary-straddling query's whole
+        candidate box is inside its owner's window by construction, so the
+        single-chip certificates hold verbatim.  One batched fetch;
+        classless and uncertified rows resolve against the host oracle.
+        Returns ((m, k) ids in ORIGINAL indexing, ascending; (m, k) d2)."""
+        from ..io import validate_or_raise
+
+        cfg, meta = self.config, self.meta
+        k = cfg.k if k is None else k
+        queries = validate_or_raise(queries, k=k, what="queries")
+        k = int(k)
+        if k > cfg.k:
+            raise InvalidKError(
+                f"k={k} exceeds the prepared k={cfg.k} (it sized the "
+                f"candidate dilation)")
+        queries = np.ascontiguousarray(queries, np.float32)
+        m = queries.shape[0]
+        out_i = np.full((m, k), INVALID_ID, np.int32)
+        out_d = np.full((m, k), np.inf, np.float32)
+        if m == 0 or self.n_points == 0:
+            return out_i, out_d
+        chip, local_rank = route_queries(self.directory, meta, queries)
+        cert = np.zeros((m,), bool)
+        pending = []
+        for d in range(meta.ndev):
+            on_d = np.nonzero(chip == d)[0]
+            if on_d.size == 0:
+                continue
+            plan = self.chip_plans[d]
+            if not plan.classes:
+                continue  # empty slab: the oracle pass below resolves them
+            (_, ext_pts, ext_ids, ext_starts, ext_counts, classes,
+             _, _, _) = self._chip_ready(d)
+            qcls = plan.class_of[local_rank[on_d]]
+            qrow = plan.row_of[local_rank[on_d]]
+            for ci, cp in enumerate(classes):
+                sel = on_d[qcls == ci]
+                if sel.size == 0:
+                    continue
+                order, r_i, r_d, r_c = launch_class_query(
+                    ext_pts, ext_starts, ext_counts, cp, queries[sel],
+                    qrow[qcls == ci], k, cfg, meta.domain, ids_map=ext_ids)
+                pending.append((sel[order], r_i, r_d, r_c))
+        for rows, h_i, h_d, h_c in _dispatch.fetch(pending):  # syncflow: pod-query-final
+            out_i[rows] = h_i
+            out_d[rows] = h_d
+            cert[rows] = h_c
+        if not cert.all():
+            bad = np.nonzero(~cert)[0].astype(np.int32)
+            b_i, b_d = self._oracle().knn(queries[bad], k)
+            out_i[bad] = b_i
+            out_d[bad] = b_d
+        return out_i, out_d
+
+    # -- diagnostics ------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Decomposition + exchange + budget diagnostics (all host state:
+        zero device round trips)."""
+        meta = self.meta
+        return {
+            "n_points": self.n_points,
+            "n_devices": meta.ndev,
+            "grid_dim": meta.dim,
+            "supercell": meta.supercell,
+            "pcap": meta.pcap,
+            "hcap": meta.hcap,
+            "ring_depth": meta.steps,
+            "halo_bytes": meta.halo_bytes(),
+            **self.hbm,
+            "chips": [{
+                "chip": d,
+                "n_points": c.n_local,
+                "n_supercells": int(c.sc_ids.size),
+                "remote_cells": c.remote_cells,
+                "max_owner_dist": c.max_owner_dist,
+                "classes": [{"radius": cp.radius, "n_supercells": cp.n_sc,
+                             "qcap": cp.qcap, "ccap": cp.ccap,
+                             "route": cp.route}
+                            for cp in c.classes],
+            } for d, c in enumerate(self.chip_plans)],
+        }

@@ -1,0 +1,103 @@
+"""HBM auto-splitting: the per-chip footprint model and its budget gate.
+
+PR 2's ``hbm_bytes_estimate``/``preflight_launch`` REFUSED would-OOM
+launches; this module turns the same preflight into the automatic
+partitioner ("Memory Safe Computations with XLA", arXiv 2206.14148): a
+cloud whose single-chip footprint exceeds the budget is not refused -- it
+streams through the pod partitioner in slab-sized host-to-device stages
+(halo.stage_sharded), and the budget is enforced against the PER-CHIP
+model instead.  Only a cloud whose *slab* cannot fit a chip refuses, with
+the same typed ``LaunchBudgetError`` taxonomy and a pointer at the knob
+that helps (more chips).
+
+Like every HBM model in this tree the estimate is deliberately a slight
+overestimate (pads counted, tables at full width): the preflight must
+refuse marginal fits, never bless them.  The model is what bench rows
+stamp as ``hbm_high_water_bytes`` and what tests/test_pod.py proves stays
+under the configured budget while the full cloud exceeds it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..config import KnnConfig
+from ..ops.pallas_solve import hbm_budget_bytes
+from ..utils.memory import LaunchBudgetError
+from .partition import PodChipPlan, PodMeta
+
+
+def chip_hbm_model(meta: PodMeta, chip: PodChipPlan, k: int) -> int:
+    """Modeled peak HBM (bytes) one chip commits to this problem: its
+    staged slab (points + ids), the halo-extended window the exchange
+    assembles, the ext CSR, every class's cell tables, and the per-class
+    solver outputs (row-major (Sc*qcap, k) dists + ids)."""
+    n_ext = meta.n_ext
+    bucket = meta.pcap * (12 + 4)                 # staged slab: pts + ids
+    window = n_ext * (12 + 4)                     # ext pts + ext ids
+    csr = 2 * 4 * max(1, chip.ext_starts.size)    # ext starts + counts
+    tables = 0
+    outputs = 0
+    for cp in chip.classes:
+        tables += 4 * cp.own.size + 4 * cp.cand.size + 2 * 12 * cp.n_sc
+        outputs += 2 * 4 * cp.n_sc * cp.qcap_pad * k
+    final = meta.pcap * (8 * k + 1)               # (pcap, k) ids+d2 + cert
+    return bucket + window + csr + tables + outputs + final
+
+
+def full_cloud_model(n: int, k: int) -> int:
+    """Modeled single-chip footprint of the UNSPLIT cloud: staged points +
+    permutation + CSR-scale tables + the (n, k) result buffers -- the
+    quantity the auto-splitter compares against the budget to decide that
+    splitting is mandatory (not just profitable)."""
+    return n * (12 + 4) + n * (12 + 4) + n * (8 * k + 1)
+
+
+def preflight_pod(meta: PodMeta, chips: List[PodChipPlan], k: int,
+                  cfg: KnnConfig, n_points: int) -> dict:
+    """The auto-splitter's gate: per-chip models must fit the budget.
+
+    Returns the stamp dict bench rows and stats() carry --
+    ``hbm_budget_bytes`` (None = unbounded), ``hbm_high_water_bytes`` (max
+    per-chip model), ``hbm_full_cloud_bytes``, and ``streamed_prepare``
+    (True when the full cloud exceeds the budget, i.e. the split was
+    mandatory and the slab staging IS what made the problem admissible).
+    Raises the typed oom-kind :class:`LaunchBudgetError` when even one
+    chip's slab cannot fit -- the refusal arm that survives, now per chip
+    rather than per cloud."""
+    budget = hbm_budget_bytes(cfg)
+    per_chip = [chip_hbm_model(meta, c, k) for c in chips]
+    high = max(per_chip) if per_chip else 0
+    full = full_cloud_model(n_points, k)
+    if budget is not None and high > budget:
+        worst = int(per_chip.index(high))
+        raise LaunchBudgetError(
+            f"pod-prepare: chip {worst}'s modeled slab footprint {high} "
+            f"bytes (pcap={meta.pcap}, halo={2 * meta.steps}x{meta.hcap}, "
+            f"k={k}) exceeds the {budget} byte per-chip HBM budget even "
+            f"after cell-range splitting across {meta.ndev} chip(s); use "
+            f"more devices, a coarser grid (config.density), or raise "
+            f"config.hbm_budget_bytes / KNTPU_HBM_BUDGET_BYTES",
+            requested=high, budget=budget, site="pod-prepare")
+    return {
+        "hbm_budget_bytes": budget,
+        "hbm_high_water_bytes": high,
+        "hbm_full_cloud_bytes": full,
+        "streamed_prepare": bool(budget is not None and full > budget),
+    }
+
+
+def auto_devices(n_points: int, k: int, cfg: KnnConfig,
+                 available: int) -> Optional[int]:
+    """The splitter's device-count chooser for ``n_devices=None``: the
+    smallest chip count whose EVEN slab share of the staged cloud fits the
+    budget (a pre-partition estimate; the real per-chip model is gated by
+    :func:`preflight_pod` after planning).  None = no budget configured --
+    the caller keeps its default (all devices)."""
+    budget = hbm_budget_bytes(cfg)
+    if budget is None:
+        return None
+    for ndev in range(1, available + 1):
+        if full_cloud_model(-(-n_points // ndev), k) * 2 <= budget:
+            return ndev
+    return available

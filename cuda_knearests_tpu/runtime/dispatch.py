@@ -59,16 +59,23 @@ class DispatchStats:
     ``host_syncs`` counts BLOCKING host round trips (batched ``fetch`` calls
     that actually touched a device array); ``d2h_bytes``/``h2d_bytes`` the
     result/staging traffic that rode them.  Async H2D staging is traffic,
-    not a sync -- dispatch continues while it is in flight."""
+    not a sync -- dispatch continues while it is in flight.  ``ici_bytes``
+    counts chip-to-chip interconnect traffic (``lax.ppermute`` halo blocks,
+    recorded by the pod subsystem's exchange via :func:`ici`): it crosses
+    no host boundary, so it never contributes to ``host_syncs`` -- the
+    whole point of the pod route's "halos are ICI, not host traffic"
+    budget (DESIGN.md section 18)."""
 
     host_syncs: int = 0
     d2h_bytes: int = 0
     h2d_bytes: int = 0
+    ici_bytes: int = 0
 
     def as_dict(self) -> dict:
         return {"host_syncs": self.host_syncs,
                 "d2h_bytes": self.d2h_bytes,
-                "h2d_bytes": self.h2d_bytes}
+                "h2d_bytes": self.h2d_bytes,
+                "ici_bytes": self.ici_bytes}
 
 
 _STATS = DispatchStats()
@@ -95,6 +102,7 @@ def reset_stats() -> None:
         _STATS.host_syncs = 0
         _STATS.d2h_bytes = 0
         _STATS.h2d_bytes = 0
+        _STATS.ici_bytes = 0
 
 
 def stats() -> DispatchStats:
@@ -114,7 +122,7 @@ class SiteRecord:
     syncflow discovery's site paths); ``synced`` is True for a fetch that
     actually touched a device array (the ones that count as host syncs)."""
 
-    kind: str      # 'fetch' | 'stage'
+    kind: str      # 'fetch' | 'stage' | 'ici'
     path: str
     line: int
     nbytes: int
@@ -181,12 +189,15 @@ def fetch(*trees: Any) -> Any:
     return out[0] if len(out) == 1 else out
 
 
-def stage(x: Any, dtype: Any = None):
+def stage(x: Any, dtype: Any = None, device: Any = None):
     """Counted async H2D staging (``jnp.asarray``): traffic, not a sync.
 
     The upload is dispatched and the host continues -- the double-buffered
     query chunk pipeline leans on exactly this (chunk i+1 uploads while
-    chunk i computes, DESIGN.md section 12)."""
+    chunk i computes, DESIGN.md section 12).  ``device`` pins the upload to
+    one specific chip (``jax.device_put``): the pod subsystem's streamed
+    prepare stages each slab onto its owning chip individually, so the full
+    cloud never rides one monolithic transfer (DESIGN.md section 18)."""
     import jax
     import jax.numpy as jnp
 
@@ -196,8 +207,30 @@ def stage(x: Any, dtype: Any = None):
             _STATS.h2d_bytes += int(arr.nbytes)
         if _SITE_TRACE is not None:
             _record_site("stage", int(arr.nbytes), False)
+        if device is not None:
+            return jax.device_put(arr, device)
         return jnp.asarray(arr)
+    if device is not None:
+        return jax.device_put(x if dtype is None else jnp.asarray(x, dtype),
+                              device)
     return x if dtype is None else jnp.asarray(x, dtype)
+
+
+def ici(nbytes: int) -> None:
+    """Record ``nbytes`` of chip-to-chip interconnect traffic (the modeled
+    volume of a ``lax.ppermute`` exchange the caller just dispatched).
+
+    ICI moves data between chips without touching the host, so this counts
+    toward ``ici_bytes`` only -- never ``host_syncs`` -- which is exactly
+    the claim the pod-solve syncflow window proves (halo exchange rides the
+    interconnect; the host round-trip budget stays <= 2).  The byte count
+    is the static schedule's exact wire volume (blocks x steps x links),
+    reconciled against the syncflow model's symbolic expression on the 20k
+    fixture by tests/test_pod.py."""
+    with _STATS_LOCK:
+        _STATS.ici_bytes += int(nbytes)
+    if _SITE_TRACE is not None:
+        _record_site("ici", int(nbytes), False)
 
 
 def signature(tree: Any, *statics: Any) -> Tuple:

@@ -177,6 +177,39 @@ for fault in drop-block skip-certify; do
     fi
 done
 
+# Pod smoke (DESIGN.md section 18): the cell-partitioned index on 4 forced
+# host devices -- partitioned == single-chip tie-aware pin on the 20k
+# fixture (incl. scorer='mxu' at both recall tiers and boundary-straddling
+# queries), one streamed-prepare case whose per-chip HBM model provably
+# stays under a budget the full cloud exceeds, the typed budget refusal,
+# and the host-sync/ICI counter reconciliation against the proven
+# pod-solve window.
+echo "== pod smoke (cell-partitioned index, 4 forced devices, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.pod --devices 4 || rc=1
+
+# Pod fuzz smoke (DESIGN.md section 18): boundary-weighted zoo clouds
+# through the partitioned route on >= 4 forced devices vs the kd-tree
+# oracle AND the single-chip adaptive route, tie-aware.  KNTPU_POD_CASES
+# deepens it for nightly runs.
+echo "== pod fuzz smoke (partitioned route vs oracle + single-chip, ${KNTPU_POD_CASES:-8} cases, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
+    --pod --cases "${KNTPU_POD_CASES:-8}" --seed 0 --budget 120s || rc=1
+
+# Pod seeded-fault self-tests (DESIGN.md section 18): a dropped halo block
+# and a stale cell->chip directory must each yield a banked failure
+# (rc != 0), diverted away from the real corpus.
+echo "== pod seeded-fault self-tests (drop-halo / stale-directory) =="
+for fault in drop-halo stale-directory; do
+    if KNTPU_POD_FAULT=$fault JAX_PLATFORMS=cpu \
+        python -m cuda_knearests_tpu.fuzz --pod --cases 2 --seed 0 \
+        --no-minimize >/dev/null 2>&1; then
+        echo "   FAIL: seeded pod fault '$fault' was not detected (rc 0)"
+        rc=1
+    else
+        echo "   ok: '$fault' detected"
+    fi
+done
+
 # Sync-budget smoke (DESIGN.md section 12): every solve route -- adaptive,
 # legacy pack, external query (single-shot + chunked pipeline), sharded
 # solve + query -- must complete within the one-sync contract's budget of

@@ -119,6 +119,20 @@ def _artifact_good(path: str, allow_partial: bool = False) -> bool:
         if str(ln.get("unit", "")) == "failover_ok" \
                 and not ln.get("failover_ok"):
             return False
+    # pod weak-scaling rows (ISSUE 12 satellite) are accepted as their own
+    # row kind: unit 'queries/sec/chip' with pod_scaling=true.  A pod row
+    # must carry its halo accounting (halo_bytes + ring_depth) and the
+    # PROVEN sync bound satisfied (sync_bound_ok) -- a partitioned
+    # throughput number whose halo traffic or host-sync proof is missing
+    # is not a record.  The CPU-fallback refusal above already rejects
+    # forced-host-device captures by their platform stamp; the first
+    # genuine on-chip row of this family is the ISSUE 12 deliverable.
+    for ln in lines:
+        if ln.get("pod_scaling") and not (
+                isinstance(ln.get("halo_bytes"), int)
+                and isinstance(ln.get("ring_depth"), int)
+                and ln.get("sync_bound_ok") is True):
+            return False
     # every kNN-throughput row of a FULL bench artifact must carry the
     # recall stamp (ISSUE 10 satellite): frontier rows trade recall for
     # QPS, so a throughput number without its recall is not comparable

@@ -188,12 +188,14 @@ def test_k_gt_n_keeps_certificates_intact():
 
 def _corpus_entries():
     # point-case repros only: mutation-stream (*-mutation.npz), FoF
-    # (*-fof.npz), approx (*-approx.npz) and fleet (*-fleet.npz) repros
-    # have their own schemas and replay via their own loaders (below /
-    # tests/test_cluster.py / test_mxu.py / test_fleet.py)
+    # (*-fof.npz), approx (*-approx.npz), fleet (*-fleet.npz) and pod
+    # (*-pod.npz) repros have their own schemas and replay via their own
+    # loaders (below / tests/test_cluster.py / test_mxu.py /
+    # test_fleet.py / test_pod.py)
     return sorted(p for p in glob.glob(os.path.join(CORPUS, "*.npz"))
                   if not p.endswith(("-mutation.npz", "-fof.npz",
-                                     "-approx.npz", "-fleet.npz")))
+                                     "-approx.npz", "-fleet.npz",
+                                     "-pod.npz")))
 
 
 def _mutation_corpus_entries():

@@ -1,0 +1,415 @@
+"""Pod-partitioned grid subsystem (ISSUE 12, DESIGN.md section 18).
+
+Covers the tentpole claims end to end on the emulated 8-device CPU mesh:
+the Morton-range partition + directory, tie-aware identity with the
+single-chip adaptive route (including scorer='mxu' at both recall tiers,
+k > n pads, and boundary-straddling queries), the HBM auto-splitter's
+streamed prepare + typed refusal, the <= 2 host-sync budget with halo
+traffic accounted as ICI (reconciled exactly against the syncflow
+window's expression), the lifted sharded scorer='mxu' refusal, the
+seeded-fault liveness of the pod fuzz flavor, and the banked corpus
+replay."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from cuda_knearests_tpu import KnnConfig, KnnProblem
+from cuda_knearests_tpu.analysis import syncflow
+from cuda_knearests_tpu.fuzz import CORPUS_DIR
+from cuda_knearests_tpu.fuzz.compare import check_route_result
+from cuda_knearests_tpu.fuzz.routes import oracle_reference
+from cuda_knearests_tpu.io import generate_uniform
+from cuda_knearests_tpu.pod import PodKnnProblem
+from cuda_knearests_tpu.pod.partition import build_pod_plan, route_queries
+from cuda_knearests_tpu.pod.stream import chip_hbm_model
+from cuda_knearests_tpu.runtime import dispatch
+from cuda_knearests_tpu.utils.memory import (InvalidConfigError,
+                                             InvalidKError,
+                                             LaunchBudgetError)
+
+NDEV = 4
+
+
+@pytest.fixture(scope="module")
+def uniform_4k():
+    # 2.5k keeps every class/halo shape nontrivial on 4 chips while the
+    # module stays inside the tier-1 wall budget
+    return generate_uniform(2_500, seed=5)
+
+
+_MXU_REF_CACHE = {}
+
+
+def _single_chip_mxu_d2(points, k, rt):
+    """The single-chip mxu route's distances (module-cached: the pod and
+    sharded pins compare against the same reference)."""
+    key = (points.shape[0], k, rt)
+    if key not in _MXU_REF_CACHE:
+        sp = KnnProblem.prepare(points, KnnConfig(k=k, scorer="mxu",
+                                                  recall_target=rt))
+        sp.solve()
+        d2 = np.empty_like(sp.get_dists_sq())
+        d2[sp.get_permutation()] = sp.get_dists_sq()
+        _MXU_REF_CACHE[key] = d2
+    return _MXU_REF_CACHE[key]
+
+
+@pytest.fixture(scope="module")
+def pod_4k(uniform_4k):
+    return PodKnnProblem.prepare(uniform_4k, n_devices=NDEV,
+                                 config=KnnConfig(k=8))
+
+
+def _single_chip_d2(points, k):
+    p = KnnProblem.prepare(points, KnnConfig(k=k))
+    p.solve()
+    d2 = np.empty_like(p.get_dists_sq())
+    d2[p.get_permutation()] = p.get_dists_sq()
+    return d2
+
+
+# -- partition + directory ----------------------------------------------------
+
+def test_directory_contiguous_and_complete(pod_4k, uniform_4k):
+    d = pod_4k.directory
+    # bounds are monotone rank splits covering every supercell exactly once
+    assert d.bounds[0] == 0 and d.bounds[-1] == d.order.size
+    assert (np.diff(d.bounds) >= 0).all()
+    # rank_of inverts order (a bijection over the supercell list)
+    assert (d.order[d.rank_of] == np.arange(d.order.size)).all()
+    # every point lands on the chip owning its supercell, and the host
+    # bucket census agrees with the directory
+    chip, _local = route_queries(d, pod_4k.meta, uniform_4k)
+    assert (chip == pod_4k._chip_of_point).all()
+    assert (np.bincount(chip, minlength=NDEV)
+            == [c.n_local for c in pod_4k.chip_plans]).all()
+
+
+def test_partition_balanced(pod_4k):
+    pops = np.array([c.n_local for c in pod_4k.chip_plans])
+    # population-balanced Morton split: no chip holds more than ~2x the
+    # even share on uniform data
+    assert pops.max() <= 2 * (pod_4k.n_points // NDEV)
+    assert pops.sum() == pod_4k.n_points
+
+
+# -- tie-aware identity with oracle + single-chip -----------------------------
+
+def test_pod_solve_tie_aware_identical(pod_4k, uniform_4k):
+    ids, d2, cert = pod_4k.solve()
+    _ref_i, ref_d = oracle_reference(uniform_4k, 8, exclude_self=True)
+    assert check_route_result(uniform_4k, uniform_4k, ids, d2,
+                              ref_d, 8) is None
+    assert check_route_result(uniform_4k, uniform_4k, ids, d2,
+                              _single_chip_d2(uniform_4k, 8), 8) is None
+    assert cert.all()  # post-resolution: every row exact
+
+
+def test_pod_boundary_straddling_queries(pod_4k, uniform_4k):
+    # queries jittered off stored points: dense near every range boundary
+    rng = np.random.default_rng(3)
+    q = np.clip(uniform_4k[rng.integers(0, uniform_4k.shape[0], 256)]
+                + rng.normal(0, 2.0, (256, 3)).astype(np.float32),
+                0.0, 1000.0).astype(np.float32)
+    qi, qd = pod_4k.query(q)
+    _ri, rd = pod_4k._oracle().knn(q, 8)
+    assert check_route_result(uniform_4k, q, qi, qd, rd, 8) is None
+    # a smaller k truncates, never re-prepares
+    qi4, qd4 = pod_4k.query(q, k=4)
+    assert check_route_result(uniform_4k, q, qi4, qd4, rd[:, :4], 4) is None
+    with pytest.raises(InvalidKError):
+        pod_4k.query(q, k=9)
+
+
+# -- MXU composition (per-chip recall_target pools) ---------------------------
+
+@pytest.mark.parametrize("rt", (0.9, 1.0))
+def test_pod_mxu_composes(uniform_4k, rt):
+    pm = PodKnnProblem.prepare(
+        uniform_4k, n_devices=NDEV,
+        config=KnnConfig(k=8, scorer="mxu", recall_target=rt))
+    routes = [cp.route for c in pm.chip_plans for cp in c.classes]
+    assert "mxu" in routes, routes
+    ids, d2, cert = pm.solve()
+    assert cert.all()
+    # pinned against the single-chip mxu route (both exact after
+    # certification + resolution, so tie-aware identical)
+    assert check_route_result(uniform_4k, uniform_4k, ids, d2,
+                              _single_chip_mxu_d2(uniform_4k, 8, rt),
+                              8) is None
+
+
+def test_sharded_mxu_refusal_lifted(uniform_4k):
+    """The PR 9 stopgap is gone: sharded prepare accepts scorer='mxu',
+    routes classes through the MXU scorer, and its results pin tie-aware
+    identical to the single-chip mxu route at both recall tiers."""
+    from cuda_knearests_tpu.parallel.sharded import ShardedKnnProblem
+
+    for rt in (0.9, 1.0):
+        sm = ShardedKnnProblem.prepare(
+            uniform_4k, n_devices=2,
+            config=KnnConfig(k=8, scorer="mxu", recall_target=rt))
+        routes = [cp.route for c in sm.chip_plans for cp in c.classes]
+        assert "mxu" in routes, routes
+        ids, d2, _cert = sm.solve()
+        assert check_route_result(uniform_4k, uniform_4k, ids, d2,
+                                  _single_chip_mxu_d2(uniform_4k, 8, rt),
+                                  8) is None
+
+
+def test_mxu_guard_shared_predicate(uniform_4k):
+    """Prepare-time guard and solve-time routing read ONE predicate: a
+    dist_method that the class scorers cannot honor refuses typed on both
+    multi-chip prepares, exactly like the single-chip guard."""
+    from cuda_knearests_tpu.api import _config_adaptive_eligible
+    from cuda_knearests_tpu.parallel.sharded import ShardedKnnProblem
+
+    bad = KnnConfig(k=8, scorer="mxu", recall_target=0.9,
+                    dist_method="dot")
+    assert not _config_adaptive_eligible(bad, per_chip=True)
+    with pytest.raises(InvalidConfigError):
+        ShardedKnnProblem.prepare(uniform_4k, n_devices=2, config=bad)
+    with pytest.raises(InvalidConfigError):
+        PodKnnProblem.prepare(uniform_4k, n_devices=2, config=bad)
+
+
+# -- degraded modes -----------------------------------------------------------
+
+def test_pod_degraded_modes():
+    k = 8
+    # k > n: -1/inf pads, counts match the oracle's
+    tiny = generate_uniform(5, seed=1)
+    pt = PodKnnProblem.prepare(tiny, n_devices=NDEV, config=KnnConfig(k=k))
+    ids, d2, cert = pt.solve()
+    _ri, rd = oracle_reference(tiny, k, exclude_self=True)
+    assert check_route_result(tiny, tiny, ids, d2, rd, k) is None
+    assert cert.all()
+    # n = 0: empty results on both surfaces
+    pe = PodKnnProblem.prepare(np.empty((0, 3), np.float32),
+                               n_devices=2, config=KnnConfig(k=4))
+    ids0, d20, cert0 = pe.solve()
+    assert ids0.shape == (0, 4) and cert0.shape == (0,)
+    qi, qd = pe.query(generate_uniform(7, seed=2))
+    assert (qi == -1).all() and np.isinf(qd).all()
+    # n = 1 with self-exclusion: the one row is all pads
+    one = PodKnnProblem.prepare(generate_uniform(1, seed=3),
+                                n_devices=2, config=KnnConfig(k=4))
+    i1, d1, c1 = one.solve()
+    assert (i1 == -1).all() and c1.all()
+
+
+def test_pod_single_device(uniform_4k):
+    p1 = PodKnnProblem.prepare(uniform_4k, n_devices=1,
+                               config=KnnConfig(k=8))
+    assert p1.meta.steps == 0 and p1.meta.halo_bytes() == 0
+    ids, d2, _cert = p1.solve()
+    _ri, rd = oracle_reference(uniform_4k, 8, exclude_self=True)
+    assert check_route_result(uniform_4k, uniform_4k, ids, d2, rd,
+                              8) is None
+
+
+# -- HBM auto-splitting -------------------------------------------------------
+
+def test_streamed_prepare_under_budget(pod_4k, uniform_4k):
+    high = pod_4k.hbm["hbm_high_water_bytes"]
+    full = pod_4k.hbm["hbm_full_cloud_bytes"]
+    assert high == max(chip_hbm_model(pod_4k.meta, c, 8)
+                       for c in pod_4k.chip_plans)
+    budget = (high + full) // 2
+    ps = PodKnnProblem.prepare(uniform_4k, n_devices=NDEV,
+                               config=KnnConfig(k=8,
+                                                hbm_budget_bytes=budget))
+    # the split is mandatory (full cloud over budget) and sufficient
+    # (per-chip model provably under it) -- and the answer stays exact
+    assert ps.hbm["streamed_prepare"]
+    assert ps.hbm["hbm_high_water_bytes"] <= budget < full
+    ids, d2, _c = ps.solve()
+    _ri, rd = oracle_reference(uniform_4k, 8, exclude_self=True)
+    assert check_route_result(uniform_4k, uniform_4k, ids, d2, rd,
+                              8) is None
+
+
+def _host_high_water(points, ndev, k=8):
+    """Per-chip model via host-only planning (no staging, no solve) --
+    the same dim/config prepare() itself would use."""
+    from cuda_knearests_tpu.config import grid_dim_for
+
+    cfg = KnnConfig(k=k)
+    plan = build_pod_plan(points, ndev, cfg,
+                          dim=grid_dim_for(points.shape[0], cfg.density),
+                          on_kernel_platform=False)
+    return max(chip_hbm_model(plan.meta, c, k) for c in plan.chips)
+
+
+def test_budget_refusal_typed(uniform_4k):
+    with pytest.raises(LaunchBudgetError) as ei:
+        PodKnnProblem.prepare(
+            uniform_4k, n_devices=2,
+            config=KnnConfig(k=8, hbm_budget_bytes=max(
+                1, _host_high_water(uniform_4k, 2) // 8)))
+    assert ei.value.kind == "oom"
+
+
+def test_auto_split_widens(uniform_4k):
+    """n_devices=None + a budget one slab cannot satisfy at small meshes:
+    the auto-splitter widens the mesh instead of refusing."""
+    budget = int(_host_high_water(uniform_4k, 1) * 0.6)
+    pa = PodKnnProblem.prepare(uniform_4k,
+                               config=KnnConfig(k=8,
+                                                hbm_budget_bytes=budget))
+    assert pa.meta.ndev > 1
+    assert pa.hbm["hbm_high_water_bytes"] <= budget
+
+
+# -- sync budget + ICI accounting ---------------------------------------------
+
+def _pod_site_lines():
+    out = {}
+    for s in syncflow.discover_sites():
+        if s.site_id in ("pod-solve-final", "pod-ici", "pod-query-final"):
+            for ln in range(s.line - 1, s.line + 6):
+                out[(s.kind, s.path, ln)] = s.site_id
+    return out
+
+
+def test_pod_solve_sync_budget_and_ici(uniform_4k):
+    maps = _pod_site_lines()
+    pp = PodKnnProblem.prepare(uniform_4k, n_devices=NDEV,
+                               config=KnnConfig(k=8))
+    dispatch.reset_stats()
+    with dispatch.trace_sites() as records:
+        pp.solve()
+    stats = dispatch.stats()
+    win = syncflow.WINDOWS["pod-solve"]
+    env = dict(syncflow.worst_case_env(), xchg=1, steps=pp.meta.steps,
+               hcap=pp.meta.hcap, ndev=pp.meta.ndev)
+    # proven bound EQUALS the measured window, and stays under budget
+    assert stats.host_syncs == win.syncs_bound(env) == 1
+    assert stats.host_syncs <= syncflow.evaluate(win.budget, env)
+    # the halo exchange rode ICI: counter == the window's symbolic byte
+    # model == the decomposition's exact wire volume
+    ici_model = syncflow.evaluate(win.sites["pod-ici"].bytes, env)
+    assert stats.ici_bytes == ici_model == pp.meta.halo_bytes() > 0
+    # per-site reconciliation: one annotated final fetch, one annotated
+    # ici record carrying exactly the modeled bytes
+    synced = [r for r in records if r.kind == "fetch" and r.synced]
+    assert len(synced) == 1
+    assert maps.get(("fetch", synced[0].path,
+                     synced[0].line)) == "pod-solve-final"
+    icis = [r for r in records if r.kind == "ici"]
+    assert len(icis) == 1 and icis[0].nbytes == ici_model
+    assert maps.get(("ici", icis[0].path, icis[0].line)) == "pod-ici"
+    # the exchange is cached: a second solve re-syncs once, ships nothing
+    dispatch.reset_stats()
+    pp.solve()
+    again = dispatch.stats()
+    assert again.host_syncs == 1 and again.ici_bytes == 0
+
+
+def test_pod_query_sync_budget(pod_4k, uniform_4k):
+    q = generate_uniform(300, seed=11)
+    pod_4k.solve()  # exchange + ready state cached
+    dispatch.reset_stats()
+    pod_4k.query(q)
+    stats = dispatch.stats()
+    win = syncflow.WINDOWS["pod-query"]
+    assert stats.host_syncs <= syncflow.evaluate(
+        win.budget, syncflow.worst_case_env())
+
+
+def test_pod_windows_registered():
+    """The pod windows are first-class citizens of the dataflow model:
+    registered routes, claimed sites discovered and annotated."""
+    assert syncflow.ROUTE_WINDOWS["pod-solve"] == "pod-solve"
+    assert syncflow.ROUTE_WINDOWS["pod-query"] == "pod-query"
+    ids = {s.site_id for s in syncflow.discover_sites() if s.site_id}
+    for sid in ("pod-solve-final", "pod-query-final", "pod-ici",
+                "pod-prepare-stage"):
+        assert sid in ids, sid
+
+
+# -- fuzz flavor: corpus replay + seeded-fault liveness -----------------------
+
+def _pod_corpus():
+    return sorted(glob.glob(os.path.join(CORPUS_DIR, "*-pod.npz")))
+
+
+@pytest.mark.parametrize("path", _pod_corpus() or ["<empty>"],
+                         ids=[os.path.basename(p)
+                              for p in _pod_corpus()] or ["none"])
+def test_pod_corpus_replays_clean(path):
+    """Every banked pod repro must stay fixed on the current tree (the
+    dev-found partitioner bugs -- the empty-chip export crash and the
+    stale slot-map candidate aliasing -- live here forever)."""
+    if path == "<empty>":
+        pytest.skip("no banked pod repros (none found yet)")
+    from cuda_knearests_tpu.fuzz.pod import _pod_failure, load_pod_case
+
+    b = load_pod_case(path)
+    assert _pod_failure(b["points"], b["k"], b["ndev"],
+                        quick=True) is None, \
+        f"banked pod repro regressed: {b['reason']}"
+
+
+@pytest.mark.parametrize("fault", ("drop-halo", "stale-directory"))
+def test_pod_seeded_fault_yields_banked_failure(fault, tmp_path,
+                                                monkeypatch):
+    from cuda_knearests_tpu.fuzz.pod import (PodCaseSpec, parse_pod_fault,
+                                             run_pod_case)
+
+    monkeypatch.setenv("KNTPU_POD_FAULT", fault)
+    assert parse_pod_fault() == fault
+    spec = PodCaseSpec(generator="uniform", seed=999983, n=257, k=8,
+                       ndev=NDEV)
+    f = run_pod_case(spec, bank_dir=str(tmp_path), minimize=False)
+    assert f is not None and f.kind == "mismatch"
+    assert f.banked and os.path.exists(f.banked)
+    assert str(tmp_path) in f.banked  # never the real corpus
+
+
+def test_pod_fault_diverts_from_real_corpus(monkeypatch):
+    from cuda_knearests_tpu.fuzz.pod import _safe_bank_dir
+
+    monkeypatch.setenv("KNTPU_POD_FAULT", "drop-halo")
+    diverted = _safe_bank_dir(CORPUS_DIR)
+    assert diverted is not None
+    assert os.path.abspath(diverted) != os.path.abspath(CORPUS_DIR)
+
+
+def test_pod_campaign_manifest_shape():
+    from cuda_knearests_tpu.fuzz.pod import run_pod_campaign
+
+    m = run_pod_campaign(n_cases=1, seed=7, bank_dir=None, minimize=False,
+                         ndev=2, log=None)
+    assert m["flavor"] == "pod" and m["completed_cases"] == 1
+    assert m["ok"] and m["n_devices"] == 2
+
+
+# -- plan shape sanity on the emulated mesh -----------------------------------
+
+def test_pod_plan_invariants(uniform_4k):
+    plan = build_pod_plan(uniform_4k, NDEV, KnnConfig(k=8), dim=11,
+                          on_kernel_platform=False)
+    meta = plan.meta
+    assert meta.steps >= 1  # multi-chip uniform: boxes cross boundaries
+    for d, chip in enumerate(plan.chips):
+        # ext CSR covers own + remote cells, counts non-negative
+        assert chip.ext_starts.shape == chip.ext_counts.shape
+        assert (chip.ext_counts >= 0).all()
+        assert chip.max_owner_dist <= meta.steps
+        # every class table slot stays inside the ext cell table
+        for cp in chip.classes:
+            own = np.asarray(jax.device_get(cp.own))
+            cand = np.asarray(jax.device_get(cp.cand))
+            assert own.max() < chip.ext_starts.size
+            assert cand.max() < chip.ext_starts.size
+            # no duplicate cand slots inside one row (the slot-map
+            # aliasing regression, pod-uniform-s10 corpus case)
+            for row in cand:
+                slots = row[row >= 0]
+                assert np.unique(slots).size == slots.size

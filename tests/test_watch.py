@@ -168,6 +168,37 @@ def test_artifact_good_requires_recall_stamp(tmp_path):
     assert tpu_watch._artifact_good(str(p), True)
 
 
+def test_artifact_good_pod_row_kind(tmp_path):
+    """ISSUE 12 satellite: pod weak-scaling rows are accepted as their own
+    row kind, but only with their halo accounting (halo_bytes +
+    ring_depth) and the proven sync bound satisfied -- and the
+    CPU-fallback refusal still applies by platform stamp, so a
+    forced-host-device capture can never be banked as the on-chip
+    record."""
+    p = tmp_path / "pod.json"
+    good_row = {"platform": "tpu", "unit": "queries/sec/chip", "value": 1,
+                "recall": 1.0, "pod_scaling": True, "halo_bytes": 4096,
+                "ring_depth": 2, "sync_bound_ok": True}
+    p.write_text(json.dumps({"rc": 0, "lines": [good_row]}))
+    assert tpu_watch._artifact_good(str(p))
+    # halo accounting missing -> refused
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        {k: v for k, v in good_row.items() if k != "halo_bytes"}]}))
+    assert not tpu_watch._artifact_good(str(p))
+    # proven sync bound failed -> refused
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        dict(good_row, sync_bound_ok=False)]}))
+    assert not tpu_watch._artifact_good(str(p))
+    # recall stamp still mandatory on the queries/sec family
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        {k: v for k, v in good_row.items() if k != "recall"}]}))
+    assert not tpu_watch._artifact_good(str(p))
+    # CPU platform (the forced-host-device emulation) -> refused
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        dict(good_row, platform="cpu")]}))
+    assert not tpu_watch._artifact_good(str(p))
+
+
 def test_artifact_good_partial_accepts_result_rows(tmp_path):
     """Experiment-matrix artifacts (kernel A/B, phases): a per-config error
     row is a result (e.g. blocked failing Mosaic); the step must not be
