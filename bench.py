@@ -736,6 +736,21 @@ _SERVE_SCENARIOS = ("serve_20k_steady", "serve_20k_mutating",
                     "fleet_failover")
 
 
+def _serve_scenario_names() -> list:
+    """The --serve row list, optionally filtered by BENCH_SERVE_SCENARIOS
+    (comma-separated subset) -- how tests and focused captures run one
+    scenario without paying for the whole family."""
+    raw = os.environ.get("BENCH_SERVE_SCENARIOS", "")
+    if not raw.strip():
+        return list(_SERVE_SCENARIOS)
+    want = [w.strip() for w in raw.split(",") if w.strip()]
+    unknown = [w for w in want if w not in _SERVE_SCENARIOS]
+    if unknown:
+        raise ValueError(f"unknown BENCH_SERVE_SCENARIOS entries "
+                         f"{unknown}: expected among {_SERVE_SCENARIOS}")
+    return want
+
+
 def _fleet_scenario(name: str) -> dict:
     """Fleet-tier serving rows (serve/fleet/, DESIGN.md section 17).
 
@@ -794,7 +809,7 @@ def _fleet_scenario(name: str) -> dict:
         t: {key: pt[key] for key in (
             "slo", "offered_rows", "served_rows", "completion", "refused",
             "sustained_qps", "sidecar", "p50_ms", "p99_ms", "p999_ms",
-            "slo_p99_budget_ms", "slo_ok")}
+            "slo_p99_budget_ms", "slo_ok", "decomposition")}
         for t, pt in summary["per_tenant"].items()}
     return {
         "config": f"serving fleet [{name}]: 4 tenants mixed SLO "
@@ -813,7 +828,8 @@ def _fleet_scenario(name: str) -> dict:
             "fleet_batches", "occupancy_mean", "jain_fairness",
             "slo_ok_all", "n_tenants", "host_syncs", "d2h_bytes",
             "h2d_bytes", "exec_cache_hits", "exec_cache_misses",
-            "exec_cache_evictions", "drr_quantum", "drr_dispatches")},
+            "exec_cache_evictions", "drr_quantum", "drr_dispatches",
+            "latency_decomposition")},
         "per_tenant": per_tenant,
     }
 
@@ -895,7 +911,7 @@ def serve_scenario(name: str) -> dict:
             "batches", "failed_batches", "failure_kinds", "occupancy_mean",
             "flushes", "host_syncs", "d2h_bytes", "h2d_bytes",
             "exec_cache_hits", "exec_cache_misses", "exec_cache_evictions",
-            "mutation_ratio")},
+            "mutation_ratio", "latency_decomposition")},
         **{key: summary[key] for key in summary if key.startswith("overlay_")},
     }
     if name == "serve_20k_contained_fault":
@@ -1164,7 +1180,7 @@ def main(argv=None) -> int:
         rc = 0
         if args.no_supervise:
             env = _env_fields(platform)
-            for name in _SERVE_SCENARIOS:
+            for name in _serve_scenario_names():
                 _watchdog.heartbeat()
                 try:
                     row = serve_scenario(name)
@@ -1182,7 +1198,7 @@ def main(argv=None) -> int:
         sup = Supervisor()
         a_fields = _analysis_fields()
         a_fields.update(_fuzz_fields())
-        for name in _SERVE_SCENARIOS:
+        for name in _serve_scenario_names():
             job_kind = ("fleet_scenario" if name.startswith("fleet_")
                         else "serve_scenario")
             row, failure = sup.run_job(name, {"job": job_kind,

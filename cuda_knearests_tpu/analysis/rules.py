@@ -370,6 +370,40 @@ def _r_bare_valueerror(ctx: FileContext) -> Iterator[Finding]:
                   "`# kntpu-ok: bare-valueerror -- <why>`")
 
 
+@rule("bare-timing", "error",
+      "bare time.time()/perf_counter() timing in serve/runtime (use "
+      "obs.spans / utils.stopwatch so timing stays observable)",
+      path_filter=("cuda_knearests_tpu/serve/",
+                   "cuda_knearests_tpu/runtime/"))
+def _r_bare_timing(ctx: FileContext) -> Iterator[Finding]:
+    """The kntpu-trace layer (obs/, DESIGN.md section 19) exists so every
+    serving/runtime timing is a span: named, attributed, decomposable,
+    exportable.  A bare ``time.time()`` / ``perf_counter()`` stopwatch on
+    these paths re-fragments the very accounting the layer unified -- the
+    measurement exists but no trace, histogram, or flight-recorder ring
+    ever sees it.  ``time.monotonic`` (the injected-clock default) and
+    ``time.sleep`` stay legal: they drive event loops, they don't measure.
+    Genuinely out-of-band timing carries a reasoned
+    ``# kntpu-ok: bare-timing -- <why>`` waiver.  The committed baseline
+    holds ZERO findings of this rule -- timing is observable-by-
+    construction from here on."""
+    bad = {"time.time", "time.perf_counter", "time.perf_counter_ns",
+           "perf_counter", "perf_counter_ns"}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name not in bad or ctx.waived("bare-timing", node):
+            continue
+        yield _mk(ctx, "bare-timing", "error", node,
+                  f"{name}() on a serve/runtime path times outside the "
+                  f"obs layer: no span, no histogram, no flight record",
+                  "time the region with obs.spans.span(...) (or "
+                  "obs.spans.now() for raw timestamps / utils.stopwatch "
+                  "for phase timers), or waive with "
+                  "`# kntpu-ok: bare-timing -- <why>`")
+
+
 @rule("jnp-in-loop", "warning",
       "jnp array construction inside a host loop",
       path_filter=("cuda_knearests_tpu/",))

@@ -345,6 +345,11 @@ NONWINDOW: Dict[str, str] = {
 KNOWN_RAW: Dict[str, str] = {
     "api.KnnProblem.prepare": "oracle backend: kd-tree build reads the "
                               "staged points once at prepare time",
+    "api.KnnProblem._prepare_impl": "oracle backend: kd-tree build reads "
+                                    "the staged points once at prepare "
+                                    "time (prepare()'s traced body -- the "
+                                    "public wrapper only opens the "
+                                    "knn.prepare span)",
     "api.KnnProblem._query_ids": "oracle backend: permutation readback on "
                                  "the host-native kd-tree route (the grid "
                                  "engine never takes this branch)",

@@ -29,6 +29,7 @@ import jax
 import numpy as np
 
 from .config import KnnConfig
+from .obs import spans as _obs_spans
 from .ops.gridhash import GridHash, build_grid
 from .ops.solve import (KnnResult, SolvePlan, brute_force_by_index, build_plan,
                         solve)
@@ -141,6 +142,14 @@ class KnnProblem:
         (kind 'invalid-input').  n = 0 and k > n are legal degraded modes
         (empty results / -1-inf-padded rows), not errors.
         """
+        with _obs_spans.span("knn.prepare",
+                             k=int((config or KnnConfig()).k)):
+            return cls._prepare_impl(points, config, dim, validate)
+
+    @classmethod
+    def _prepare_impl(cls, points, config: KnnConfig | None = None,
+                      dim: int | None = None,
+                      validate: bool = True) -> "KnnProblem":
         from .io import validate_or_raise
 
         config = config or KnnConfig()
@@ -221,6 +230,17 @@ class KnnProblem:
         /root/reference/test_knearests.cu:194-214) promoted to a first-class
         engine, and the fastest exact CPU route (measured 3-5x the grid's
         dense route on the 900k north star, DESIGN.md section 5)."""
+        with _obs_spans.span("knn.solve", n=int(self.grid.n_points),
+                             k=int(self.config.k),
+                             route=self._route_name()):
+            return self._solve_impl()
+
+    def _route_name(self) -> str:
+        if self.config.backend == "oracle":
+            return "oracle"
+        return "adaptive" if self._adaptive_eligible() else "legacy"
+
+    def _solve_impl(self) -> KnnResult:
         if self.grid.n_points == 0:
             # degraded mode: an empty cloud solves to empty, fully-certified
             # results (there is nothing a neighbor table could miss)
@@ -360,10 +380,16 @@ class KnnProblem:
         containing the query; a pure-host f64 epilogue over the fetched
         rows, zero extra device syncs).
         """
+        with _obs_spans.span("knn.query", k=int(k or self.config.k),
+                             route=self._route_name()) as sp:
+            return self._query_impl(queries, k, planes, sp)
+
+    def _query_impl(self, queries, k, planes, sp):
         from .io import validate_or_raise
 
         k = self.config.k if k is None else k
         queries = validate_or_raise(queries, k=k, what="queries")
+        sp.set(m=int(queries.shape[0]))
         k = int(k)
         if k > self.config.k:
             raise InvalidKError(

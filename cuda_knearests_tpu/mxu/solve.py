@@ -29,8 +29,10 @@ from typing import Optional
 import jax
 import numpy as np
 
+from ..obs import spans as _spans
 from ..runtime import dispatch as _dispatch
 from ..utils.memory import InvalidConfigError
+from ..utils.profiling import annotate
 from .scorer import FAULTS, _MXU_TILE_BYTES, solve_blocks_xla
 from .topk import BLOCK, interleave_slots, per_block_m, recall_bound
 
@@ -234,10 +236,15 @@ def solve_general(points, k: int = 10, recall_target: float = 1.0,
         qid = np.full((qp.shape[0],), -1, np.int32)
         if exclude_self:
             qid[:m_q] = np.arange(m_q, dtype=np.int32)
-        sel_i, sel_s, cert_d = select_pallas(
-            _dispatch.stage(qp), _dispatch.stage(qid),  # syncflow: mxu-stage
-            _dispatch.stage(pil), _dispatch.stage(cid_il),  # syncflow: mxu-stage
-            k, m, d, exclude_self, interpret)
+        # named profiler scope: the blocked-matmul selection shows as
+        # 'kntpu:mxu-select' in jax.profiler traces (and as a phase span
+        # in the kntpu-trace timeline) instead of anonymous jit regions
+        with _spans.span("solve.mxu.select", backend="pallas",
+                         n_blocks=g, m=m), annotate("kntpu:mxu-select"):
+            sel_i, sel_s, cert_d = select_pallas(
+                _dispatch.stage(qp), _dispatch.stage(qid),  # syncflow: mxu-stage
+                _dispatch.stage(pil), _dispatch.stage(cid_il),  # syncflow: mxu-stage
+                k, m, d, exclude_self, interpret)
         sel_i, cert_d = sel_i[:m_q], cert_d[:m_q]
         backend = "pallas"
     else:
@@ -248,17 +255,20 @@ def solve_general(points, k: int = 10, recall_target: float = 1.0,
         qid = np.full((mq_pad,), -1, np.int32)
         if exclude_self:
             qid[:m_q] = np.arange(m_q, dtype=np.int32)
-        sel_i, _sel_s, cert_d = solve_blocks_xla(
-            _dispatch.stage(pts_il), _dispatch.stage(cid_il),  # syncflow: mxu-stage
-            _dispatch.stage(qpad), _dispatch.stage(qid),  # syncflow: mxu-stage
-            k, m, exclude_self, qc, fault)
+        with _spans.span("solve.mxu.select", backend="xla",
+                         n_blocks=g, m=m), annotate("kntpu:mxu-select"):
+            sel_i, _sel_s, cert_d = solve_blocks_xla(
+                _dispatch.stage(pts_il), _dispatch.stage(cid_il),  # syncflow: mxu-stage
+                _dispatch.stage(qpad), _dispatch.stage(qid),  # syncflow: mxu-stage
+                k, m, exclude_self, qc, fault)
         sel_i, cert_d = sel_i[:m_q], cert_d[:m_q]
         backend = "xla"
 
     # ONE batched readback of the selection -- the mxu-brute window's
     # single sync; the exact distances are a host epilogue over it
     ids_sel, cert = _dispatch.fetch(sel_i, cert_d)  # syncflow: mxu-final
-    ids, d2 = _host_rescore(points, queries_v, np.asarray(ids_sel))
+    with _spans.span("solve.mxu.rescore", rows=m_q):
+        ids, d2 = _host_rescore(points, queries_v, np.asarray(ids_sel))
     cert = np.array(cert)
     n_unc = int((~cert).sum())
     if refine == "brute" and n_unc:
@@ -266,27 +276,30 @@ def solve_general(points, k: int = 10, recall_target: float = 1.0,
         from ..ops.query import brute_force_by_coords
         from ..ops.solve import brute_force_by_index
 
-        bad = np.nonzero(~cert)[0].astype(np.int32)
-        pts_dev = _dispatch.stage(points)  # syncflow: mxu-fallback-stage
-        if self_solve:
-            q_idx = _pad_pow2(bad, fill=-1)
-            b_i, _b_d = brute_force_by_index(
-                pts_dev, _dispatch.stage(q_idx), k, exclude_self)  # syncflow: mxu-fallback-stage
-            b_i = np.asarray(_dispatch.fetch(b_i))  # syncflow: mxu-fallback
-            sel = q_idx >= 0
-            rows = q_idx[sel]
-            r_ids, r_d2 = _host_rescore(points, queries_v[rows], b_i[sel])
-        else:
-            b_i, _b_d = brute_force_by_coords(
-                pts_dev, _dispatch.stage(queries_v[bad]), k)  # syncflow: mxu-fallback-stage
-            b_i = np.asarray(_dispatch.fetch(b_i))  # syncflow: mxu-fallback
-            rows = bad
-            r_ids, r_d2 = _host_rescore(points, queries_v[rows], b_i)
-        # fallback rows land through the SAME realization as certified
-        # rows -- one canonical (d2, id) form for every row of this route
-        ids[rows] = r_ids
-        d2[rows] = r_d2
-        cert[bad] = True
+        with _spans.span("solve.mxu.refine", rows=n_unc), \
+                annotate("kntpu:mxu-refine"):
+            bad = np.nonzero(~cert)[0].astype(np.int32)
+            pts_dev = _dispatch.stage(points)  # syncflow: mxu-fallback-stage
+            if self_solve:
+                q_idx = _pad_pow2(bad, fill=-1)
+                b_i, _b_d = brute_force_by_index(
+                    pts_dev, _dispatch.stage(q_idx), k, exclude_self)  # syncflow: mxu-fallback-stage
+                b_i = np.asarray(_dispatch.fetch(b_i))  # syncflow: mxu-fallback
+                sel = q_idx >= 0
+                rows = q_idx[sel]
+                r_ids, r_d2 = _host_rescore(points, queries_v[rows],
+                                            b_i[sel])
+            else:
+                b_i, _b_d = brute_force_by_coords(
+                    pts_dev, _dispatch.stage(queries_v[bad]), k)  # syncflow: mxu-fallback-stage
+                b_i = np.asarray(_dispatch.fetch(b_i))  # syncflow: mxu-fallback
+                rows = bad
+                r_ids, r_d2 = _host_rescore(points, queries_v[rows], b_i)
+            # fallback rows land through the SAME realization as
+            # certified rows -- one canonical (d2, id) form for every row
+            ids[rows] = r_ids
+            d2[rows] = r_d2
+            cert[bad] = True
     return MxuResult(neighbors=ids, dists_sq=d2, certified=cert,
                      uncert_count=n_unc, bound=bound, m=m, n_blocks=g,
                      backend=backend)

@@ -1,0 +1,42 @@
+"""kntpu-trace: unified observability (DESIGN.md section 19).
+
+Four pieces, one event vocabulary:
+
+* :mod:`.spans`   -- structured span tracer: nested, attributed timing
+  regions with a stable schema, near-zero cost when disabled, stitched
+  across processes by (pid, job) tags and wall-anchored timestamps.
+* :mod:`.metrics` -- counters/gauges/fixed-bucket histograms and the one
+  unified snapshot (``metrics`` wire command, ``--metrics-jsonl``).
+* :mod:`.recorder` -- the flight recorder: a bounded ring of recent
+  spans + metric deltas, spilled line-flushed so a SIGKILLed worker's
+  last milliseconds land in the failure artifact.
+* :mod:`.export`  -- merge per-process trace spills into one Chrome
+  trace-event JSON (Perfetto-loadable).
+
+``python -m cuda_knearests_tpu.obs`` runs the CPU smoke: capture a 20k
+solve trace, validate the schema, bound the disabled-mode overhead, and
+write the merged Perfetto trace + a metrics snapshot as artifacts.
+
+The package imports no jax: infrastructure (watchdog, worker entry,
+supervisor) arms tracing before any backend exists.
+"""
+
+from . import metrics, recorder, spans
+from .metrics import REGISTRY, Histogram, metrics_snapshot
+from .recorder import FLIGHT
+from .spans import capture, emit, event, set_process_tag, span
+
+__all__ = [
+    "FLIGHT",
+    "Histogram",
+    "REGISTRY",
+    "capture",
+    "emit",
+    "event",
+    "metrics",
+    "metrics_snapshot",
+    "recorder",
+    "set_process_tag",
+    "span",
+    "spans",
+]

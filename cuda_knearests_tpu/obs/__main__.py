@@ -1,0 +1,156 @@
+"""``python -m cuda_knearests_tpu.obs`` -- the observability CPU smoke.
+
+One bounded, chip-free gate (scripts/check.sh + CI):
+
+1. **Trace capture**: solve the 20k fixture with tracing enabled
+   (collector + per-process jsonl spill), then VALIDATE -- every event
+   passes the schema check, the instrumented seams all appear
+   (``knn.prepare`` / ``knn.solve`` / ``dispatch.fetch``), and the
+   dispatch child spans nest INSIDE the solve span tree (depth > 0), so
+   sync counters land in the timeline rather than beside it.
+2. **Disabled-overhead bound**: measure the disabled ``span()`` fast
+   path directly (per-call cost over a tight loop), scale it by the
+   span count one traced solve actually emits, and assert the implied
+   per-solve overhead is under ``--overhead-pct`` (default 2%) of the
+   measured solve time.  Deterministic: bounds the machinery itself, not
+   two noisy wall-clock runs against each other.
+3. **Artifacts**: the merged Chrome trace (Perfetto-loadable) and one
+   metrics snapshot line land in ``--out-dir`` -- CI uploads them.
+
+Exit 0 iff every check passes; one JSON summary line either way.
+``KNTPU_OBS_N`` scales the fixture for constrained runners.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+
+def _overhead_per_call_s(calls: int = 200_000) -> float:
+    """Measured cost of one DISABLED span() call (enter+exit included)."""
+    from . import spans as _spans
+
+    assert not _spans.enabled()
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        with _spans.span("overhead.probe"):
+            pass
+    return (time.perf_counter() - t0) / calls
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cuda_knearests_tpu.obs",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--out-dir", default="obs_artifacts",
+                    help="artifact directory (merged trace + metrics "
+                         "snapshot; default ./obs_artifacts)")
+    ap.add_argument("--n", type=int,
+                    default=int(os.environ.get("KNTPU_OBS_N", "20000")),
+                    help="fixture size (default 20000; KNTPU_OBS_N "
+                         "overrides)")
+    ap.add_argument("--overhead-pct", type=float, default=2.0,
+                    help="disabled-mode overhead bound, percent of one "
+                         "solve (default 2.0)")
+    args = ap.parse_args(argv)
+
+    from ..utils.platform import enable_compile_cache, honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    enable_compile_cache()
+
+    from .. import KnnConfig, KnnProblem
+    from ..io import generate_uniform
+    from . import export as _export
+    from . import metrics as _metrics
+    from . import spans as _spans
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    _spans.set_process_tag("obs-smoke")
+    failures: List[str] = []
+    summary: dict = {"config": "obs smoke", "n": args.n}
+
+    points = generate_uniform(args.n, seed=5)
+    queries = generate_uniform(max(256, args.n // 16), seed=6)
+
+    # 1. traced solve: collector + spill, then schema/seam validation
+    sink = _spans.start_file_trace(os.path.join(
+        args.out_dir, f"trace_obs-smoke_{os.getpid()}.jsonl"))
+    with _spans.capture() as events:
+        problem = KnnProblem.prepare(points, KnnConfig(k=8))
+        problem.solve()
+        problem.query(queries)
+    sink.close()
+    bad = [(ev.get("name"), why) for ev in events
+           if (why := _spans.validate_event(ev)) is not None]
+    if bad:
+        failures.append(f"schema violations: {bad[:5]}")
+    names = {ev["name"] for ev in events}
+    for need in ("knn.prepare", "knn.solve", "knn.query",
+                 "dispatch.fetch"):
+        if need not in names:
+            failures.append(f"missing expected span {need!r}")
+    nested_fetch = [ev for ev in events if ev["name"] == "dispatch.fetch"
+                    and ev["depth"] > 0]
+    if not nested_fetch:
+        failures.append("dispatch.fetch spans did not nest inside the "
+                        "solve span tree")
+    summary["events"] = len(events)
+    solve_events = [ev for ev in events if ev["name"] == "knn.solve"]
+    solve_s = (solve_events[0]["dur_ms"] / 1e3 if solve_events else 0.0)
+
+    # 2. disabled-overhead bound (the near-zero-cost contract)
+    spans_per_solve = sum(1 for ev in events)
+    per_call = _overhead_per_call_s()
+    overhead_pct = (100.0 * spans_per_solve * per_call / solve_s
+                    if solve_s > 0 else 0.0)
+    summary.update(spans_per_solve=spans_per_solve,
+                   disabled_ns_per_span=round(per_call * 1e9, 1),
+                   solve_s=round(solve_s, 4),
+                   disabled_overhead_pct=round(overhead_pct, 4))
+    if overhead_pct >= args.overhead_pct:
+        failures.append(
+            f"disabled-mode overhead {overhead_pct:.3f}% >= "
+            f"{args.overhead_pct}% bound")
+
+    # 3. metrics registry sanity + snapshot artifact
+    _metrics.REGISTRY.counter("obs.smoke_runs").inc()
+    hist = _metrics.Histogram("obs.probe_ms")
+    for v in (1.0, 2.0, 4.0, 8.0):
+        hist.observe(v)
+    if hist.snapshot()["count"] != 4 or hist.percentile(0.5) is None:
+        failures.append("histogram self-check failed")
+    snap = _metrics.metrics_snapshot()
+    for key in ("v", "ts", "counters", "histograms", "dispatch",
+                "exec_cache"):
+        if key not in snap:
+            failures.append(f"metrics snapshot missing {key!r}")
+    with open(os.path.join(args.out_dir, "metrics.jsonl"), "a",
+              encoding="utf-8") as f:
+        f.write(json.dumps(snap) + "\n")
+
+    # 4. merged Perfetto trace artifact
+    exp = _export.export_dir(
+        args.out_dir, os.path.join(args.out_dir, "trace_merged.json"))
+    summary["trace_events"] = exp["events"]
+    if exp["events"] == 0:
+        failures.append("merged trace is empty")
+    with open(os.path.join(args.out_dir, "trace_merged.json"),
+              encoding="utf-8") as f:
+        if not json.load(f).get("traceEvents"):
+            failures.append("merged trace has no traceEvents")
+
+    summary["ok"] = not failures
+    if failures:
+        summary["failures"] = failures
+    print(json.dumps(summary), flush=True)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

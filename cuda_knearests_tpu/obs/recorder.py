@@ -1,0 +1,216 @@
+"""Flight recorder: the last milliseconds of a dying process, bounded.
+
+A SIGKILLed worker (libtpu abort, the supervisor's row timeout, the
+failover drill's deliberate kill) used to leave nothing but an exit code
+and a stderr tail.  The recorder keeps a BOUNDED in-memory ring of recent
+span events plus metric deltas and -- when armed with a spill path --
+mirrors every event to a line-flushed ``.jsonl`` file, so the evidence
+survives even a kill the process never sees:
+
+* the ring feeds the watchdog's stall artifact (utils/watchdog.py dumps
+  ``FLIGHT.dump()`` next to the faulthandler tracebacks), and
+* the spill feeds the supervisor: on any worker failure it reads the
+  file's tail into ``FailureRecord.flight_tail``, so a crash-injected
+  bench row's failure artifact reconstructs the killed worker's last
+  >= 32 spans (the ISSUE 13 acceptance pin, tests/test_obs.py).
+
+Fault injection: :meth:`FlightRecorder.kill_after_events` arms a
+deterministic mid-flight SIGKILL after the N-th recorded event -- the
+``KNTPU_FAULT=abort-after:<label>:<n>`` hook (runtime/worker.py), which is
+how the spill-survives-SIGKILL property is tested without hardware.
+
+No jax import (armed by the worker entry before any backend exists).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import IO, Deque, List, Optional
+
+from . import spans as _spans
+
+#: Default ring capacity (events).  Generous for "last milliseconds":
+#: a serve batch emits ~3 spans, so 256 events cover ~85 batches.
+DEFAULT_CAPACITY = 256
+
+#: Spill-path env var: the supervisor points each worker attempt at its
+#: own file, then harvests the tail on failure.
+FLIGHT_FILE_ENV = "KNTPU_FLIGHT_FILE"
+
+
+class FlightRecorder:
+    """Bounded ring of recent events; optionally spilled to a jsonl file
+    (line-flushed: survives SIGKILL).  Registers itself as a spans sink
+    when armed, so every span/event in the process lands here."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = int(capacity)
+        self.events: Deque[dict] = deque(maxlen=self.capacity)
+        self.recorded = 0
+        self.tag = ""
+        self.armed = False
+        self._lock = threading.Lock()
+        self._spill: Optional[IO[str]] = None
+        self._spill_path: Optional[str] = None
+        self._kill_after: Optional[int] = None
+        self._metric_base: dict = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def arm(self, tag: str = "", spill_path: Optional[str] = None,
+            capacity: Optional[int] = None) -> "FlightRecorder":
+        """Start recording (idempotent): register as a spans sink, open
+        the spill file when given one, and drop a ``recorder.arm``
+        marker event so even an immediately-wedged process leaves at
+        least one record."""
+        with self._lock:
+            self.tag = tag or self.tag
+            if capacity and capacity != self.capacity:
+                self.capacity = int(capacity)
+                self.events = deque(self.events, maxlen=self.capacity)
+            if spill_path and spill_path != self._spill_path:
+                if self._spill is not None:
+                    try:
+                        self._spill.close()
+                    except OSError:
+                        pass
+                d = os.path.dirname(spill_path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._spill = open(spill_path, "a", encoding="utf-8")
+                self._spill_path = spill_path
+            self.armed = True
+        _spans.add_sink(self)
+        self._metric_base = self._dispatch_counters()
+        self.record({"v": _spans.SCHEMA, "kind": "event",
+                     "name": "recorder.arm", "t0": time.time(),
+                     "dur_ms": 0.0, "depth": 0, "parent": "",
+                     "pid": os.getpid(), "job": tag, "tid": "main",
+                     "trace_id": None, "attrs": {"tag": tag}})
+        return self
+
+    def disarm(self) -> None:
+        _spans.remove_sink(self)
+        with self._lock:
+            self.armed = False
+            if self._spill is not None:
+                try:
+                    self._spill.close()
+                except OSError:
+                    pass
+                self._spill = None
+                self._spill_path = None
+
+    # -- recording ----------------------------------------------------------
+
+    def __call__(self, event: dict) -> None:
+        self.record(event)
+
+    def record(self, event: dict) -> None:
+        kill = False
+        with self._lock:
+            if not self.armed:
+                return
+            self.events.append(event)
+            self.recorded += 1
+            if self._spill is not None:
+                try:
+                    self._spill.write(json.dumps(event) + "\n")
+                    self._spill.flush()
+                except (OSError, TypeError, ValueError):
+                    pass          # spill is best-effort; the ring survives
+            kill = (self._kill_after is not None
+                    and self.recorded >= self._kill_after)
+        if kill:
+            # the abort-after fault: die exactly as hard as libtpu would
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    @staticmethod
+    def _dispatch_counters() -> dict:
+        try:
+            from ..runtime import dispatch as _dispatch
+
+            return dict(_dispatch.stats_dict())
+        except Exception:  # noqa: BLE001 -- the recorder must work before/without the dispatch layer
+            return {}
+
+    def metric_delta(self) -> dict:
+        """Record (and return) the dispatch-counter delta since the last
+        call -- the ``spans+metric deltas`` half of the ring's contract.
+        Cheap; phase boundaries and the watchdog trip path call it."""
+        now_c = self._dispatch_counters()
+        delta = {k: now_c.get(k, 0) - self._metric_base.get(k, 0)
+                 for k in now_c}
+        self._metric_base = now_c
+        ev = {"v": _spans.SCHEMA, "kind": "metrics",
+              "name": "dispatch.delta", "t0": time.time(), "dur_ms": 0.0,
+              "depth": 0, "parent": "", "pid": os.getpid(),
+              "job": self.tag, "tid": "main", "trace_id": None,
+              "attrs": delta}
+        self.record(ev)
+        return ev
+
+    def kill_after_events(self, n: int) -> None:
+        """Arm the deterministic mid-flight SIGKILL (fault injection):
+        the process dies upon recording its ``n``-th event, counted from
+        process start."""
+        with self._lock:
+            self._kill_after = max(1, int(n))
+
+    # -- reading ------------------------------------------------------------
+
+    def tail(self, n: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            evs = list(self.events)
+        return evs if n is None else evs[-int(n):]
+
+    def dump(self) -> dict:
+        """The crash-artifact document: ring tail + drop accounting +
+        one final metric delta."""
+        with self._lock:
+            dropped = max(0, self.recorded - len(self.events))
+        return {"v": _spans.SCHEMA, "tag": self.tag, "pid": os.getpid(),
+                "recorded": self.recorded, "dropped": dropped,
+                "events": self.tail()}
+
+
+#: The process-wide recorder (one per process by design: the (pid, tag)
+#: pair identifies it across the merged artifact).
+FLIGHT = FlightRecorder()
+
+
+def arm(tag: str = "", spill_path: Optional[str] = None,
+        capacity: Optional[int] = None) -> FlightRecorder:
+    """Arm the process-wide recorder.  ``spill_path`` defaults to the
+    supervisor-provided ``KNTPU_FLIGHT_FILE`` env var."""
+    if spill_path is None:
+        spill_path = os.environ.get(FLIGHT_FILE_ENV) or None
+    return FLIGHT.arm(tag=tag, spill_path=spill_path, capacity=capacity)
+
+
+def read_spill_tail(path: str, n: int = 64) -> List[dict]:
+    """Last ``n`` well-formed events of a spill file (the supervisor's
+    harvest on worker failure).  Missing/corrupt files yield []."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return []
+    out: List[dict] = []
+    for line in lines[-int(n):]:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError:
+            continue          # a half-written final line (killed mid-write)
+        if isinstance(ev, dict):
+            out.append(ev)
+    return out

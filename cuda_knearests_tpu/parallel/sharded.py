@@ -48,8 +48,10 @@ if _shard_map is None:  # pragma: no cover - depends on installed jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..config import DOMAIN_SIZE, KnnConfig, default_ring_radius
+from ..obs import spans as _spans
 from ..runtime import dispatch as _dispatch
 from ..utils.memory import InvalidConfigError, InvalidKError
+from ..utils.profiling import annotate
 from ..ops.adaptive import (ClassPlan, _class_flat, _prepack_kernel_inputs,
                             _rows2d, build_class_specs, select_radii)
 from ..ops.gridhash import cell_coords
@@ -786,18 +788,21 @@ class ShardedKnnProblem:
         cfg, meta = self.config, self.meta
         epilogue = cfg.resolved_epilogue()
         outs = {}
-        for d in self.local_chips():
-            if not self.chip_plans[d].classes:   # empty slab: nothing to do
-                outs[d] = None
-                continue
-            (spts, ext_pts, ext_ids, ext_starts, ext_counts, classes,
-             inv_loc, lo_rows, hi_rows) = self._chip_ready(d)
-            outs[d] = _chip_solve(
-                spts, ext_pts, ext_ids, ext_starts,
-                ext_counts, classes, inv_loc, lo_rows, hi_rows,
-                cfg.k, cfg.exclude_self, meta.domain, cfg.interpret,
-                cfg.stream_tile, cfg.effective_kernel(), epilogue,
-                float(cfg.recall_target))
+        with _spans.span("solve.sharded.chips",
+                         chips=len(self.local_chips())), \
+                annotate("kntpu:sharded-chip-solves"):
+            for d in self.local_chips():
+                if not self.chip_plans[d].classes:  # empty slab: no work
+                    outs[d] = None
+                    continue
+                (spts, ext_pts, ext_ids, ext_starts, ext_counts, classes,
+                 inv_loc, lo_rows, hi_rows) = self._chip_ready(d)
+                outs[d] = _chip_solve(
+                    spts, ext_pts, ext_ids, ext_starts,
+                    ext_counts, classes, inv_loc, lo_rows, hi_rows,
+                    cfg.k, cfg.exclude_self, meta.domain, cfg.interpret,
+                    cfg.stream_tile, cfg.effective_kernel(), epilogue,
+                    float(cfg.recall_target))
         # memoized for stats() margin telemetry (released by drop_ready)
         self._device_out_cache = outs
         return outs

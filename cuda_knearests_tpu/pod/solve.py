@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import KnnConfig
+from ..obs import spans as _spans
 from ..ops.adaptive import (ClassPlan, _class_inverse_update,
                             _prepack_kernel_inputs, launch_class_query)
 from ..ops.topk import INVALID_ID
@@ -44,6 +45,7 @@ from ..parallel.sharded import _chip_solve
 from ..runtime import dispatch as _dispatch
 from ..utils.memory import (InvalidConfigError, InvalidKError,
                             LaunchBudgetError)
+from ..utils.profiling import annotate
 from . import halo as _halo
 from .partition import (PodChipPlan, PodDirectory, PodMeta, PodPlan,
                         build_pod_plan, route_queries)
@@ -242,10 +244,16 @@ class PodKnnProblem:
         traffic, not a host sync (the pod-solve window's central claim)."""
         if self._exchanged:
             return
-        program = _halo.exchange_program(self.meta, self.mesh)
-        halo_pts, halo_ids = program(self.dev["bucket_pts"],
-                                     self.dev["bucket_ids"],
-                                     self.dev["export_idx"])
+        # named profiler scope: the ppermute ring shows as
+        # 'kntpu:halo-exchange' in jax.profiler traces; the obs span puts
+        # the same phase (with its modeled wire volume) on the timeline
+        with _spans.span("solve.pod.halo", steps=self.meta.steps,
+                         ici_bytes=self.meta.halo_bytes()), \
+                annotate("kntpu:halo-exchange"):
+            program = _halo.exchange_program(self.meta, self.mesh)
+            halo_pts, halo_ids = program(self.dev["bucket_pts"],
+                                         self.dev["bucket_ids"],
+                                         self.dev["export_idx"])
         self.dev["halo_pts"] = halo_pts
         self.dev["halo_ids"] = halo_ids
         if self.meta.steps and self.meta.ndev > 1:
@@ -283,15 +291,18 @@ class PodKnnProblem:
         no host sync happens here."""
         cfg = self.config
         outs: Dict[int, Optional[tuple]] = {}
-        for d in range(self.meta.ndev):
-            if not self.chip_plans[d].classes:
-                outs[d] = None
-                continue
-            state = self._chip_ready(d)
-            outs[d] = _chip_solve(
-                *state, cfg.k, cfg.exclude_self, self.meta.domain,
-                cfg.interpret, cfg.stream_tile, cfg.effective_kernel(),
-                cfg.resolved_epilogue(), float(cfg.recall_target))
+        with _spans.span("solve.pod.chips", ndev=self.meta.ndev), \
+                annotate("kntpu:pod-chip-solves"):
+            for d in range(self.meta.ndev):
+                if not self.chip_plans[d].classes:
+                    outs[d] = None
+                    continue
+                state = self._chip_ready(d)
+                outs[d] = _chip_solve(
+                    *state, cfg.k, cfg.exclude_self, self.meta.domain,
+                    cfg.interpret, cfg.stream_tile,
+                    cfg.effective_kernel(), cfg.resolved_epilogue(),
+                    float(cfg.recall_target))
         return outs
 
     def solve(self, device_out=None

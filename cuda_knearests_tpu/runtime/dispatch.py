@@ -45,6 +45,7 @@ from typing import Any, Callable, Optional, Tuple
 import numpy as np
 
 from ..config import DEFAULT_EXEC_CACHE_ENTRIES
+from ..obs import spans as _spans
 
 # The one-sync solve contract (DESIGN.md section 12): a solve or query call
 # completes with at most one batched readback of its assembled results, plus
@@ -179,13 +180,22 @@ def fetch(*trees: Any) -> Any:
     import jax
 
     dev = _device_leaves(trees)
+    nbytes = int(sum(l.nbytes for l in dev))
     if dev:
         with _STATS_LOCK:
             _STATS.host_syncs += 1
-            _STATS.d2h_bytes += int(sum(l.nbytes for l in dev))
+            _STATS.d2h_bytes += nbytes
     if _SITE_TRACE is not None:
-        _record_site("fetch", int(sum(l.nbytes for l in dev)), bool(dev))
-    out = jax.device_get(trees)
+        _record_site("fetch", nbytes, bool(dev))
+    if _spans.enabled():
+        # auto child span: the one host sync lands INSIDE whatever span
+        # tree the caller holds open (solve phase / serve device window),
+        # so sync accounting appears in the trace timeline, not beside it
+        with _spans.span("dispatch.fetch", nbytes=nbytes,
+                         synced=bool(dev)):
+            out = jax.device_get(trees)
+    else:
+        out = jax.device_get(trees)
     return out[0] if len(out) == 1 else out
 
 
@@ -207,6 +217,11 @@ def stage(x: Any, dtype: Any = None, device: Any = None):
             _STATS.h2d_bytes += int(arr.nbytes)
         if _SITE_TRACE is not None:
             _record_site("stage", int(arr.nbytes), False)
+        if _spans.enabled():
+            with _spans.span("dispatch.stage", nbytes=int(arr.nbytes)):
+                if device is not None:
+                    return jax.device_put(arr, device)
+                return jnp.asarray(arr)
         if device is not None:
             return jax.device_put(arr, device)
         return jnp.asarray(arr)
@@ -231,6 +246,7 @@ def ici(nbytes: int) -> None:
         _STATS.ici_bytes += int(nbytes)
     if _SITE_TRACE is not None:
         _record_site("ici", int(nbytes), False)
+    _spans.event("dispatch.ici", nbytes=int(nbytes))
 
 
 def signature(tree: Any, *statics: Any) -> Tuple:

@@ -41,9 +41,11 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 from typing import Optional, Tuple
 
+from ..obs import recorder as _recorder
 from ..utils.memory import classify_fault_text
 from ..utils.platform import _env_number, backoff_schedule
 
@@ -80,6 +82,10 @@ class FailureRecord:
     signal:      POSIX signal number that killed the child, else None.
     attempts:    how many child launches were spent on this job (>= 1).
     stderr_tail: last chunk of the final child's stderr -- the evidence.
+    flight_tail: the killed worker's flight-recorder tail (obs/recorder):
+                 its last recorded span/metric events, harvested from the
+                 line-flushed spill file, so even a SIGKILL leaves the
+                 final milliseconds reconstructable (DESIGN.md s19).
     """
 
     kind: str
@@ -89,6 +95,7 @@ class FailureRecord:
     signal: Optional[int] = None
     attempts: int = 1
     stderr_tail: str = ""
+    flight_tail: list = dataclasses.field(default_factory=list)
 
     def __post_init__(self):
         if self.kind not in FAILURE_KINDS:
@@ -101,14 +108,16 @@ class FailureRecord:
         return {"kind": self.kind, "config": self.config,
                 "message": self.message, "rc": self.rc,
                 "signal": self.signal, "attempts": int(self.attempts),
-                "stderr_tail": self.stderr_tail}
+                "stderr_tail": self.stderr_tail,
+                "flight_tail": list(self.flight_tail)}
 
     @classmethod
     def from_json(cls, d: dict) -> "FailureRecord":
         return cls(kind=d["kind"], config=d["config"], message=d["message"],
                    rc=d.get("rc"), signal=d.get("signal"),
                    attempts=int(d.get("attempts", 1)),
-                   stderr_tail=d.get("stderr_tail", ""))
+                   stderr_tail=d.get("stderr_tail", ""),
+                   flight_tail=list(d.get("flight_tail", [])))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -235,13 +244,36 @@ class Supervisor:
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
         return env
 
+    def _flight_path(self, label: str, attempt: int) -> str:
+        """Per-attempt flight-recorder spill path handed to the child via
+        KNTPU_FLIGHT_FILE: the worker mirrors its span ring here
+        (line-flushed), and any failure -- SIGKILL included -- lets the
+        parent harvest the tail into the FailureRecord."""
+        d = os.environ.get("KNTPU_FAILURE_DIR") or tempfile.gettempdir()
+        os.makedirs(d, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "-_." else "-"
+                       for c in label)
+        return os.path.join(
+            d, f"flight_{safe}_{os.getpid()}_{attempt}.jsonl")
+
     def _run_once(self, label: str, job: dict, attempt: int) \
             -> Tuple[Optional[dict], Optional[FailureRecord]]:
         spec = json.dumps({**job, "label": label, "attempt": attempt})
+        flight = self._flight_path(label, attempt)
+        env = self._worker_env()
+        env[_recorder.FLIGHT_FILE_ENV] = flight
+
+        def _cleanup_flight() -> None:
+            try:
+                os.unlink(flight)
+            except OSError:
+                pass
+
+        _cleanup_flight()   # a stale spill from a prior same-label run
         try:
             proc = subprocess.run(
                 self._worker_cmd(spec), capture_output=True, text=True,
-                timeout=self.timeout_s, env=self._worker_env())
+                timeout=self.timeout_s, env=env)
         except subprocess.TimeoutExpired as e:
             # subprocess.run already killed the child on expiry
             stderr = e.stderr if isinstance(e.stderr, str) else \
@@ -251,8 +283,10 @@ class Supervisor:
                 message=f"worker exceeded the {self.timeout_s:.0f}s row "
                         f"timeout and was killed",
                 rc=None, signal=None,
-                stderr_tail=(stderr or "")[-self._tail:])
+                stderr_tail=(stderr or "")[-self._tail:],
+                flight_tail=_recorder.read_spill_tail(flight))
         except OSError as e:
+            _cleanup_flight()
             return None, FailureRecord(
                 kind="crash", config=label,
                 message=f"worker failed to spawn: {e}", rc=None)
@@ -260,6 +294,7 @@ class Supervisor:
         sig = -proc.returncode if proc.returncode < 0 else None
         if proc.returncode == 0 and frame is not None \
                 and "error" not in frame:
+            _cleanup_flight()
             return frame, None
         kind, message = classify_exit(proc.returncode, sig, frame,
                                       proc.stderr or "")
@@ -269,4 +304,5 @@ class Supervisor:
         return None, FailureRecord(
             kind=kind, config=label, message=message,
             rc=proc.returncode if proc.returncode >= 0 else None,
-            signal=sig, stderr_tail=(proc.stderr or "")[-self._tail:])
+            signal=sig, stderr_tail=(proc.stderr or "")[-self._tail:],
+            flight_tail=_recorder.read_spill_tail(flight))

@@ -30,12 +30,21 @@ a dead transport self-exits rc 3 (classified 'timeout') before the
 supervisor's harder row timeout has to fire.
 
 Fault injection (``KNTPU_FAULT``, comma-separable ``kind:label[:arg]``):
-  abort:<label>         SIGKILL self (crash containment path)
-  hang:<label>[:secs]   sleep (timeout / stall-watchdog path)
-  transient:<label>[:n] raise TransportError while attempt <= n (retry path)
-  oom:<label>           raise a synthetic LaunchBudgetError (preflight path)
+  abort:<label>           SIGKILL self (crash containment path)
+  abort-after:<label>[:n] SIGKILL self upon recording the n-th flight-
+                          recorder event (default 32) -- dies MID-WORK, so
+                          the spill-survives-SIGKILL property is testable
+  hang:<label>[:secs]     sleep (timeout / stall-watchdog path)
+  transient:<label>[:n]   raise TransportError while attempt <= n (retry)
+  oom:<label>             raise a synthetic LaunchBudgetError (preflight)
 Faults fire before any heavy import, so the crash case dies exactly as hard
 as a real libtpu SIGKILL would.
+
+Observability (DESIGN.md section 19): every worker arms the flight
+recorder (obs/recorder) before fault injection -- tagged
+``worker:<label>``, spilling to the supervisor-provided KNTPU_FLIGHT_FILE
+-- and spills full span traces when KNTPU_TRACE_DIR is set, so merged
+timelines show each worker as its own (pid, job) process row.
 """
 
 from __future__ import annotations
@@ -68,6 +77,10 @@ def _inject_fault(label: str, attempt: int) -> None:
             continue
         if kind == "abort":
             os.kill(os.getpid(), signal.SIGKILL)
+        elif kind == "abort-after":
+            from ..obs import recorder as _recorder
+
+            _recorder.FLIGHT.kill_after_events(int(arg or 32))
         elif kind == "hang":
             time.sleep(float(arg or 3600.0))
         elif kind == "transient":
@@ -105,8 +118,25 @@ def _failure_kind(exc: BaseException) -> str:
 
 def _run_job(job: dict) -> dict:
     label = job.get("label") or job.get("name") or job.get("job", "")
+    # observability first, faults second: the recorder and the stall
+    # watchdog are armed BEFORE fault injection, so an injected hang or
+    # mid-work SIGKILL leaves evidence exactly like a real one would
+    from ..obs import recorder as _recorder
+    from ..obs import spans as _spans
+    from ..utils import watchdog
+
+    _spans.set_process_tag(f"worker:{label}")
+    _spans.start_file_trace_from_env(f"worker-{label}")
+    _recorder.arm(tag=f"worker:{label}")
+    watchdog.start(tag=f"worker:{label}")
     _inject_fault(label, int(job.get("attempt", 1)))
     if job.get("job") == "selftest":
+        # optional span emission ({"spans": N}): the fast vehicle for the
+        # flight-recorder fault tests -- N trivial recorded spans, no
+        # device work (abort-after kills mid-loop)
+        for i in range(int(job.get("spans", 0) or 0)):
+            with _spans.span("selftest.tick", force=True, i=i):
+                pass
         return {"config": "selftest", "value": 1.0, "unit": "ok",
                 "label": label}
 
@@ -115,12 +145,10 @@ def _run_job(job: dict) -> dict:
     # the env this child inherited)
     if _REPO_ROOT not in sys.path:
         sys.path.insert(0, _REPO_ROOT)  # bench.py lives at the repo root
-    from ..utils import watchdog
     from ..utils.platform import enable_compile_cache, honor_jax_platforms_env
 
     honor_jax_platforms_env()
     enable_compile_cache()
-    watchdog.start(tag=f"worker:{label}")
     import jax
 
     platform = jax.devices()[0].platform

@@ -75,9 +75,18 @@ def _stdio_loop(daemon) -> int:
                               "error": f"unparseable request line: {e}"}),
                   flush=True)
             return
+        if req.get("op") == "metrics":
+            # the metrics wire command (DESIGN.md section 19): one
+            # snapshot reply -- registry + dispatch + exec-cache counters
+            # + the daemon's serving stats and latency decomposition
+            print(json.dumps({"id": req.get("id"), "ok": True,
+                              "metrics": daemon.metrics_snapshot()}),
+                  flush=True)
+            return
         emit(daemon.submit(req_id=req.get("id"),
                            kind=req.get("op", "query"),
-                           payload=req.get("data"), k=req.get("k")))
+                           payload=req.get("data"), k=req.get("k"),
+                           trace_id=req.get("trace_id")))
 
     fd = sys.stdin.fileno()
     buf = b""
@@ -123,6 +132,13 @@ def main(argv=None) -> int:
     ap.add_argument("--assert-steady", action="store_true",
                     help="loadgen: exit 1 unless >= 1 batch flushed with "
                          "zero steady-state recompiles (the CI smoke gate)")
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="periodically append unified metrics snapshots "
+                         "(obs.metrics) to this path, one JSON line each; "
+                         "a final snapshot lands on exit")
+    ap.add_argument("--metrics-period-s", type=float, default=1.0,
+                    help="snapshot period for --metrics-jsonl "
+                         "(default 1.0)")
     args = ap.parse_args(argv)
 
     from ..utils.platform import enable_compile_cache, honor_jax_platforms_env
@@ -159,25 +175,42 @@ def main(argv=None) -> int:
     except DeviceMemoryError as e:
         return _refuse(e, 4)
 
-    if not args.loadgen:
-        return _stdio_loop(daemon)
+    from ..obs import spans as _spans
+    from ..obs.metrics import JsonlEmitter
 
-    spec = LoadSpec(rate=args.rate, requests=args.requests,
-                    mutation_ratio=args.mutation_ratio, seed=args.seed)
-    summary = run_session(daemon, spec)
-    print(json.dumps(summary), flush=True)
-    if args.assert_steady:
-        ok = (summary["batches"] >= 1 and summary["recompiles"] == 0
-              and summary["exec_cache_enabled"]
-              and summary["failed_requests"] == 0)
-        if not ok:
-            print(f"STEADY-STATE ASSERTION FAILED: batches="
-                  f"{summary['batches']} recompiles={summary['recompiles']} "
-                  f"cache_enabled={summary['exec_cache_enabled']} "
-                  f"failed={summary['failed_requests']}",
-                  file=sys.stderr, flush=True)
-            return 1
-    return 0
+    trace_sink = _spans.start_file_trace_from_env("serve")
+    emitter = None
+    if args.metrics_jsonl:
+        emitter = JsonlEmitter(args.metrics_jsonl,
+                               period_s=args.metrics_period_s,
+                               snapshot_fn=daemon.metrics_snapshot)
+        emitter.start()
+    try:
+        if not args.loadgen:
+            return _stdio_loop(daemon)
+
+        spec = LoadSpec(rate=args.rate, requests=args.requests,
+                        mutation_ratio=args.mutation_ratio, seed=args.seed)
+        summary = run_session(daemon, spec)
+        print(json.dumps(summary), flush=True)
+        if args.assert_steady:
+            ok = (summary["batches"] >= 1 and summary["recompiles"] == 0
+                  and summary["exec_cache_enabled"]
+                  and summary["failed_requests"] == 0)
+            if not ok:
+                print(f"STEADY-STATE ASSERTION FAILED: batches="
+                      f"{summary['batches']} recompiles="
+                      f"{summary['recompiles']} "
+                      f"cache_enabled={summary['exec_cache_enabled']} "
+                      f"failed={summary['failed_requests']}",
+                      file=sys.stderr, flush=True)
+                return 1
+        return 0
+    finally:
+        if emitter is not None:
+            emitter.stop()
+        if trace_sink is not None:
+            trace_sink.close()
 
 
 if __name__ == "__main__":

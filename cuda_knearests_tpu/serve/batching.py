@@ -42,6 +42,13 @@ class Request:
     queries: np.ndarray          # (m, 3) f32, validated
     k: int                       # <= serving k; columns truncate on reply
     arrived_at: float            # open-loop arrival time (latency anchor)
+    # observability (DESIGN.md section 19): the wire-carried trace id
+    # (echoed on the reply, stamped on the request's spans) and the real-
+    # clock admission timestamp (obs.spans.now()) the queue-wait component
+    # of the latency decomposition is measured from -- arrived_at may be
+    # synthetic (injected clocks), t_perf never is
+    trace_id: Optional[str] = None
+    t_perf: float = 0.0
 
 
 @dataclasses.dataclass

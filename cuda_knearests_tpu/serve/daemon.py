@@ -40,6 +40,8 @@ import numpy as np
 from ..api import KnnProblem
 from ..config import DOMAIN_SIZE, ServeConfig
 from ..io import validate_request
+from ..obs import metrics as _metrics
+from ..obs import spans as _spans
 from ..runtime import dispatch as _dispatch
 from ..runtime.supervisor import FAILURE_KINDS
 from ..utils.memory import (InputContractError, InvalidConfigError,
@@ -68,6 +70,15 @@ class Response:
     # fleet wires (serve/fleet, DESIGN.md section 17) stamp the tenant the
     # response belongs to; single-tenant daemons leave it None
     tenant: Optional[str] = None
+    # observability (DESIGN.md section 19): the echoed wire trace_id and
+    # the span-sourced latency decomposition -- where this request's wall
+    # time went (admission -> flush = queue, host batch work = dispatch,
+    # device execution = device).  Query responses only; mutation/FoF
+    # acks leave them None.
+    trace_id: Optional[str] = None
+    queue_ms: Optional[float] = None
+    dispatch_ms: Optional[float] = None
+    device_ms: Optional[float] = None
 
     @property
     def latency_s(self) -> float:
@@ -89,6 +100,12 @@ class Response:
             out["n_clusters"] = self.n_clusters
         if self.tenant is not None:
             out["tenant"] = self.tenant
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
+        if self.queue_ms is not None:
+            out["timing"] = {"queue_ms": self.queue_ms,
+                             "dispatch_ms": self.dispatch_ms,
+                             "device_ms": self.device_ms}
         if not self.ok:
             out["error"] = self.error
             out["failure_kind"] = self.failure_kind
@@ -136,6 +153,12 @@ class ServeDaemon:
         self.refused = 0
         self.failure_kinds: Dict[str, int] = {}
         self.occupancies: List[float] = []
+        # bounded latency accounting (obs.metrics.Histogram): total plus
+        # the span-sourced queue/dispatch/device decomposition, O(1)
+        # memory at any request count (DESIGN.md section 19)
+        self.lat_hist = {name: _metrics.Histogram(f"serve.{name}")
+                         for name in ("total_ms", "queue_ms",
+                                      "dispatch_ms", "device_ms")}
         self._fault = _parse_serve_fault()
         self._compactions_seen = 0
         if self.config.warmup:
@@ -156,13 +179,19 @@ class ServeDaemon:
     # -- admission ------------------------------------------------------------
 
     def submit(self, req_id: int, kind: str, payload, k: Optional[int] = None,
-               now: Optional[float] = None) -> List[Response]:
+               now: Optional[float] = None,
+               trace_id: Optional[str] = None) -> List[Response]:
         """Admit one request.  Queries queue into the batcher (responses
         surface later via poll/drain); mutations are barriers -- the
         pending batch flushes first, then the mutation applies and answers
         immediately.  A contract violation refuses THIS request (typed,
-        kind 'invalid-input') and nothing else."""
+        kind 'invalid-input') and nothing else.  ``trace_id`` is the wire-
+        carried correlation id: echoed on the reply, stamped on the
+        request's spans (DESIGN.md section 19)."""
         now = self.clock() if now is None else now
+        t_admit = _spans.now()
+        _spans.event("serve.admit", trace_id=trace_id, kind=kind,
+                     req=req_id)
         try:
             payload = validate_request(
                 kind, payload, k=k, k_max=self.k_serve,
@@ -173,10 +202,12 @@ class ServeDaemon:
             self.refused += 1
             return [Response(req_id=req_id, ok=False, error=str(e),
                              failure_kind=e.kind, arrived_at=now,
-                             completed_at=self.clock())]
+                             completed_at=self.clock(),
+                             trace_id=trace_id)]
         if kind == "query":
             req = Request(req_id=req_id, queries=payload,
-                          k=int(k) if k else self.k_serve, arrived_at=now)
+                          k=int(k) if k else self.k_serve, arrived_at=now,
+                          trace_id=trace_id, t_perf=t_admit)
             out = []
             for batch in self.batcher.admit(req, now):
                 out.extend(self._execute(batch))
@@ -202,12 +233,13 @@ class ServeDaemon:
                     req_id=req_id, ok=False,
                     error=f"fof failed: {type(e).__name__}: {e}",
                     failure_kind=fkind, arrived_at=now,
-                    completed_at=self.clock()))
+                    completed_at=self.clock(), trace_id=trace_id))
                 return out
             out.append(Response(
                 req_id=req_id, ok=True, n_points=self.overlay.n_points,
                 labels=res.labels, n_clusters=res.n_clusters,
-                arrived_at=now, completed_at=self.clock()))
+                arrived_at=now, completed_at=self.clock(),
+                trace_id=trace_id))
             return out
         # mutation barrier: queries already pending answer against the
         # pre-mutation cloud (their batch formed first)
@@ -240,11 +272,12 @@ class ServeDaemon:
                 req_id=req_id, ok=False,
                 error=f"mutation failed: {type(e).__name__}: {e}",
                 failure_kind=fkind, arrived_at=now,
-                completed_at=self.clock()))
+                completed_at=self.clock(), trace_id=trace_id))
             return out
         out.append(Response(req_id=req_id, ok=True,
                             n_points=self.overlay.n_points,
-                            arrived_at=now, completed_at=self.clock()))
+                            arrived_at=now, completed_at=self.clock(),
+                            trace_id=trace_id))
         return out
 
     def poll(self, now: Optional[float] = None) -> List[Response]:
@@ -313,7 +346,10 @@ class ServeDaemon:
         self._fof_cache = None
 
     def _run_batch(self, batch: Batch, idx: int):
-        """One padded bucket-capacity launch at the serving k."""
+        """One padded bucket-capacity launch at the serving k.  Returns
+        (ids, d2, device_ms): the device span wraps ONLY the overlay
+        launch, so the decomposition's device component excludes the
+        host-side padding/slicing work (which lands in dispatch_ms)."""
         if self._fault is not None and idx == self._fault[0]:
             if self._fault[1] == "oom":
                 raise LaunchBudgetError(
@@ -324,41 +360,98 @@ class ServeDaemon:
         dom = float(self.overlay.base.grid.domain or DOMAIN_SIZE)
         padded = np.full((cap, 3), dom / 2.0, np.float32)
         padded[: batch.total] = batch.queries
-        ids, d2 = self.overlay.query(padded, self.k_serve)
-        return ids[: batch.total], d2[: batch.total]
+        with _spans.span("serve.device", force=True, batch=idx) as dev:
+            ids, d2 = self.overlay.query(padded, self.k_serve)
+        return ids[: batch.total], d2[: batch.total], round(dev.dur_ms, 4)
+
+    def _queue_ms(self, req: Request, t_exec0: float) -> Optional[float]:
+        """Span-sourced queue-wait of one rider: admission (t_perf) to
+        batch execution start, on the tracer's real clock."""
+        if not req.t_perf:
+            return None
+        return round(max((t_exec0 - req.t_perf) * 1e3, 0.0), 4)
 
     def _execute(self, batch: Batch) -> List[Response]:
         """Run one batch with containment: a raise costs every rider of
         THIS batch a typed failure response (kind from the supervisor
         taxonomy) and nothing more -- the daemon's loop state stays
-        consistent and the next batch runs fresh."""
+        consistent and the next batch runs fresh.
+
+        Observability (DESIGN.md section 19): the execute window and the
+        device launch are ALWAYS timed (forced spans -- the decomposition
+        is a product, not a debug mode); each rider's reply carries
+        queue_ms (admission -> execute start), dispatch_ms (host batch
+        work around the device call), and device_ms, and when tracing is
+        enabled a retrospective ``serve.queue`` span per rider puts the
+        wait on the timeline under its trace_id."""
         idx = self.batches_executed
         self.batches_executed += 1
-        try:
-            ids, d2 = self._run_batch(batch, idx)
-        except Exception as e:  # noqa: BLE001 -- containment IS the contract: any batch death becomes typed per-request failures, the daemon survives
-            kind = self._classify(e)
+        failed: Optional[BaseException] = None
+        ids = d2 = None
+        device_ms = 0.0
+        with _spans.span("serve.execute", force=True, batch=idx,
+                         capacity=batch.capacity, rows=batch.total,
+                         reason=batch.reason) as ex:
+            try:
+                ids, d2, device_ms = self._run_batch(batch, idx)
+            except Exception as e:  # noqa: BLE001 -- containment IS the contract: any batch death becomes typed per-request failures, the daemon survives
+                failed = e
+        dispatch_ms = round(max(ex.dur_ms - device_ms, 0.0), 4)
+        if _spans.enabled():
+            for r in batch.requests:
+                if r.t_perf:
+                    _spans.emit("serve.queue", r.t_perf, ex.t0,
+                                trace_id=r.trace_id, req=r.req_id,
+                                batch=idx)
+        if failed is not None:
+            kind = self._classify(failed)
             self.failed_batches += 1
             self.failure_kinds[kind] = self.failure_kinds.get(kind, 0) + 1
             done = self.clock()
             return [Response(req_id=r.req_id, ok=False,
                              error=f"batch {idx} failed: "
-                                   f"{type(e).__name__}: {e}",
+                                   f"{type(failed).__name__}: {failed}",
                              failure_kind=kind, arrived_at=r.arrived_at,
-                             completed_at=done)
+                             completed_at=done, trace_id=r.trace_id,
+                             queue_ms=self._queue_ms(r, ex.t0),
+                             dispatch_ms=dispatch_ms,
+                             device_ms=device_ms)
                     for r in batch.requests]
         self.occupancies.append(batch.occupancy)
         done = self.clock()
         out = []
         for req, a, b in batch.slices():
-            out.append(Response(
+            queue_ms = self._queue_ms(req, ex.t0)
+            resp = Response(
                 req_id=req.req_id, ok=True,
                 ids=np.ascontiguousarray(ids[a:b, : req.k]),
                 d2=np.ascontiguousarray(d2[a:b, : req.k]),
-                arrived_at=req.arrived_at, completed_at=done))
+                arrived_at=req.arrived_at, completed_at=done,
+                trace_id=req.trace_id, queue_ms=queue_ms,
+                dispatch_ms=dispatch_ms, device_ms=device_ms)
+            self.lat_hist["total_ms"].observe(resp.latency_s * 1e3)
+            if queue_ms is not None:
+                self.lat_hist["queue_ms"].observe(queue_ms)
+                self.lat_hist["dispatch_ms"].observe(dispatch_ms)
+                self.lat_hist["device_ms"].observe(device_ms)
+            out.append(resp)
         return out
 
     # -- introspection --------------------------------------------------------
+
+    def latency_decomposition(self) -> dict:
+        """Per-request latency decomposition at p50/p99 (span-sourced,
+        histogram-bounded): where the daemon's wall time goes, the
+        queue-depth/latency trade-off of arXiv 1512.02831 made a stamp."""
+        return {name: _metrics.percentile_fields(hist)
+                for name, hist in self.lat_hist.items()}
+
+    def metrics_snapshot(self) -> dict:
+        """The ``metrics`` wire command's document: the unified obs
+        snapshot (registry + dispatch + executable cache) plus this
+        daemon's own serving counters and latency decomposition."""
+        return {**_metrics.metrics_snapshot(),
+                "serve": self.stats_dict()}
 
     def stats_dict(self) -> dict:
         occ = self.occupancies
@@ -376,6 +469,7 @@ class ServeDaemon:
             "failure_kinds": dict(self.failure_kinds),
             "flushes": dict(self.batcher.flushes),
             "occupancy_mean": (float(np.mean(occ)) if occ else None),
+            "latency_decomposition": self.latency_decomposition(),
             "k_serve": self.k_serve,
             "n_points": self.overlay.n_points,
             **{f"overlay_{k}": v

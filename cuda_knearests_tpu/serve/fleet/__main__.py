@@ -72,6 +72,10 @@ def main(argv=None) -> int:
                          "instead of the loadgen session")
     ap.add_argument("--failover-ops", type=int, default=24)
     ap.add_argument("--failover-points", type=int, default=1500)
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="periodically append unified metrics snapshots "
+                         "(obs.metrics) to this path, one JSON line each")
+    ap.add_argument("--metrics-period-s", type=float, default=1.0)
     args = ap.parse_args(argv)
 
     from ...utils.platform import enable_compile_cache, honor_jax_platforms_env
@@ -102,7 +106,23 @@ def main(argv=None) -> int:
                                             else 0.0),
                             seed=args.seed + 31 * i)
                  for i, (spec, _) in enumerate(builds)]
-        summary = run_fleet_session(fleet, loads)
+        from ...obs import spans as _spans
+        from ...obs.metrics import JsonlEmitter
+
+        trace_sink = _spans.start_file_trace_from_env("fleet")
+        emitter = None
+        if args.metrics_jsonl:
+            emitter = JsonlEmitter(args.metrics_jsonl,
+                                   period_s=args.metrics_period_s,
+                                   snapshot_fn=fleet.metrics_snapshot)
+            emitter.start()
+        try:
+            summary = run_fleet_session(fleet, loads)
+        finally:
+            if emitter is not None:
+                emitter.stop()
+            if trace_sink is not None:
+                trace_sink.close()
     except InputContractError as e:
         print(json.dumps({"error": str(e),
                           "failure_kind": getattr(e, "kind", "crash")}),

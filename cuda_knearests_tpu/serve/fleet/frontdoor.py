@@ -39,6 +39,8 @@ import numpy as np
 
 from ...config import DOMAIN_SIZE, ServeFleetConfig
 from ...io import validate_request
+from ...obs import metrics as _metrics
+from ...obs import spans as _spans
 from ...utils.memory import InputContractError, InvalidConfigError
 from ..batching import Batch, Request
 from ..daemon import Response
@@ -114,15 +116,17 @@ class FleetDaemon:
     # -- admission + routing --------------------------------------------------
 
     def _refusal(self, req_id, tenant, e: InputContractError,
-                 now: float) -> List[Response]:
+                 now: float,
+                 trace_id: Optional[str] = None) -> List[Response]:
         self.refused[tenant] = self.refused.get(tenant, 0) + 1
         return [Response(req_id=req_id, ok=False, error=str(e),
                          failure_kind=e.kind, arrived_at=now,
-                         completed_at=self.clock(), tenant=tenant)]
+                         completed_at=self.clock(), tenant=tenant,
+                         trace_id=trace_id)]
 
     def submit(self, req_id: int, tenant: str, kind: str, payload,
-               k: Optional[int] = None,
-               now: Optional[float] = None) -> List[Response]:
+               k: Optional[int] = None, now: Optional[float] = None,
+               trace_id: Optional[str] = None) -> List[Response]:
         """Admit one tenant-addressed request.  Query responses may
         surface later (poll/pump) or now (size-trigger flush); sidecar
         tenants, mutations, and FoF answer synchronously.  Responses from
@@ -144,13 +148,15 @@ class FleetDaemon:
                 tenant=tenant, tenants=tuple(self.tenants),
                 quota_ok=quota_ok)
         except InputContractError as e:
-            return self._refusal(req_id, tenant, e, now)
+            return self._refusal(req_id, tenant, e, now, trace_id)
         if kind == "query" and self._fault == "cross-tenant" \
                 and len(self.tenants) > 1:
             return self._cross_tenant_fault(req_id, tenant, payload, k, now)
         if t.is_sidecar:
-            return self._submit_sidecar(req_id, t, kind, payload, k, now)
-        return self._submit_dense(req_id, t, kind, payload, k, now)
+            return self._submit_sidecar(req_id, t, kind, payload, k, now,
+                                        trace_id)
+        return self._submit_dense(req_id, t, kind, payload, k, now,
+                                  trace_id)
 
     def _domain(self, t: Optional[Tenant]) -> float:
         if t is None or t.is_sidecar or t.daemon is None:
@@ -191,15 +197,23 @@ class FleetDaemon:
                          tenant=tenant)]
 
     def _submit_sidecar(self, req_id, t: Tenant, kind, payload, k,
-                        now) -> List[Response]:
+                        now, trace_id=None) -> List[Response]:
         name = t.spec.name
         if kind == "query":
             kq = int(k) if k else t.spec.k
-            ids, d2 = t.sidecar.query(payload, kq)
+            # sidecar answers synchronously: no batcher queue and no
+            # batch formation, so queue and dispatch are zero BY
+            # CONSTRUCTION and the whole wall cost is the CPU worker
+            # call (the 'device' of this placement)
+            with _spans.span("serve.sidecar", force=True, tenant=name,
+                             trace_id=trace_id) as dev_sp:
+                ids, d2 = t.sidecar.query(payload, kq)
             self.served_rows[name] += payload.shape[0]
             return [Response(req_id=req_id, ok=True, ids=ids, d2=d2,
                              arrived_at=now, completed_at=self.clock(),
-                             tenant=name)]
+                             tenant=name, trace_id=trace_id,
+                             queue_ms=0.0, dispatch_ms=0.0,
+                             device_ms=round(dev_sp.dur_ms, 4))]
         if kind == "fof":
             res = t.sidecar.fof(float(payload))
             return [Response(req_id=req_id, ok=True,
@@ -216,11 +230,12 @@ class FleetDaemon:
                          tenant=name)]
 
     def _submit_dense(self, req_id, t: Tenant, kind, payload, k,
-                      now) -> List[Response]:
+                      now, trace_id=None) -> List[Response]:
         name = t.spec.name
         if kind == "query":
             req = Request(req_id=req_id, queries=payload,
-                          k=int(k) if k else t.spec.k, arrived_at=now)
+                          k=int(k) if k else t.spec.k, arrived_at=now,
+                          trace_id=trace_id, t_perf=_spans.now())
             for batch in t.daemon.batcher.admit(req, now):
                 t.ready.append(batch)
             return self.pump(now)
@@ -236,7 +251,8 @@ class FleetDaemon:
         if pending is not None:
             t.ready.append(pending)
             out.extend(self._execute_ready(t))
-        responses = t.daemon.submit(req_id, kind, payload, k=k, now=now)
+        responses = t.daemon.submit(req_id, kind, payload, k=k, now=now,
+                                    trace_id=trace_id)
         for r in responses:
             r.tenant = name
         out.extend(responses)
@@ -324,6 +340,20 @@ class FleetDaemon:
             skip_reship=self._fault == "stale-replica")
 
     # -- introspection --------------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """The fleet's ``metrics`` document: the unified obs snapshot
+        plus the fleet's own scheduling/fairness/tenant counters and the
+        per-tenant latency decomposition (span-sourced, DESIGN.md
+        section 19)."""
+        return {
+            **_metrics.metrics_snapshot(),
+            "fleet": self.stats_dict(),
+            "latency_decomposition": {
+                name: t.daemon.latency_decomposition()
+                for name, t in self.tenants.items()
+                if not t.is_sidecar and t.daemon is not None},
+        }
 
     def stats_dict(self) -> dict:
         from ...runtime import dispatch as _dispatch

@@ -21,7 +21,7 @@ import numpy as np
 from ...config import DOMAIN_SIZE, SLO_CLASSES
 from ...runtime import dispatch as _dispatch
 from ..daemon import Response
-from ..loadgen import _percentiles
+from ..loadgen import SessionAggregate, _percentiles
 from .admission import jain_index
 from .frontdoor import FleetDaemon
 from .tenants import TenantSpec
@@ -99,7 +99,21 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
         domain=DOMAIN_SIZE)
     cache0 = dict(_dispatch.EXEC_CACHE.stats_dict())
     _dispatch.reset_stats()
-    responses: List[Response] = []
+    # streaming per-tenant aggregation (ISSUE 13 satellite): every
+    # response is absorbed -- counted + binned into BOUNDED histograms
+    # (query responses only: the fleet's SLO-gate semantics) -- the
+    # moment it surfaces; nothing is retained, so a sustained-QPS fleet
+    # session's memory is O(1) in the request count
+    aggs: Dict[str, SessionAggregate] = {
+        load.tenant: SessionAggregate(query_only=True) for load in loads}
+    fleet_agg = SessionAggregate(query_only=True)
+
+    def absorb(rs: List[Response]) -> None:
+        fleet_agg.absorb(rs)
+        for r in rs:
+            if r.tenant in aggs:
+                aggs[r.tenant].absorb([r])
+
     t0 = clock()
     i = 0
     pending = (lambda: any(t.ready or (not t.is_sidecar
@@ -110,12 +124,13 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
         if i < len(schedule) and t0 + schedule[i]["t"] <= now:
             item = schedule[i]
             i += 1
-            responses.extend(fleet.submit(
+            absorb(fleet.submit(
                 req_id=i, tenant=item["tenant"], kind=item["kind"],
                 payload=item["payload"], k=item.get("k"),
-                now=t0 + item["t"]))
+                now=t0 + item["t"],
+                trace_id=f"{item['tenant']}-{i}"))
             continue
-        responses.extend(fleet.poll(now))
+        absorb(fleet.poll(now))
         next_events = []
         if i < len(schedule):
             next_events.append(t0 + schedule[i]["t"])
@@ -127,7 +142,7 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
         wait = min(next_events) - clock()
         if wait > 0:
             sleep(min(wait, 0.005))
-    responses.extend(fleet.drain(clock()))
+    absorb(fleet.drain(clock()))
     elapsed = max(clock() - t0, 1e-9)
     cache1 = _dispatch.EXEC_CACHE.stats_dict()
 
@@ -139,14 +154,12 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
     completion = []
     for load in loads:
         name = load.tenant
-        mine = [r for r in responses if r.tenant == name]
-        ok_q = [r for r in mine if r.ok and r.ids is not None]
-        served = int(sum(r.ids.shape[0] for r in ok_q))
+        agg = aggs[name]
+        served = agg.completed_queries
         # percentiles over QUERY responses only: mutation acks are
         # near-instant and would dilute the p99 the slo_ok gate checks
-        lat = [r.latency_s for r in ok_q]
         slo = SLO_CLASSES[fleet.tenants[name].spec.slo]
-        pct = _percentiles(lat)
+        pct = _percentiles(agg.hist["total_ms"])
         ratio = served / offered[name] if offered[name] else None
         completion.append(ratio)
         per_tenant[name] = {
@@ -155,24 +168,22 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
             "served_rows": served,
             "completion": (round(ratio, 6) if ratio is not None else None),
             "refused": fleet.refused[name],
-            "failed": len([r for r in mine if not r.ok
-                           and r.failure_kind != "invalid-input"]),
+            "failed": agg.failed,
             "sustained_qps": round(served / elapsed, 1),
             "sidecar": fleet.tenants[name].is_sidecar,
             **pct,
+            "decomposition": agg.decomposition(),
             "slo_p99_budget_ms": slo.p99_budget_ms,
             "slo_ok": (pct["p99_ms"] is not None
                        and pct["p99_ms"] <= slo.p99_budget_ms),
         }
-    ok_all = [r for r in responses if r.ok and r.ids is not None]
-    total_served = int(sum(r.ids.shape[0] for r in ok_all))
+    total_served = fleet_agg.completed_queries
     occ = [b["rows"] / b["capacity"] for b in fleet.batch_log]
     summary = {
         "requests": len(schedule),
-        "responses": len(responses),
+        "responses": fleet_agg.responses,
         "completed_queries": total_served,
-        "failed_requests": len([r for r in responses if not r.ok
-                                and r.failure_kind != "invalid-input"]),
+        "failed_requests": fleet_agg.failed,
         "refused_requests": int(sum(fleet.refused.values())),
         "elapsed_s": round(elapsed, 4),
         "sustained_qps": round(total_served / elapsed, 1),
@@ -180,6 +191,10 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
                           - cache0["exec_cache_misses"]),
         "exec_cache_enabled": _dispatch.EXEC_CACHE.enabled,
         "occupancy_mean": (round(float(np.mean(occ)), 4) if occ else None),
+        # fleet-wide per-request latency decomposition (span-sourced:
+        # queue wait -> host dispatch -> device), p50/p99 -- the stamp
+        # the fleet bench rows carry (DESIGN.md section 19)
+        "latency_decomposition": fleet_agg.decomposition(),
         "jain_fairness": jain_index(completion),
         "n_tenants": len(fleet.tenants),
         "slo_ok_all": all(per_tenant[n]["slo_ok"] or not offered[n]

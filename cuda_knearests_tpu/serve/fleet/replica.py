@@ -51,6 +51,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ...obs import metrics as _metrics
+from ...obs import spans as _spans
 from ...runtime.supervisor import _REPO_ROOT, RESULT_PREFIX
 from ...utils.memory import TransportError
 
@@ -180,6 +182,7 @@ class ReplicaProcess:
         self._buf = ""      # our own stdout line buffer (see _recv)
         self.acked_seq = 0
         self.promoted = False
+        self.last_timing: dict = {}
         ready = self._recv()          # startup handshake
         self.n_points = int(ready.get("n_points", 0))
 
@@ -241,14 +244,32 @@ class ReplicaProcess:
         self.acked_seq = int(frame["seq"])
         return self.acked_seq
 
-    def query(self, queries: np.ndarray, k: int):
+    def query(self, queries: np.ndarray, k: int,
+              trace_id=None):
+        t0 = _spans.now()
         frame = self._call({"op": "query",
                             "queries": np.asarray(queries,
                                                   np.float32).tolist(),
-                            "k": int(k)})
+                            "k": int(k), "trace_id": trace_id})
+        e2e_ms = (_spans.now() - t0) * 1e3
+        # wire-level latency decomposition: the child frames how long the
+        # whole op and the device launch took; queue here is transport +
+        # child stdin wait (everything outside the child's op window)
+        op_ms = float(frame.get("op_ms") or 0.0)
+        dev_ms = float(frame.get("device_ms") or 0.0)
+        self.last_timing = {
+            "total_ms": round(e2e_ms, 4),
+            "queue_ms": round(max(e2e_ms - op_ms, 0.0), 4),
+            "dispatch_ms": round(max(op_ms - dev_ms, 0.0), 4),
+            "device_ms": round(dev_ms, 4)}
         ids = np.asarray(frame["ids"], np.int32).reshape(
             len(frame["ids"]), -1)
         return ids, _decode_d2(frame["d2"])
+
+    def metrics(self) -> dict:
+        """The child's unified obs metrics snapshot (the fleet wire's
+        `metrics` command over the framed transport)."""
+        return self._call({"op": "metrics"})["metrics"]
 
     def seq(self) -> int:
         return int(self._call({"op": "seq"})["seq"])
@@ -402,6 +423,19 @@ def failover_drill(n: int = 1500, k: int = 8, ops: int = 24,
     killed_at = None
     killed_pid = None
     commits_acked = 0
+    # per-request latency decomposition across the wire (DESIGN.md
+    # section 19): queue (transport) / dispatch (child host work) /
+    # device, binned into bounded histograms, stamped on the bench row
+    lat_hist = {name: _metrics.Histogram(f"failover.{name}")
+                for name in ("total_ms", "queue_ms", "dispatch_ms",
+                             "device_ms")}
+
+    def _absorb_timing() -> None:
+        for key, hist in lat_hist.items():
+            v = ctl.primary.last_timing.get(key)
+            if v is not None:
+                hist.observe(v)
+
     try:
         for i in range(ops):
             if i == ops // 2:
@@ -425,6 +459,7 @@ def failover_drill(n: int = 1500, k: int = 8, ops: int = 24,
                     qs = (rng.random((8, 3)) * 980.0 + 10.0
                           ).astype(np.float32)
                     ctl.query(qs)
+                    _absorb_timing()
             except TransportError:
                 # the dead primary surfaces here; the op was never
                 # committed (no ack), so failing over and moving on loses
@@ -436,6 +471,7 @@ def failover_drill(n: int = 1500, k: int = 8, ops: int = 24,
         probe = (np.random.default_rng(seed + 9).random((32, 3))
                  * 980.0 + 10.0).astype(np.float32)
         got_i, got_d = ctl.query(probe)
+        _absorb_timing()
         oracle = KnnProblem.prepare(expected,
                                     KnnConfig(k=k, adaptive=False))
         ref_i, ref_d = oracle.query(probe, k)
@@ -454,6 +490,9 @@ def failover_drill(n: int = 1500, k: int = 8, ops: int = 24,
             "post_failover_byte_identical": bool(byte_identical),
             "failover_ok": bool(zero_lost and byte_identical
                                 and ctl.failovers >= 1),
+            "latency_decomposition": {
+                name: _metrics.percentile_fields(hist)
+                for name, hist in lat_hist.items()},
         }
     finally:
         ctl.close()
@@ -480,6 +519,10 @@ def _child_main(argv) -> int:
         compact_threshold = int(z["compact_threshold"])
     problem = KnnProblem.prepare(points, KnnConfig(k=k, adaptive=False))
     replica = Replica(problem, compact_threshold=compact_threshold)
+    # cross-process trace stitching: tag this process so merged timelines
+    # show 'replica:<pid>', and spill spans when KNTPU_TRACE_DIR is set
+    _spans.set_process_tag(f"replica:{os.getpid()}")
+    _spans.start_file_trace_from_env(f"replica-{os.getpid()}")
     _child_emit({"ok": True, "ready": True, "n_points": points.shape[0]})
     for line in sys.stdin:
         line = line.strip()
@@ -496,15 +539,25 @@ def _child_main(argv) -> int:
                 _child_emit({"ok": True, "seq": seq,
                              "n_points": replica.overlay.n_points})
             elif op == "query":
-                ids, d2 = replica.query(
-                    np.asarray(req["queries"], np.float32),  # kntpu-ok: host-sync-loop -- JSON-decoded wire payload (host list), no device array rides this loop
-                    int(req.get("k") or k))
-                wire_ids, wire_d2 = _encode_rows(ids, d2)
+                with _spans.span("replica.query", force=True,
+                                 trace_id=req.get("trace_id")) as op_sp:
+                    with _spans.span("replica.device",
+                                     force=True) as dev_sp:
+                        ids, d2 = replica.query(
+                            np.asarray(req["queries"], np.float32),  # kntpu-ok: host-sync-loop -- JSON-decoded wire payload (host list), no device array rides this loop
+                            int(req.get("k") or k))
+                    wire_ids, wire_d2 = _encode_rows(ids, d2)
                 _child_emit({"ok": True, "ids": wire_ids, "d2": wire_d2,
-                             "seq": replica.applied_seq})
+                             "seq": replica.applied_seq,
+                             "trace_id": req.get("trace_id"),
+                             "op_ms": round(op_sp.dur_ms, 4),
+                             "device_ms": round(dev_sp.dur_ms, 4)})
             elif op == "seq":
                 _child_emit({"ok": True, "seq": replica.applied_seq,
                              "n_points": replica.overlay.n_points})
+            elif op == "metrics":
+                _child_emit({"ok": True,
+                             "metrics": _metrics.metrics_snapshot()})
             elif op == "promote":
                 _child_emit({"ok": True, "seq": replica.applied_seq})
             else:

@@ -26,6 +26,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..config import DOMAIN_SIZE, ServeConfig
+from ..obs import metrics as _metrics
 from ..runtime import dispatch as _dispatch
 from .daemon import Response, ServeDaemon
 
@@ -85,13 +86,58 @@ def build_schedule(spec: LoadSpec, n_current: int,
     return out
 
 
-def _percentiles(latencies_s: List[float]) -> dict:
-    if not latencies_s:
-        return {"p50_ms": None, "p99_ms": None, "p999_ms": None}
-    arr = np.asarray(latencies_s) * 1000.0
-    p50, p99, p999 = np.percentile(arr, [50, 99, 99.9])
-    return {"p50_ms": round(float(p50), 3), "p99_ms": round(float(p99), 3),
-            "p999_ms": round(float(p999), 3)}
+def _percentiles(hist: "_metrics.Histogram") -> dict:
+    """p50/p99/p999 (ms) from a BOUNDED histogram -- O(1) memory at any
+    sustained QPS, replacing the unbounded per-latency Python lists the
+    open-loop runner used to grow (ISSUE 13 satellite)."""
+    out = {}
+    for label, q in (("p50_ms", 0.5), ("p99_ms", 0.99),
+                     ("p999_ms", 0.999)):
+        p = hist.percentile(q)
+        out[label] = round(p, 3) if p is not None else None
+    return out
+
+
+class SessionAggregate:
+    """Streaming response accounting for one open-loop session: counts
+    plus bounded latency histograms (total + the span-sourced
+    queue/dispatch/device decomposition).  Responses are absorbed as they
+    surface and never retained, so a sustained-QPS run's memory is O(1)
+    in the request count."""
+
+    def __init__(self, query_only: bool = False) -> None:
+        # query_only: bin latency for QUERY responses only (the fleet's
+        # SLO gate semantics -- mutation acks are near-instant and would
+        # dilute the p99 the per-class budget checks)
+        self.query_only = query_only
+        self.responses = 0
+        self.ok_query_requests = 0
+        self.completed_queries = 0
+        self.failed = 0
+        self.hist = {name: _metrics.Histogram(f"loadgen.{name}")
+                     for name in ("total_ms", "queue_ms", "dispatch_ms",
+                                  "device_ms")}
+
+    def absorb(self, rs: List[Response]) -> None:
+        for r in rs:
+            self.responses += 1
+            if r.ok:
+                if r.ids is not None:
+                    self.ok_query_requests += 1
+                    self.completed_queries += int(r.ids.shape[0])
+                    self.hist["total_ms"].observe(r.latency_s * 1e3)
+                    if r.queue_ms is not None:
+                        self.hist["queue_ms"].observe(r.queue_ms)
+                        self.hist["dispatch_ms"].observe(r.dispatch_ms)
+                        self.hist["device_ms"].observe(r.device_ms)
+                elif not self.query_only:
+                    self.hist["total_ms"].observe(r.latency_s * 1e3)
+            elif r.failure_kind != "invalid-input":
+                self.failed += 1
+
+    def decomposition(self) -> dict:
+        return {name: _metrics.percentile_fields(h)
+                for name, h in self.hist.items()}
 
 
 def run_session(daemon: ServeDaemon, spec: LoadSpec,
@@ -108,7 +154,10 @@ def run_session(daemon: ServeDaemon, spec: LoadSpec,
                                            or DOMAIN_SIZE))
     cache0 = dict(_dispatch.EXEC_CACHE.stats_dict())
     _dispatch.reset_stats()
-    responses: List[Response] = []
+    # streaming aggregation: responses are absorbed (counted + binned into
+    # bounded histograms) the moment they surface, never accumulated --
+    # the open-loop runner's memory no longer grows with the request count
+    agg = SessionAggregate()
     t0 = clock()
     i = 0
     while i < len(schedule) or daemon.batcher.pending_queries:
@@ -116,11 +165,12 @@ def run_session(daemon: ServeDaemon, spec: LoadSpec,
         if i < len(schedule) and t0 + schedule[i]["t"] <= now:
             item = schedule[i]
             i += 1
-            responses.extend(daemon.submit(
+            agg.absorb(daemon.submit(
                 req_id=i, kind=item["kind"], payload=item["payload"],
-                k=item.get("k"), now=t0 + item["t"]))
+                k=item.get("k"), now=t0 + item["t"],
+                trace_id=f"s{spec.seed}-{i}"))
             continue
-        responses.extend(daemon.poll(now))
+        agg.absorb(daemon.poll(now))
         next_events = []
         if i < len(schedule):
             next_events.append(t0 + schedule[i]["t"])
@@ -132,33 +182,32 @@ def run_session(daemon: ServeDaemon, spec: LoadSpec,
         wait = min(next_events) - clock()
         if wait > 0:
             sleep(min(wait, 0.005))
-    responses.extend(daemon.drain(clock()))
+    agg.absorb(daemon.drain(clock()))
     elapsed = max(clock() - t0, 1e-9)
 
     cache1 = _dispatch.EXEC_CACHE.stats_dict()
-    ok = [r for r in responses if r.ok and r.ids is not None]
-    failed = [r for r in responses if not r.ok and r.failure_kind
-              != "invalid-input"]
-    lat = [r.latency_s for r in responses if r.ok]
-    completed_queries = int(sum(r.ids.shape[0] for r in ok))
     summary = {
         "requests": len(schedule),
-        "responses": len(responses),
-        "completed_query_requests": len(ok),
-        "completed_queries": completed_queries,
-        "failed_requests": len(failed),
+        "responses": agg.responses,
+        "completed_query_requests": agg.ok_query_requests,
+        "completed_queries": agg.completed_queries,
+        "failed_requests": agg.failed,
         "elapsed_s": round(elapsed, 4),
-        "sustained_qps": round(completed_queries / elapsed, 1),
+        "sustained_qps": round(agg.completed_queries / elapsed, 1),
         "offered_rate": spec.rate,
         "mutation_ratio": spec.mutation_ratio,
         "seed": spec.seed,
-        **_percentiles(lat),
+        **_percentiles(agg.hist["total_ms"]),
+        "latency_decomposition": agg.decomposition(),
         "recompiles": int(cache1["exec_cache_misses"]
                           - cache0["exec_cache_misses"]),
         "exec_cache_enabled": _dispatch.EXEC_CACHE.enabled,
         **{k: v for k, v in cache1.items() if k != "exec_cache_disabled_by"},
         **_dispatch.stats_dict(),   # host_syncs / d2h_bytes / h2d_bytes
-        **daemon.stats_dict(),
+        # the session-window decomposition above wins over the daemon's
+        # lifetime one (identical on a fresh daemon; the window is exact)
+        **{k: v for k, v in daemon.stats_dict().items()
+           if k != "latency_decomposition"},
     }
     if not _dispatch.EXEC_CACHE.enabled:
         summary["exec_cache_disabled_by"] = cache1.get(

@@ -53,6 +53,18 @@ def _dump_tracebacks(tag: str) -> str | None:
             f.write(f"stall watchdog trip ({tag}): all-thread tracebacks\n")
             f.flush()
             faulthandler.dump_traceback(file=f, all_threads=True)
+            # flight-recorder tail (obs/recorder): the process's recent
+            # spans + metric deltas land NEXT TO the tracebacks, so a
+            # hang postmortem sees what the process was doing, not just
+            # where it was pinned (DESIGN.md section 19)
+            try:
+                from ..obs.recorder import FLIGHT
+
+                FLIGHT.metric_delta()
+                f.write("\n=== flight recorder tail ===\n")
+                f.write(json.dumps(FLIGHT.dump()) + "\n")
+            except Exception:  # noqa: BLE001 -- the exit path must never raise; tracebacks alone still land
+                pass
     except Exception:  # noqa: BLE001 -- the exit path must never raise
         path = None
     try:
@@ -114,6 +126,14 @@ def _watch() -> None:
             dt = time.monotonic() - _state["t"]
             tag = _state["tag"]
         if dt > stall_s:
+            # count the trip in the metrics registry (obs/metrics) before
+            # dumping -- snapshot consumers see watchdog.stalls move
+            try:
+                from ..obs.metrics import watchdog_stall_tripped
+
+                watchdog_stall_tripped(tag)
+            except Exception:  # noqa: BLE001 -- the exit path must never raise
+                pass
             # evidence first: all-thread tracebacks into the failure
             # artifact (and stderr), so a hang leaves more than a timeout
             tb_path = _dump_tracebacks(tag)

@@ -1,0 +1,301 @@
+#!/usr/bin/env python3
+"""bench_diff: the bench-corpus regression gate (ISSUE 13).
+
+Compares a current bench run against a committed baseline and exits
+nonzero on regression beyond tolerance -- turning the BENCH_*.json
+trajectory from a pile of files into a gate:
+
+* **Row matching** is by the row's identity key (``config``, or
+  ``metric`` for the north star).  Rows present in both runs are
+  compared; baseline rows absent from the current run are ``missing``
+  (gating only under ``--require-all``), new rows are informational.
+* **Per-row-kind tolerance**: throughput values (``value``, higher is
+  better) may drop by at most the kind's tolerance fraction -- serving
+  rows are noisier than engine solves, so their band is wider.  Override
+  any kind with ``--tol kind=frac``.
+* **Strict fields**: ``recall`` must not drop by more than 1e-3;
+  structural booleans (``slo_ok_all``, ``steady_ok``, ``failover_ok``,
+  ``containment_ok``, ``sync_bound_ok``, ``recall_ok``) must never flip
+  true -> false; a current row carrying ``error`` gates.
+* **Typed verdict rows**: one JSON line per comparison
+  (``verdict`` in {ok, improved, regressed, errored, missing, new}) plus
+  one summary line; rc 0 iff nothing gated.
+
+Inputs accept any artifact shape the repo produces: a JSON-lines file of
+rows, a JSON list, or the banked wrapper objects (``{"parsed": row,
+"tail": "<json lines>"}``).  Multiple ``--baseline`` files form a
+trajectory: later files override earlier ones per row key.
+
+Self-test mode (``--self-test``, wired into CI): verifies the gate's own
+teeth -- the committed baseline diffed against itself must pass (rc 0),
+and a synthetically regressed copy (values halved, recall dropped,
+structural booleans flipped) must FAIL.  A gate whose detector cannot
+fire is not a gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: Per-row-kind tolerated fractional drop of `value` (higher-is-better).
+KIND_TOLERANCE = {
+    "north_star": 0.20,
+    "engine": 0.20,
+    "serve": 0.35,      # open-loop serving rows breathe with the host
+    "fleet": 0.35,
+    "pod": 0.30,
+    "frontier": 0.25,
+}
+
+#: Structural booleans that must never flip true -> false.
+STRICT_BOOLS = ("slo_ok_all", "steady_ok", "failover_ok",
+                "containment_ok", "sync_bound_ok", "recall_ok")
+
+RECALL_EPS = 1e-3
+
+
+def row_key(row: dict) -> Optional[str]:
+    return row.get("config") or row.get("metric")
+
+
+def row_kind(row: dict) -> str:
+    config = str(row.get("config") or "")
+    if row.get("metric") and not config:
+        return "north_star"
+    if config.startswith("serving fleet"):
+        return "fleet"
+    if config.startswith("serving"):
+        return "serve"
+    if "pod weak-scaling" in config:
+        return "pod"
+    if "frontier" in config or "mxu general-d" in config:
+        return "frontier"
+    return "engine"
+
+
+def _rows_from_text(text: str) -> List[dict]:
+    """Rows from any artifact shape (see module docstring)."""
+    text = text.strip()
+    rows: List[dict] = []
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = None
+    if isinstance(obj, list):
+        rows = [r for r in obj if isinstance(r, dict)]
+    elif isinstance(obj, dict):
+        if row_key(obj):
+            rows = [obj]
+        else:
+            # banked wrappers: {"lines": [rows]} (the rc-stamped --all
+            # artifacts) and {"parsed": row, "tail": "<json lines>"}
+            if isinstance(obj.get("lines"), list):
+                rows.extend(r for r in obj["lines"]
+                            if isinstance(r, dict) and row_key(r))
+            if isinstance(obj.get("parsed"), dict):
+                rows.append(obj["parsed"])
+            for line in str(obj.get("tail", "")).splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        cand = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(cand, dict) and row_key(cand):
+                        rows.append(cand)
+    else:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                cand = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(cand, dict) and row_key(cand):
+                rows.append(cand)
+    return rows
+
+
+def load_rows(paths: List[str]) -> Dict[str, dict]:
+    """Row-key -> row over a file trajectory (later files win)."""
+    out: Dict[str, dict] = {}
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            for row in _rows_from_text(f.read()):
+                key = row_key(row)
+                if key:
+                    out[key] = row
+    return out
+
+
+def compare_row(key: str, base: dict, cur: dict,
+                tol: Dict[str, float]) -> dict:
+    """One typed verdict row for a matched (baseline, current) pair."""
+    kind = row_kind(base)
+    tolerance = tol.get(kind, 0.25)
+    verdict = {"row": key, "kind": kind, "tolerance": tolerance,
+               "checks": [], "verdict": "ok"}
+
+    def gate(check: str, detail: str) -> None:
+        verdict["checks"].append({"check": check, "detail": detail,
+                                  "ok": False})
+        verdict["verdict"] = "regressed"
+
+    def passed(check: str) -> None:
+        verdict["checks"].append({"check": check, "ok": True})
+
+    if cur.get("error"):
+        verdict["verdict"] = "errored"
+        verdict["checks"].append({"check": "error", "ok": False,
+                                  "detail": str(cur["error"])[:300]})
+        return verdict
+
+    bv, cv = base.get("value"), cur.get("value")
+    if isinstance(bv, (int, float)) and isinstance(cv, (int, float)) \
+            and bv > 0:
+        ratio = cv / bv
+        verdict.update(baseline_value=bv, current_value=cv,
+                       ratio=round(ratio, 4))
+        if ratio < 1.0 - tolerance:
+            gate("value", f"{cv:g} < {bv:g} * (1 - {tolerance:g})")
+        elif ratio > 1.0 + tolerance:
+            verdict["verdict"] = "improved"
+            passed("value")
+        else:
+            passed("value")
+
+    br, cr = base.get("recall"), cur.get("recall")
+    if isinstance(br, (int, float)) and isinstance(cr, (int, float)):
+        if cr < br - RECALL_EPS:
+            gate("recall", f"{cr:g} < {br:g} - {RECALL_EPS:g}")
+        else:
+            passed("recall")
+
+    for flag in STRICT_BOOLS:
+        if base.get(flag) is True:
+            if cur.get(flag) is not True:
+                gate(flag, f"baseline true, current {cur.get(flag)!r}")
+            else:
+                passed(flag)
+    return verdict
+
+
+def diff(baseline: Dict[str, dict], current: Dict[str, dict],
+         tol: Dict[str, float], require_all: bool = False
+         ) -> Tuple[List[dict], int]:
+    """(verdict rows, rc).  rc 0 iff nothing gated."""
+    verdicts: List[dict] = []
+    rc = 0
+    for key in sorted(baseline):
+        if key not in current:
+            verdicts.append({"row": key, "kind": row_kind(baseline[key]),
+                             "verdict": "missing"})
+            if require_all:
+                rc = 1
+            continue
+        v = compare_row(key, baseline[key], current[key], tol)
+        verdicts.append(v)
+        if v["verdict"] in ("regressed", "errored"):
+            rc = 1
+    for key in sorted(set(current) - set(baseline)):
+        verdicts.append({"row": key, "kind": row_kind(current[key]),
+                         "verdict": "new"})
+    return verdicts, rc
+
+
+def seed_regression(rows: Dict[str, dict]) -> Dict[str, dict]:
+    """A synthetically regressed copy of ``rows`` (the self-test's
+    seeded fault): throughput halved, recall dropped, structural
+    booleans flipped."""
+    out: Dict[str, dict] = {}
+    for key, row in rows.items():
+        bad = dict(row)
+        if isinstance(bad.get("value"), (int, float)):
+            bad["value"] = bad["value"] * 0.5
+        if isinstance(bad.get("recall"), (int, float)):
+            bad["recall"] = max(0.0, bad["recall"] - 0.05)
+        for flag in STRICT_BOOLS:
+            if bad.get(flag) is True:
+                bad[flag] = False
+        out[key] = bad
+    return out
+
+
+def _parse_tol(overrides: List[str]) -> Dict[str, float]:
+    tol = dict(KIND_TOLERANCE)
+    for item in overrides or []:
+        kind, _, frac = item.partition("=")
+        if not frac:
+            raise SystemExit(f"--tol expects kind=frac, got {item!r}")
+        tol[kind] = float(frac)
+    return tol
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", action="append", required=True,
+                    help="baseline artifact (repeatable: a trajectory, "
+                         "later files override earlier per row)")
+    ap.add_argument("--current", default=None,
+                    help="current run's artifact (JSON lines / list / "
+                         "banked wrapper).  Required unless --self-test")
+    ap.add_argument("--tol", action="append", default=None,
+                    metavar="KIND=FRAC",
+                    help="override one kind's tolerated value drop "
+                         "(e.g. serve=0.5)")
+    ap.add_argument("--require-all", action="store_true",
+                    help="missing baseline rows gate too (default: "
+                         "informational -- focused runs compare subsets)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="verify the gate itself: baseline vs itself must "
+                         "pass, a seeded synthetic regression must fail")
+    args = ap.parse_args(argv)
+    tol = _parse_tol(args.tol)
+
+    baseline = load_rows(args.baseline)
+    if not baseline:
+        print(json.dumps({"error": "no rows found in baseline",
+                          "files": args.baseline}), flush=True)
+        return 2
+
+    if args.self_test:
+        _, rc_same = diff(baseline, dict(baseline), tol,
+                          require_all=True)
+        seeded = seed_regression(baseline)
+        verdicts, rc_bad = diff(baseline, seeded, tol, require_all=True)
+        tripped = [v["row"] for v in verdicts
+                   if v["verdict"] in ("regressed", "errored")]
+        ok = rc_same == 0 and rc_bad != 0 and tripped
+        print(json.dumps({
+            "self_test": "bench_diff",
+            "identity_rc": rc_same,
+            "seeded_regression_rc": rc_bad,
+            "seeded_rows_tripped": len(tripped),
+            "rows": len(baseline),
+            "ok": bool(ok)}), flush=True)
+        return 0 if ok else 2
+
+    if not args.current:
+        print(json.dumps({"error": "--current is required (or use "
+                                   "--self-test)"}), flush=True)
+        return 2
+    current = load_rows([args.current])
+    verdicts, rc = diff(baseline, current, tol,
+                        require_all=args.require_all)
+    for v in verdicts:
+        print(json.dumps(v), flush=True)
+    counts: Dict[str, int] = {}
+    for v in verdicts:
+        counts[v["verdict"]] = counts.get(v["verdict"], 0) + 1
+    print(json.dumps({"summary": counts, "rc": rc,
+                      "baseline_rows": len(baseline),
+                      "current_rows": len(current)}), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

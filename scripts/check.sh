@@ -210,6 +210,30 @@ for fault in drop-halo stale-directory; do
     fi
 done
 
+# Obs smoke (DESIGN.md section 19): capture a 20k solve trace with the
+# kntpu-trace span tracer, validate the event schema and the seam
+# coverage (knn.prepare/solve/query + dispatch child spans nested inside
+# the solve tree), bound the disabled-mode overhead under 2%, and write
+# the merged Perfetto trace + one metrics snapshot as artifacts (CI
+# uploads ${KNTPU_OBS_DIR}).
+echo "== obs smoke (span schema + disabled-overhead bound + Perfetto export, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.obs \
+    --out-dir "${KNTPU_OBS_DIR:-/tmp/kntpu-obs}" || rc=1
+
+# Bench regression gate (DESIGN.md section 19): the committed BENCH
+# trajectory diffed against itself must pass, and the gate's own seeded
+# synthetic regression must FAIL (a gate whose detector cannot fire is
+# not a gate).  Real captures gate with:
+#   python scripts/bench_diff.py --baseline bench_runs/r5_cpu_all_rows.json \
+#       --current <fresh artifact>
+echo "== bench regression gate (identity + seeded-regression self-test) =="
+python scripts/bench_diff.py --baseline bench_runs/r5_cpu_all_rows.json \
+    --baseline BENCH_r05.json --current bench_runs/r5_cpu_all_rows.json \
+    >/dev/null || rc=1
+python scripts/bench_diff.py --self-test \
+    --baseline bench_runs/r5_cpu_all_rows.json \
+    --baseline BENCH_r05.json || rc=1
+
 # Sync-budget smoke (DESIGN.md section 12): every solve route -- adaptive,
 # legacy pack, external query (single-shot + chunked pipeline), sharded
 # solve + query -- must complete within the one-sync contract's budget of

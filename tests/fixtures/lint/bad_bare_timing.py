@@ -1,0 +1,13 @@
+"""Lint fixture: bare timing on a serve/runtime path (bare-timing)."""
+import time
+from time import perf_counter
+
+
+def measure_batch(run):
+    t0 = time.perf_counter()        # finding: bare perf_counter timing
+    run()
+    elapsed = time.time() - t0      # finding: bare time.time timing
+    t1 = perf_counter()             # finding: bare imported perf_counter
+    waived = time.perf_counter()    # kntpu-ok: bare-timing -- fixture: demonstrates the waiver form
+    legal = time.monotonic()        # injected-clock default: not a finding
+    return elapsed, t1, waived, legal

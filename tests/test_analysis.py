@@ -44,6 +44,10 @@ def _lint(path):
     ("bad_broad_except.py", "broad-except", {7}),
     ("bad_jnp_in_loop.py", "jnp-in-loop", {8}),
     ("bad_bare_valueerror.py", "bare-valueerror", {6, 8}),
+    # ISSUE 13: bare time.time()/perf_counter() timing in serve/runtime
+    # must route through obs.spans / stopwatch (the waived + monotonic
+    # lines in the fixture must stay silent)
+    ("bad_bare_timing.py", "bare-timing", {7, 9, 10}),
 ])
 def test_rule_fires_exactly_where_planted(fixture, rule, lines):
     findings = _lint(fixture)

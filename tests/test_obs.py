@@ -1,0 +1,461 @@
+"""kntpu-trace (ISSUE 13): span tracer, metrics registry, flight
+recorder, bench regression gate, and the serve-tier latency
+decomposition.
+
+The acceptance pins live here: the fleet bench rows stamp the
+span-sourced queue/dispatch/device decomposition whose components sum to
+within 5% of measured end-to-end latency on the 20k fixture; a
+crash-injected supervised job's failure artifact carries the killed
+worker's flight-recorder tail (>= 32 spans); ``scripts/bench_diff.py``
+passes the committed baseline against itself and fails a seeded
+synthetic regression.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu.obs import metrics as obs_metrics
+from cuda_knearests_tpu.obs import recorder as obs_recorder
+from cuda_knearests_tpu.obs import spans as obs_spans
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- spans --------------------------------------------------------------------
+
+def test_span_schema_nesting_and_validation():
+    with obs_spans.capture() as events:
+        with obs_spans.span("outer", a=1):
+            with obs_spans.span("inner", trace_id="t-1"):
+                pass
+        obs_spans.event("marker", note="x")
+    assert [e["name"] for e in events] == ["inner", "outer", "marker"]
+    for e in events:
+        assert obs_spans.validate_event(e) is None, e
+    inner, outer, marker = events
+    assert inner["depth"] == 1 and inner["parent"] == "outer"
+    assert outer["depth"] == 0 and outer["attrs"] == {"a": 1}
+    assert inner["trace_id"] == "t-1"
+    assert marker["kind"] == "event" and marker["dur_ms"] == 0.0
+    # wall anchoring: the inner span starts at/after the outer one
+    assert inner["t0"] >= outer["t0"]
+
+
+def test_disabled_fast_path_is_shared_singleton():
+    assert not obs_spans.enabled()
+    assert obs_spans.span("a") is obs_spans.span("b")
+    # forced spans still time without any sink
+    with obs_spans.span("forced", force=True) as sp:
+        pass
+    assert sp.t1 >= sp.t0 and sp.dur_ms >= 0.0
+
+
+def test_span_records_exception_and_propagates():
+    with obs_spans.capture() as events:
+        with pytest.raises(ValueError):
+            with obs_spans.span("dies"):
+                raise ValueError("boom")
+    assert events[0]["attrs"]["error"] == "ValueError"
+
+
+def test_broken_sink_never_breaks_the_engine():
+    def bad_sink(ev):
+        raise RuntimeError("sink bug")
+
+    obs_spans.add_sink(bad_sink)
+    try:
+        with obs_spans.span("survives"):
+            pass
+    finally:
+        obs_spans.remove_sink(bad_sink)
+
+
+def test_solve_trace_capture_nests_dispatch_children():
+    """The instrumented seams: prepare/solve/query spans appear, and the
+    dispatch fetch spans nest INSIDE the solve span tree."""
+    from cuda_knearests_tpu import KnnConfig, KnnProblem
+    from cuda_knearests_tpu.io import generate_uniform
+
+    pts = generate_uniform(2000, seed=11)
+    with obs_spans.capture() as events:
+        p = KnnProblem.prepare(pts, KnnConfig(k=6))
+        p.solve()
+        p.query(generate_uniform(64, seed=12))
+    names = {e["name"] for e in events}
+    assert {"knn.prepare", "knn.solve", "knn.query",
+            "dispatch.fetch"} <= names
+    fetch_depths = [e["depth"] for e in events
+                    if e["name"] == "dispatch.fetch"]
+    assert fetch_depths and all(d >= 1 for d in fetch_depths)
+
+
+# -- metrics ------------------------------------------------------------------
+
+def test_histogram_percentiles_and_extrema():
+    h = obs_metrics.Histogram("t")
+    for v in range(1, 1001):          # 1..1000 ms uniform
+        h.observe(float(v))
+    snap = h.snapshot()
+    assert snap["count"] == 1000 and snap["min"] == 1.0 \
+        and snap["max"] == 1000.0
+    assert abs(snap["sum"] - 500500.0) < 1e-3
+    p50 = h.percentile(0.5)
+    p99 = h.percentile(0.99)
+    assert 400 <= p50 <= 600, p50          # geometric buckets: ~17% wide
+    assert 900 <= p99 <= 1000.0, p99
+    assert obs_metrics.Histogram("e").percentile(0.5) is None
+
+
+def test_registry_snapshot_and_provider_error_isolation():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("c").inc(3)
+    reg.gauge("g").set(2.5)
+    reg.histogram("h").observe(1.0)
+    reg.register_provider("ok", lambda: {"x": 1})
+    reg.register_provider("bad",
+                          lambda: (_ for _ in ()).throw(RuntimeError("p")))
+    snap = reg.snapshot()
+    assert snap["counters"]["c"] == 3 and snap["gauges"]["g"] == 2.5
+    assert snap["histograms"]["h"]["count"] == 1
+    assert snap["providers"]["ok"] == {"x": 1}
+    assert "error" in snap["providers"]["bad"]
+
+
+def test_unified_snapshot_schema_and_jsonl_emitter(tmp_path):
+    snap = obs_metrics.metrics_snapshot()
+    for key in ("v", "ts", "pid", "counters", "gauges", "histograms",
+                "providers", "dispatch", "exec_cache"):
+        assert key in snap, key
+    assert snap["v"] == obs_metrics.SCHEMA
+    assert "host_syncs" in snap["dispatch"]
+    json.dumps(snap)                       # wire-serializable as-is
+
+    path = tmp_path / "metrics.jsonl"
+    em = obs_metrics.JsonlEmitter(str(path), period_s=0.05)
+    em.start()
+    import time
+
+    time.sleep(0.18)
+    em.stop()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert len(lines) >= 2                 # periodic + final
+    assert all(ln["v"] == obs_metrics.SCHEMA for ln in lines)
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def test_recorder_ring_bound_spill_and_tail(tmp_path):
+    rec = obs_recorder.FlightRecorder(capacity=16)
+    spill = tmp_path / "flight.jsonl"
+    rec.arm(tag="t", spill_path=str(spill))
+    try:
+        for i in range(50):
+            with obs_spans.span(f"s{i}", force=True):
+                pass
+        rec.metric_delta()
+    finally:
+        rec.disarm()
+    dump = rec.dump()
+    assert len(dump["events"]) == 16       # ring stays bounded
+    assert dump["dropped"] == dump["recorded"] - 16
+    assert dump["events"][-1]["kind"] == "metrics"
+    # the spill kept EVERYTHING (line-flushed: survives SIGKILL)
+    tail = obs_recorder.read_spill_tail(str(spill), n=64)
+    assert len(tail) == 52                 # arm marker + 50 spans + delta
+    assert tail[0]["name"] == "recorder.arm"
+    # torn final line (killed mid-write) is skipped, not fatal
+    with open(spill, "a") as f:
+        f.write('{"torn": ')
+    assert len(obs_recorder.read_spill_tail(str(spill), n=8)) == 7
+
+
+def test_supervised_crash_row_carries_flight_tail(tmp_path, monkeypatch):
+    """ISSUE 13 acceptance: a crash-injected supervised job's failure
+    record reconstructs the killed worker's last >= 32 spans.  The
+    abort-after fault SIGKILLs the worker upon its 40th recorded event
+    -- mid-work, exactly like a libtpu kill -- and the supervisor
+    harvests the line-flushed spill."""
+    from cuda_knearests_tpu.runtime import Supervisor
+
+    monkeypatch.setenv("KNTPU_FAILURE_DIR", str(tmp_path))
+    monkeypatch.setenv("KNTPU_FAULT", "abort-after:crashy:40")
+    monkeypatch.setenv("BENCH_ROW_TIMEOUT_S", "120")
+    row, failure = Supervisor().run_job(
+        "crashy", {"job": "selftest", "spans": 64})
+    assert row is None and failure is not None
+    assert failure.kind == "crash" and failure.signal == 9
+    spans = [e for e in failure.flight_tail if e.get("kind") == "span"]
+    assert len(spans) >= 32, len(failure.flight_tail)
+    assert all(e["job"] == "worker:crashy" for e in failure.flight_tail)
+    # the artifact schema carries it (bench failure rows embed to_json())
+    assert len(failure.to_json()["flight_tail"]) >= 32
+
+
+def test_watchdog_stall_artifact_contains_flight_tail(tmp_path,
+                                                      monkeypatch):
+    """ISSUE 13 satellite: under KNTPU_FAULT=hang the worker's stall
+    watchdog must leave a failure artifact containing BOTH the
+    faulthandler all-thread dump and the flight-recorder tail -- the
+    contents are asserted, not just the dump path."""
+    import glob
+
+    from cuda_knearests_tpu.runtime import Supervisor
+
+    monkeypatch.setenv("KNTPU_FAILURE_DIR", str(tmp_path))
+    monkeypatch.setenv("KNTPU_FAULT", "hang:hangy:120")
+    monkeypatch.setenv("BENCH_STALL_TIMEOUT_S", "1")
+    monkeypatch.setenv("BENCH_ROW_TIMEOUT_S", "60")
+    row, failure = Supervisor().run_job(
+        "hangy", {"job": "selftest", "spans": 4})
+    assert row is None and failure.kind == "timeout"
+    assert failure.rc == 3                 # the worker self-exited
+    arts = glob.glob(str(tmp_path / "stall_*.tb"))
+    assert arts, "no stall artifact written"
+    content = open(arts[0]).read()
+    assert "most recent call first" in content       # faulthandler frames
+    assert "flight recorder tail" in content
+    tail_json = content.split("=== flight recorder tail ===", 1)[1]
+    dump = json.loads(tail_json.strip().splitlines()[0])
+    assert dump["tag"] == "worker:hangy"
+    assert any(e["name"] == "recorder.arm" for e in dump["events"])
+    assert any(e["kind"] == "metrics" for e in dump["events"])
+
+
+# -- export -------------------------------------------------------------------
+
+def test_export_merges_processes_into_chrome_trace(tmp_path):
+    from cuda_knearests_tpu.obs import export as obs_export
+
+    def fake(pid, job, name, t0):
+        return {"v": obs_spans.SCHEMA, "kind": "span", "name": name,
+                "t0": t0, "dur_ms": 1.0, "depth": 0, "parent": "",
+                "pid": pid, "job": job, "tid": "main",
+                "trace_id": "r-1", "attrs": {"n": 1}}
+
+    f1 = tmp_path / "trace_a_100.jsonl"
+    f2 = tmp_path / "trace_b_200.jsonl"
+    f1.write_text(json.dumps(fake(100, "worker:a", "s1", 10.0)) + "\n"
+                  + "{torn\n")
+    f2.write_text(json.dumps(fake(200, "worker:b", "s2", 9.0)) + "\n")
+    summary = obs_export.export_dir(str(tmp_path),
+                                    str(tmp_path / "merged.json"))
+    assert summary["files"] == 2 and summary["events"] == 2
+    chrome = json.load(open(tmp_path / "merged.json"))
+    evs = chrome["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert {m["args"]["name"] for m in meta} == {"worker:a", "worker:b"}
+    assert len(xs) == 2
+    # time-sorted and rebased: the earlier event (t0=9.0) leads at ts 0
+    assert xs[0]["name"] == "s2" and xs[0]["ts"] == 0.0
+    assert xs[1]["ts"] == pytest.approx(1e6)
+    assert xs[0]["args"]["trace_id"] == "r-1"
+
+
+# -- serve decomposition (the 20k-fixture acceptance pin) --------------------
+
+def test_serve_decomposition_components_sum_on_20k_fixture():
+    """ISSUE 13 acceptance: per-request queue/dispatch/device components
+    (span-sourced) sum to within 5% of the measured end-to-end latency
+    on the 20k fixture."""
+    from cuda_knearests_tpu import KnnConfig, KnnProblem
+    from cuda_knearests_tpu.config import ServeConfig
+    from cuda_knearests_tpu.io import get_dataset
+    from cuda_knearests_tpu.serve.daemon import ServeDaemon
+
+    points = get_dataset("pts20K.xyz")
+    problem = KnnProblem.prepare(points, KnnConfig(k=8, adaptive=False))
+    daemon = ServeDaemon(problem, ServeConfig(max_batch=64,
+                                              max_delay_s=0.002))
+    rng = np.random.default_rng(7)
+    responses = []
+    for i in range(12):
+        qs = (rng.random((64, 3)) * 900.0 + 50.0).astype(np.float32)
+        responses.extend(daemon.submit(req_id=i, kind="query",
+                                       payload=qs,
+                                       trace_id=f"req-{i}"))
+    responses.extend(daemon.drain())
+    ok = [r for r in responses if r.ok and r.ids is not None]
+    assert len(ok) == 12
+    total_e2e = 0.0
+    total_sum = 0.0
+    for r in ok:
+        assert r.trace_id is not None
+        assert r.queue_ms is not None and r.queue_ms >= 0.0
+        assert r.dispatch_ms is not None and r.device_ms is not None
+        e2e_ms = r.latency_s * 1e3
+        comp = r.queue_ms + r.dispatch_ms + r.device_ms
+        total_e2e += e2e_ms
+        total_sum += comp
+        # per-response: within 5% (plus a sub-ms scheduling floor)
+        assert abs(comp - e2e_ms) <= max(0.05 * e2e_ms, 0.75), \
+            (comp, e2e_ms)
+    # the aggregate 5% criterion, no floor
+    assert abs(total_sum - total_e2e) <= 0.05 * total_e2e, \
+        (total_sum, total_e2e)
+    # the daemon's bounded histograms saw every component
+    deco = daemon.latency_decomposition()
+    for name in ("total_ms", "queue_ms", "dispatch_ms", "device_ms"):
+        assert deco[name]["p50"] is not None, deco
+    # and the wire reply carries the timing block + trace id
+    wire = ok[0].to_wire()
+    assert wire["trace_id"] == ok[0].trace_id
+    assert set(wire["timing"]) == {"queue_ms", "dispatch_ms",
+                                   "device_ms"}
+
+
+def _load_bench():
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    return bench
+
+
+def test_fleet_mix_bench_row_stamps_decomposition(monkeypatch):
+    """ISSUE 13 acceptance: the fleet_4tenant_mix bench row stamps the
+    span-sourced p50/p99 latency decomposition, fleet-wide and per
+    tenant."""
+    monkeypatch.setenv("BENCH_FLEET_N", "600")
+    monkeypatch.setenv("BENCH_FLEET_REQUESTS", "8")
+    bench = _load_bench()
+    row = bench.serve_scenario("fleet_4tenant_mix")
+    deco = row["latency_decomposition"]
+    for name in ("queue_ms", "dispatch_ms", "device_ms"):
+        assert deco[name]["p50"] is not None, deco
+        assert deco[name]["p99"] is not None, deco
+    for tenant, pt in row["per_tenant"].items():
+        if pt["served_rows"] and not pt["sidecar"]:
+            assert pt["decomposition"]["device_ms"]["p50"] is not None, \
+                (tenant, pt)
+
+
+def test_fleet_failover_row_stamps_decomposition():
+    """ISSUE 13 acceptance: the failover drill's row decomposes its
+    wire-level request latency (child-framed op/device timings)."""
+    from cuda_knearests_tpu.serve.fleet.replica import failover_drill
+
+    drill = failover_drill(n=400, k=6, ops=12, seed=3)
+    assert drill["failover_ok"], drill
+    deco = drill["latency_decomposition"]
+    for name in ("total_ms", "queue_ms", "dispatch_ms", "device_ms"):
+        assert name in deco, deco
+    assert deco["device_ms"]["p50"] is not None, deco
+
+
+def test_serve_scenario_filter_env(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setenv("BENCH_SERVE_SCENARIOS", "fleet_failover")
+    assert bench._serve_scenario_names() == ["fleet_failover"]
+    monkeypatch.setenv("BENCH_SERVE_SCENARIOS", "nope")
+    with pytest.raises(ValueError, match="unknown BENCH_SERVE_SCENARIOS"):
+        bench._serve_scenario_names()
+    monkeypatch.delenv("BENCH_SERVE_SCENARIOS")
+    assert bench._serve_scenario_names() == list(bench._SERVE_SCENARIOS)
+
+
+# -- bench regression gate ----------------------------------------------------
+
+def _load_bench_diff():
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO, "scripts", "bench_diff.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_diff_passes_committed_baseline_and_fails_seeded():
+    """ISSUE 13 acceptance: rc 0 on the committed baseline vs itself,
+    rc != 0 on a seeded synthetic regression."""
+    bd = _load_bench_diff()
+    baseline_files = [os.path.join(REPO, "bench_runs",
+                                   "r5_cpu_all_rows.json"),
+                      os.path.join(REPO, "BENCH_r05.json")]
+    rc_same = bd.main(["--baseline", baseline_files[0],
+                       "--baseline", baseline_files[1],
+                       "--current", baseline_files[0]])
+    assert rc_same == 0
+    rc_selftest = bd.main(["--self-test",
+                           "--baseline", baseline_files[0],
+                           "--baseline", baseline_files[1]])
+    assert rc_selftest == 0   # the self-test VERIFIES the seeded trip
+
+    baseline = bd.load_rows(baseline_files)
+    assert len(baseline) >= 7
+    seeded = bd.seed_regression(baseline)
+    verdicts, rc = bd.diff(baseline, seeded, dict(bd.KIND_TOLERANCE))
+    assert rc != 0
+    assert any(v["verdict"] == "regressed" for v in verdicts)
+
+
+def test_bench_diff_verdict_taxonomy(tmp_path):
+    bd = _load_bench_diff()
+    base = {"config": "row A", "value": 100.0, "recall": 1.0,
+            "steady_ok": True}
+    # within tolerance: ok;  errored row gates;  missing is informational
+    cur_ok = dict(base, value=90.0)
+    v = bd.compare_row("row A", base, cur_ok, {"engine": 0.2})
+    assert v["verdict"] == "ok"
+    v = bd.compare_row("row A", base, dict(base, error="boom"),
+                       {"engine": 0.2})
+    assert v["verdict"] == "errored"
+    v = bd.compare_row("row A", base, dict(base, steady_ok=False),
+                       {"engine": 0.2})
+    assert v["verdict"] == "regressed"
+    v = bd.compare_row("row A", base, dict(base, recall=0.9),
+                       {"engine": 0.2})
+    assert v["verdict"] == "regressed"
+    verdicts, rc = bd.diff({"row A": base}, {}, {"engine": 0.2})
+    assert verdicts[0]["verdict"] == "missing" and rc == 0
+    _, rc = bd.diff({"row A": base}, {}, {"engine": 0.2},
+                    require_all=True)
+    assert rc != 0
+
+
+# -- the obs smoke itself -----------------------------------------------------
+
+def test_obs_smoke_main_passes(tmp_path):
+    from cuda_knearests_tpu.obs.__main__ import main as obs_main
+
+    rc = obs_main(["--out-dir", str(tmp_path), "--n", "3000"])
+    assert rc == 0
+    chrome = json.load(open(tmp_path / "trace_merged.json"))
+    assert chrome["traceEvents"]
+    snap = json.loads((tmp_path / "metrics.jsonl").read_text()
+                      .splitlines()[-1])
+    assert snap["v"] == obs_metrics.SCHEMA
+
+
+# -- metrics wire command -----------------------------------------------------
+
+def test_metrics_wire_command_over_stdio():
+    """The serve wire's `metrics` op returns one unified snapshot."""
+    import subprocess
+
+    req = (json.dumps({"id": 1, "op": "query",
+                       "data": [[50.0, 50.0, 50.0]], "k": 4,
+                       "trace_id": "wire-1"}) + "\n"
+           + json.dumps({"id": 2, "op": "metrics"}) + "\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "cuda_knearests_tpu.serve",
+         "--points", "uniform:1500", "--k", "6", "--max-batch", "32",
+         "--max-delay-ms", "2"],
+        input=req, capture_output=True, text=True, timeout=180,
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    replies = [json.loads(ln) for ln in r.stdout.splitlines() if ln]
+    by_id = {rep.get("id"): rep for rep in replies}
+    q = by_id[1]
+    assert q["ok"] and q["trace_id"] == "wire-1"
+    assert set(q["timing"]) == {"queue_ms", "dispatch_ms", "device_ms"}
+    m = by_id[2]
+    assert m["ok"] and m["metrics"]["v"] == obs_metrics.SCHEMA
+    assert "host_syncs" in m["metrics"]["dispatch"]
+    assert "serve" in m["metrics"]
+    assert "latency_decomposition" in m["metrics"]["serve"]

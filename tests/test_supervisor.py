@@ -41,9 +41,10 @@ def test_failure_record_schema_roundtrip():
                         signal=9, attempts=1, stderr_tail="boom")
     d = rec.to_json()
     # every key always present, exactly these -- artifact consumers and the
-    # --all failure rows depend on the stable shape
+    # --all failure rows depend on the stable shape (flight_tail: ISSUE 13,
+    # the killed worker's flight-recorder events; [] when none were spilled)
     assert set(d) == {"kind", "config", "message", "rc", "signal",
-                      "attempts", "stderr_tail"}
+                      "attempts", "stderr_tail", "flight_tail"}
     assert json.loads(json.dumps(d)) == d  # JSON-serializable as-is
     back = FailureRecord.from_json(d)
     assert back == rec
