@@ -12,6 +12,12 @@ Four pieces, one event vocabulary:
   last milliseconds land in the failure artifact.
 * :mod:`.export`  -- merge per-process trace spills into one Chrome
   trace-event JSON (Perfetto-loadable).
+* :mod:`.device` / :mod:`.attribution` (kntpu-scope, DESIGN.md
+  section 20) -- programmatic ``jax.profiler`` capture scoped to a
+  solve window, device-event attribution to spans/scopes/signatures,
+  and the measured-HBM verdict.  NOT imported here: ``device`` touches
+  jax lazily and both load on demand, preserving this package's
+  import-before-any-backend contract.
 
 ``python -m cuda_knearests_tpu.obs`` runs the CPU smoke: capture a 20k
 solve trace, validate the schema, bound the disabled-mode overhead, and

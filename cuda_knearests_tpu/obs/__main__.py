@@ -1,21 +1,32 @@
 """``python -m cuda_knearests_tpu.obs`` -- the observability CPU smoke.
 
-One bounded, chip-free gate (scripts/check.sh + CI):
+One bounded, chip-free gate (scripts/check.sh + CI), staged
+(``--stage all|host|device``):
 
-1. **Trace capture**: solve the 20k fixture with tracing enabled
-   (collector + per-process jsonl spill), then VALIDATE -- every event
-   passes the schema check, the instrumented seams all appear
+1. **Trace capture** (host stage): solve the 20k fixture with tracing
+   enabled (collector + per-process jsonl spill), then VALIDATE -- every
+   event passes the schema check, the instrumented seams all appear
    (``knn.prepare`` / ``knn.solve`` / ``dispatch.fetch``), and the
    dispatch child spans nest INSIDE the solve span tree (depth > 0), so
    sync counters land in the timeline rather than beside it.
-2. **Disabled-overhead bound**: measure the disabled ``span()`` fast
-   path directly (per-call cost over a tight loop), scale it by the
-   span count one traced solve actually emits, and assert the implied
-   per-solve overhead is under ``--overhead-pct`` (default 2%) of the
-   measured solve time.  Deterministic: bounds the machinery itself, not
-   two noisy wall-clock runs against each other.
-3. **Artifacts**: the merged Chrome trace (Perfetto-loadable) and one
-   metrics snapshot line land in ``--out-dir`` -- CI uploads them.
+2. **Disabled-overhead bound** (host stage): measure the disabled
+   ``span()`` fast path directly (per-call cost over a tight loop),
+   scale it by the span count one traced solve actually emits, and
+   assert the implied per-solve overhead is under ``--overhead-pct``
+   (default 2%) of the measured solve time.  Deterministic: bounds the
+   machinery itself, not two noisy wall-clock runs against each other.
+3. **Device capture round trip** (device stage, kntpu-scope): capture
+   one solve under the REAL ``jax.profiler`` via obs/device.py, then
+   assert the full pipeline -- >= 1 executable event captured, every one
+   attributed to exactly one host span (unattributed count ZERO), the
+   measured-HBM verdict true against the engine's own model, mounted
+   device events schema-valid and exported into the SAME merged
+   timeline as the host spans.  The capture-disabled fast path (the
+   only cost bench rows pay when capture is off) is bounded under
+   ``--overhead-pct`` like the span fast path.
+4. **Artifacts**: the merged host+device Chrome trace
+   (Perfetto-loadable) and one metrics snapshot line land in
+   ``--out-dir`` -- CI uploads them.
 
 Exit 0 iff every check passes; one JSON summary line either way.
 ``KNTPU_OBS_N`` scales the fixture for constrained runners.
@@ -43,6 +54,95 @@ def _overhead_per_call_s(calls: int = 200_000) -> float:
     return (time.perf_counter() - t0) / calls
 
 
+def _capture_disabled_cost_s(calls: int = 200_000) -> float:
+    """Measured cost of the capture-off fast path (the only thing a
+    bench row pays when BENCH_DEVICE_CAPTURE=0): one env check."""
+    from . import device as _device
+
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        _device.bench_capture_enabled()
+    return (time.perf_counter() - t0) / calls
+
+
+def _device_stage(args, points, summary: dict,
+                  failures: List[str]) -> None:
+    """The kntpu-scope round trip (DESIGN.md section 20): capture one
+    solve under the real profiler, attribute, reconcile HBM, mount the
+    device lane into the export dir, and bound the capture-off cost."""
+    import jax
+
+    from .. import KnnConfig, KnnProblem
+    from . import attribution as _attr
+    from . import device as _device
+    from . import spans as _spans
+
+    problem = KnnProblem.prepare(points, KnnConfig(k=8))
+
+    def run():
+        res = problem.solve()
+        jax.block_until_ready((res.neighbors, res.dists_sq,
+                               res.certified))
+
+    run()  # warmup: the capture measures a steady-state solve
+    try:
+        report = _device.profile_window(
+            run, trace_id="obs-smoke",
+            hbm_model_bytes=_device.problem_hbm_model(problem))
+    except Exception as e:  # noqa: BLE001 -- the smoke's verdict IS the failure list
+        failures.append(f"device capture failed: {type(e).__name__}: {e}")
+        return
+    summary.update(
+        device_events=len(report.attributed),
+        device_unattributed=len(report.unattributed),
+        device_outside_window=report.outside_window,
+        device_total_ms=report.decomposition["device_total_ms"],
+        hbm_model_ok=report.hbm["hbm_model_ok"],
+        hbm_measured_source=report.hbm["hbm_measured_source"])
+    if not report.attributed:
+        failures.append("device capture attributed zero executable "
+                        "events (the profiler recorded nothing)")
+    if report.unattributed:
+        failures.append(
+            f"{len(report.unattributed)} device events attributed to NO "
+            f"host span (first: "
+            f"{report.unattributed[0].name!r})")
+    if report.hbm["hbm_model_ok"] is not True:
+        failures.append(f"hbm_model_ok failed: {report.hbm}")
+    mounted_bad = [ev for ev in report.mounted
+                   if _spans.validate_event(ev) is not None]
+    if mounted_bad:
+        failures.append(f"{len(mounted_bad)} mounted device events "
+                        f"violate the span schema")
+    scopes = set(report.decomposition["by_scope"])
+    if not any(s.startswith(_attr.SCOPE_PREFIX) for s in scopes):
+        failures.append(f"no kntpu:* named scope in the decomposition "
+                        f"(got {sorted(scopes)})")
+    # the device lane joins the SAME merged timeline as the host spans
+    _attr.write_spill(report.mounted, os.path.join(
+        args.out_dir, f"trace_obs-device_{os.getpid()}.jsonl"))
+    # capture-off fast-path bound (like the PR 12 disabled-span gate).
+    # Denominator: the captured window's OWN measured duration (the
+    # umbrella span) -- the host stage's solve_s does not exist in the
+    # standalone `--stage device` invocation CI runs, and a fictitious
+    # denominator would make the bound vacuous.
+    window = [e for e in report.host_events
+              if e.get("name") == _device.WINDOW_SPAN]
+    solve_s = (window[0]["dur_ms"] / 1e3 if window else 0.0)
+    if solve_s <= 0:
+        failures.append("capture window span missing from the host "
+                        "events: no denominator for the overhead bound")
+        return
+    per_call = _capture_disabled_cost_s()
+    off_pct = 100.0 * per_call / solve_s
+    summary.update(device_window_s=round(solve_s, 4),
+                   capture_off_ns_per_check=round(per_call * 1e9, 1),
+                   capture_off_overhead_pct=round(off_pct, 6))
+    if off_pct >= args.overhead_pct:
+        failures.append(f"capture-off overhead {off_pct:.4f}% >= "
+                        f"{args.overhead_pct}% bound")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m cuda_knearests_tpu.obs",
@@ -57,6 +157,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--overhead-pct", type=float, default=2.0,
                     help="disabled-mode overhead bound, percent of one "
                          "solve (default 2.0)")
+    ap.add_argument("--stage", choices=("all", "host", "device"),
+                    default="all",
+                    help="which smoke stages to run (check.sh gates the "
+                         "host and device stages as separate lines)")
     args = ap.parse_args(argv)
 
     from ..utils.platform import enable_compile_cache, honor_jax_platforms_env
@@ -78,61 +182,66 @@ def main(argv: Optional[List[str]] = None) -> int:
     points = generate_uniform(args.n, seed=5)
     queries = generate_uniform(max(256, args.n // 16), seed=6)
 
-    # 1. traced solve: collector + spill, then schema/seam validation
-    sink = _spans.start_file_trace(os.path.join(
-        args.out_dir, f"trace_obs-smoke_{os.getpid()}.jsonl"))
-    with _spans.capture() as events:
-        problem = KnnProblem.prepare(points, KnnConfig(k=8))
-        problem.solve()
-        problem.query(queries)
-    sink.close()
-    bad = [(ev.get("name"), why) for ev in events
-           if (why := _spans.validate_event(ev)) is not None]
-    if bad:
-        failures.append(f"schema violations: {bad[:5]}")
-    names = {ev["name"] for ev in events}
-    for need in ("knn.prepare", "knn.solve", "knn.query",
-                 "dispatch.fetch"):
-        if need not in names:
-            failures.append(f"missing expected span {need!r}")
-    nested_fetch = [ev for ev in events if ev["name"] == "dispatch.fetch"
-                    and ev["depth"] > 0]
-    if not nested_fetch:
-        failures.append("dispatch.fetch spans did not nest inside the "
-                        "solve span tree")
-    summary["events"] = len(events)
-    solve_events = [ev for ev in events if ev["name"] == "knn.solve"]
-    solve_s = (solve_events[0]["dur_ms"] / 1e3 if solve_events else 0.0)
+    if args.stage in ("all", "host"):
+        # 1. traced solve: collector + spill, then schema/seam validation
+        sink = _spans.start_file_trace(os.path.join(
+            args.out_dir, f"trace_obs-smoke_{os.getpid()}.jsonl"))
+        with _spans.capture() as events:
+            problem = KnnProblem.prepare(points, KnnConfig(k=8))
+            problem.solve()
+            problem.query(queries)
+        sink.close()
+        bad = [(ev.get("name"), why) for ev in events
+               if (why := _spans.validate_event(ev)) is not None]
+        if bad:
+            failures.append(f"schema violations: {bad[:5]}")
+        names = {ev["name"] for ev in events}
+        for need in ("knn.prepare", "knn.solve", "knn.query",
+                     "dispatch.fetch"):
+            if need not in names:
+                failures.append(f"missing expected span {need!r}")
+        nested_fetch = [ev for ev in events
+                        if ev["name"] == "dispatch.fetch"
+                        and ev["depth"] > 0]
+        if not nested_fetch:
+            failures.append("dispatch.fetch spans did not nest inside the "
+                            "solve span tree")
+        summary["events"] = len(events)
+        solve_events = [ev for ev in events if ev["name"] == "knn.solve"]
+        solve_s = (solve_events[0]["dur_ms"] / 1e3 if solve_events else 0.0)
 
-    # 2. disabled-overhead bound (the near-zero-cost contract)
-    spans_per_solve = sum(1 for ev in events)
-    per_call = _overhead_per_call_s()
-    overhead_pct = (100.0 * spans_per_solve * per_call / solve_s
-                    if solve_s > 0 else 0.0)
-    summary.update(spans_per_solve=spans_per_solve,
-                   disabled_ns_per_span=round(per_call * 1e9, 1),
-                   solve_s=round(solve_s, 4),
-                   disabled_overhead_pct=round(overhead_pct, 4))
-    if overhead_pct >= args.overhead_pct:
-        failures.append(
-            f"disabled-mode overhead {overhead_pct:.3f}% >= "
-            f"{args.overhead_pct}% bound")
+        # 2. disabled-overhead bound (the near-zero-cost contract)
+        spans_per_solve = sum(1 for ev in events)
+        per_call = _overhead_per_call_s()
+        overhead_pct = (100.0 * spans_per_solve * per_call / solve_s
+                        if solve_s > 0 else 0.0)
+        summary.update(spans_per_solve=spans_per_solve,
+                       disabled_ns_per_span=round(per_call * 1e9, 1),
+                       solve_s=round(solve_s, 4),
+                       disabled_overhead_pct=round(overhead_pct, 4))
+        if overhead_pct >= args.overhead_pct:
+            failures.append(
+                f"disabled-mode overhead {overhead_pct:.3f}% >= "
+                f"{args.overhead_pct}% bound")
 
-    # 3. metrics registry sanity + snapshot artifact
-    _metrics.REGISTRY.counter("obs.smoke_runs").inc()
-    hist = _metrics.Histogram("obs.probe_ms")
-    for v in (1.0, 2.0, 4.0, 8.0):
-        hist.observe(v)
-    if hist.snapshot()["count"] != 4 or hist.percentile(0.5) is None:
-        failures.append("histogram self-check failed")
-    snap = _metrics.metrics_snapshot()
-    for key in ("v", "ts", "counters", "histograms", "dispatch",
-                "exec_cache"):
-        if key not in snap:
-            failures.append(f"metrics snapshot missing {key!r}")
-    with open(os.path.join(args.out_dir, "metrics.jsonl"), "a",
-              encoding="utf-8") as f:
-        f.write(json.dumps(snap) + "\n")
+        # 3. metrics registry sanity + snapshot artifact
+        _metrics.REGISTRY.counter("obs.smoke_runs").inc()
+        hist = _metrics.Histogram("obs.probe_ms")
+        for v in (1.0, 2.0, 4.0, 8.0):
+            hist.observe(v)
+        if hist.snapshot()["count"] != 4 or hist.percentile(0.5) is None:
+            failures.append("histogram self-check failed")
+        snap = _metrics.metrics_snapshot()
+        for key in ("v", "ts", "counters", "histograms", "dispatch",
+                    "exec_cache"):
+            if key not in snap:
+                failures.append(f"metrics snapshot missing {key!r}")
+        with open(os.path.join(args.out_dir, "metrics.jsonl"), "a",
+                  encoding="utf-8") as f:
+            f.write(json.dumps(snap) + "\n")
+
+    if args.stage in ("all", "device"):
+        _device_stage(args, points, summary, failures)
 
     # 4. merged Perfetto trace artifact
     exp = _export.export_dir(

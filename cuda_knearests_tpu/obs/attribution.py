@@ -1,0 +1,392 @@
+"""Device-event attribution: captured profiler events -> signatures/spans.
+
+``obs/device.py`` captures a ``jax.profiler`` trace scoped to one solve
+window.  This module is the pure-parsing half (no jax import -- the
+capture product is a gzipped Chrome trace-event document, and parsing it
+must work in processes that never initialize a backend):
+
+* :func:`load_chrome_trace` / :func:`chrome_events` -- read the capture.
+* :func:`rebase` -- map the profiler's private microsecond axis onto the
+  span tracer's wall-clock axis via the capture-anchor annotation
+  (``kntpu.capture:<id>``) whose host-side wall time the capturer
+  recorded, and classify every event:
+
+    - ``exec``   -- an executable/op event (carries ``hlo_module`` /
+      ``hlo_op`` args): actual device compute, the thing we attribute.
+    - ``scope``  -- a ``profiling.annotate`` named region (the
+      ``kntpu:*`` scopes the routes already emit).
+    - ``anchor`` -- the capture window annotation itself.
+    - ``other``  -- profiler plumbing (ignored by attribution).
+
+* :data:`MODULE_REGISTRY` / :func:`register_executable` -- the
+  hlo-module -> executable-signature join: ``runtime.dispatch``'s
+  ExecutableCache registers every AOT build here (module name, cache-key
+  label, compile wall seconds, ``cost_analysis()`` flops/bytes), so a
+  captured ``hlo_module`` resolves to the executable signature that
+  compiled it.
+* :func:`attribute` -- mount each exec event into the host span
+  timeline: the innermost host span containing the event's midpoint
+  (deepest, then latest-started -- unique by span nesting), plus the
+  innermost named scope and the registry signature.  Events no span
+  covers come back as ``unattributed`` -- the capture harness asserts
+  that count is ZERO (its umbrella window span guarantees coverage).
+* :func:`decomposition` -- the ``device_time_decomposition`` bench
+  stamp: device ms by module / scope / span, with per-module compile
+  seconds and achieved GFLOP/s where the registry knows the cost.
+* :func:`mount` / :func:`write_spill` -- re-express attributed events in
+  the span event schema (obs/spans.py) so ``obs/export.py`` merges them
+  into ONE host+device Perfetto timeline (device events ride a
+  ``device:*`` thread lane of the capturing process).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from . import spans as _spans
+
+#: Prefix of the capture-window anchor annotation (obs/device.py opens a
+#: ``TraceAnnotation(CAPTURE_PREFIX + capture_id)`` around the window and
+#: records its host wall time -- the affine clock join).
+CAPTURE_PREFIX = "kntpu.capture:"
+
+#: Prefix of the engine's named profiler scopes (utils/profiling.annotate
+#: call sites: ``kntpu:adaptive-solve``, ``kntpu:halo-exchange``, ...).
+SCOPE_PREFIX = "kntpu:"
+
+#: Safety margin (seconds) when window-filtering exec events: profiler
+#: event close timestamps can trail the anchor exit by scheduler noise.
+WINDOW_EPS_S = 0.050
+
+
+# -- executable registry (the compile-observability join) ---------------------
+
+_REG_LOCK = threading.Lock()
+#: hlo module name -> {"module", "label", "compile_s", "flops",
+#: "bytes_accessed"}: fed by ExecutableCache.get_or_build at compile time,
+#: read by attribution when a captured event carries that module name.
+MODULE_REGISTRY: Dict[str, dict] = {}
+
+
+def register_executable(module: Optional[str], label: str = "",
+                        compile_s: Optional[float] = None,
+                        flops: Optional[float] = None,
+                        bytes_accessed: Optional[float] = None) -> None:
+    """Record one compiled executable's identity + cost census.  Keyed by
+    the XLA module name because that is exactly what captured device
+    events carry (``args.hlo_module``)."""
+    if not module:
+        return
+    with _REG_LOCK:
+        ent = MODULE_REGISTRY.setdefault(str(module), {"module": str(module)})
+        if label:
+            ent["label"] = str(label)
+        if compile_s is not None:
+            ent["compile_s"] = round(float(compile_s), 6)
+        if flops is not None:
+            ent["flops"] = float(flops)
+        if bytes_accessed is not None:
+            ent["bytes_accessed"] = float(bytes_accessed)
+
+
+def executable_info(module: Optional[str]) -> Optional[dict]:
+    if not module:
+        return None
+    with _REG_LOCK:
+        ent = MODULE_REGISTRY.get(module)
+        return dict(ent) if ent is not None else None
+
+
+# -- capture parsing ----------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DeviceEvent:
+    """One captured profiler event, rebased onto the span wall axis."""
+
+    name: str
+    t0: float          # wall seconds (same axis as span events' ``t0``)
+    dur_ms: float
+    pid: int
+    tid: str
+    kind: str          # 'exec' | 'scope' | 'anchor' | 'other'
+    hlo_module: Optional[str] = None
+    hlo_op: Optional[str] = None
+
+    @property
+    def t1(self) -> float:
+        return self.t0 + self.dur_ms / 1e3
+
+    @property
+    def midpoint(self) -> float:
+        return self.t0 + self.dur_ms / 2e3
+
+
+def load_chrome_trace(path: str) -> dict:
+    """A capture's Chrome trace-event document (gzipped or plain JSON)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:  # type: ignore[operator]
+        return json.loads(f.read().decode("utf-8"))
+
+
+def chrome_events(doc: dict) -> List[dict]:
+    """The complete ('X') events of a Chrome trace document."""
+    return [ev for ev in doc.get("traceEvents", [])
+            if isinstance(ev, dict) and ev.get("ph") == "X"]
+
+
+def _full_name(raw: dict) -> str:
+    """An event's full name: the trace exporter splits ``prefix:rest``
+    annotation names into (category, short name) and parks the original
+    under ``args.long_name`` -- the ``kntpu:*`` scopes and the capture
+    anchor must match either spelling."""
+    args = raw.get("args") or {}
+    return str(args.get("long_name") or raw.get("name", ""))
+
+
+#: Host-side launch events ("PjitFunction(<fn>)") recorded by the
+#: profiler's python tracer: the launch-order join maps the module they
+#: dispatch ("jit_<fn>") onto the named scope the launch ran under, so
+#: ASYNC executions -- compute landing after the dispatching scope closed
+#: -- still attribute to the scope that launched them.
+_LAUNCH_PREFIX = "PjitFunction("
+
+
+def _classify(raw: dict) -> Tuple[str, Optional[str], Optional[str]]:
+    args = raw.get("args") or {}
+    name = _full_name(raw)
+    if name.startswith(CAPTURE_PREFIX):
+        return "anchor", None, None
+    module = args.get("hlo_module")
+    if module:
+        return "exec", str(module), (str(args["hlo_op"])
+                                     if args.get("hlo_op") else None)
+    if name.startswith(SCOPE_PREFIX):
+        return "scope", None, None
+    if name.startswith(_LAUNCH_PREFIX):
+        return "launch", None, None
+    return "other", None, None
+
+
+def rebase(raw_events: List[dict], anchor_wall: float,
+           capture_id: str) -> Tuple[List[DeviceEvent], int]:
+    """(window events on the wall axis, count dropped as outside-window).
+
+    The anchor annotation ``kntpu.capture:<capture_id>`` appears in the
+    capture at its own profiler timestamp; the capturer recorded the host
+    wall clock at the instant it opened that annotation.  The offset
+    between the two joins the axes (one shared host clock family -- the
+    drift over a solve window is far below event durations).  Exec/scope
+    events whose midpoint falls outside the anchor interval (work from
+    before the window that the profiler session still saw) are dropped
+    and counted, never silently attributed.
+    """
+    anchor_name = CAPTURE_PREFIX + capture_id
+    anchor = next((ev for ev in raw_events
+                   if _full_name(ev) == anchor_name), None)
+    if anchor is None:
+        raise ValueError(
+            f"capture anchor {anchor_name!r} not found in the trace "
+            f"({len(raw_events)} events): the profiler did not record "
+            f"the window annotation")
+    a_ts = float(anchor["ts"])
+    a_dur_s = float(anchor.get("dur", 0.0)) / 1e6
+    lo = anchor_wall - WINDOW_EPS_S
+    hi = anchor_wall + a_dur_s + WINDOW_EPS_S
+    out: List[DeviceEvent] = []
+    outside = 0
+    for raw in raw_events:
+        kind, module, op = _classify(raw)
+        t0 = anchor_wall + (float(raw.get("ts", 0.0)) - a_ts) / 1e6
+        dur_ms = float(raw.get("dur", 0.0)) / 1e3
+        ev = DeviceEvent(name=_full_name(raw), t0=t0,
+                         dur_ms=dur_ms, pid=int(raw.get("pid", 0)),
+                         tid=str(raw.get("tid", "")), kind=kind,
+                         hlo_module=module, hlo_op=op)
+        if kind in ("exec", "scope", "launch") \
+                and not (lo <= ev.midpoint <= hi):
+            outside += 1
+            continue
+        out.append(ev)
+    return out, outside
+
+
+# -- attribution --------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Attribution:
+    """One exec event mounted into the host timeline."""
+
+    event: DeviceEvent
+    span_name: str
+    span_depth: int
+    trace_id: Optional[str]
+    scope: Optional[str]          # innermost kntpu:* named region
+    signature: Optional[dict]     # MODULE_REGISTRY entry (or None)
+
+
+def attribute(events: List[DeviceEvent], host_events: List[dict]
+              ) -> Tuple[List[Attribution], List[DeviceEvent]]:
+    """Mount every exec event into the host span timeline.
+
+    Host spans are finished span-schema event dicts (an obs/spans
+    Collector's output).  Each exec event lands in the innermost host
+    span containing its midpoint -- deepest nesting level, then latest
+    start, which is unique because same-thread spans strictly nest and
+    the capture harness's umbrella window span covers the whole window.
+    Returns (attributed, unattributed); the capture harness asserts the
+    second list is EMPTY.
+    """
+    spans = [e for e in host_events
+             if e.get("kind") == "span"
+             and isinstance(e.get("t0"), (int, float))]
+    scopes = [e for e in events if e.kind == "scope"]
+    # launch-order join: a host "PjitFunction(<fn>)" event inside a named
+    # scope dispatched module "jit_<fn>" -- compute for that module
+    # attributes to the scope even when it executes AFTER the scope
+    # closed (async dispatch: the host moves on to block in fetch while
+    # the executor runs the program)
+    launch_map: Dict[str, str] = {}
+    for ev in events:
+        if ev.kind != "launch":
+            continue
+        fn = ev.name[len(_LAUNCH_PREFIX):].rstrip(")")
+        enclosing = [sc for sc in scopes
+                     if sc.t0 <= ev.midpoint <= sc.t1]
+        if fn and enclosing:
+            launch_map.setdefault(
+                "jit_" + fn,
+                min(enclosing, key=lambda sc: sc.dur_ms).name)
+    attributed: List[Attribution] = []
+    unattributed: List[DeviceEvent] = []
+    for ev in events:
+        if ev.kind != "exec":
+            continue
+        mid = ev.midpoint
+        cands = [s for s in spans
+                 if s["t0"] <= mid <= s["t0"] + s["dur_ms"] / 1e3]
+        if not cands:
+            unattributed.append(ev)
+            continue
+        best = max(cands, key=lambda s: (s["depth"], s["t0"]))
+        enclosing = [sc for sc in scopes if sc.t0 <= mid <= sc.t1]
+        scope = (min(enclosing, key=lambda sc: sc.dur_ms).name
+                 if enclosing else launch_map.get(ev.hlo_module or ""))
+        attributed.append(Attribution(
+            event=ev, span_name=str(best["name"]),
+            span_depth=int(best["depth"]),
+            trace_id=best.get("trace_id"), scope=scope,
+            signature=executable_info(ev.hlo_module)))
+    return attributed, unattributed
+
+
+def _top(acc: Dict[str, float], cap: int) -> Dict[str, float]:
+    """Largest ``cap`` buckets (ms, rounded), the tail folded into
+    ``"...other"`` -- bench rows must stay bounded however many modules a
+    big solve executes."""
+    items = sorted(acc.items(), key=lambda kv: -kv[1])
+    out = {k: round(v, 4) for k, v in items[:cap]}
+    rest = sum(v for _, v in items[cap:])
+    if rest > 0:
+        out["...other"] = round(rest, 4)
+    return out
+
+
+def decomposition(attributed: List[Attribution],
+                  unattributed: List[DeviceEvent],
+                  cap: int = 12,
+                  events: Optional[List[DeviceEvent]] = None) -> dict:
+    """The ``device_time_decomposition`` stamp: measured device ms by
+    executable module, named scope, and host span, plus per-module
+    compile/cost provenance where the ExecutableCache registered it.
+
+    ``events`` (the full window event list) lets per-module achieved
+    GFLOP/s account for REPEATED executions: exec events are per-op, so
+    execution counts come from the host ``PjitFunction(<fn>)`` launch
+    events -- a module launched N times in the window did N times its
+    cost census's flops.  Without launch evidence the count defaults to
+    1 and the figure is a lower bound."""
+    by_module: Dict[str, float] = {}
+    by_scope: Dict[str, float] = {}
+    by_span: Dict[str, float] = {}
+    modules: Dict[str, dict] = {}
+    total = 0.0
+    for a in attributed:
+        ms = a.event.dur_ms
+        total += ms
+        mod = a.event.hlo_module or "<unknown-module>"
+        by_module[mod] = by_module.get(mod, 0.0) + ms
+        by_scope[a.scope or "<no-scope>"] = \
+            by_scope.get(a.scope or "<no-scope>", 0.0) + ms
+        by_span[a.span_name] = by_span.get(a.span_name, 0.0) + ms
+        if a.signature and mod not in modules:
+            modules[mod] = {k: a.signature[k] for k in
+                            ("label", "compile_s", "flops",
+                             "bytes_accessed") if k in a.signature}
+    launches: Dict[str, int] = {}
+    for ev in events or []:
+        if ev.kind == "launch":
+            fn = ev.name[len(_LAUNCH_PREFIX):].rstrip(")")
+            if fn:
+                launches["jit_" + fn] = launches.get("jit_" + fn, 0) + 1
+    for mod, info in modules.items():
+        ms = by_module.get(mod, 0.0)
+        n_exec = max(1, launches.get(mod, 0))
+        if ms > 0 and isinstance(info.get("flops"), (int, float)):
+            info["executions"] = n_exec
+            info["achieved_gflops"] = round(
+                info["flops"] * n_exec / (ms / 1e3) / 1e9, 3)
+    return {
+        "device_total_ms": round(total, 4),
+        "events": len(attributed),
+        "unattributed": len(unattributed),
+        "by_module": _top(by_module, cap),
+        "by_scope": _top(by_scope, cap),
+        "by_span": _top(by_span, cap),
+        **({"modules": modules} if modules else {}),
+    }
+
+
+# -- mounting into the merged timeline ---------------------------------------
+
+def mount(attributed: List[Attribution], job: str = "device") -> List[dict]:
+    """Attributed device events as span-schema event dicts: one child
+    span per exec event, parented under the host span it attributed to,
+    on a ``device:*`` thread lane of THIS process -- obs/export.py merges
+    them into the same Perfetto timeline as the host spans with zero
+    special-casing (they validate against the same schema)."""
+    out = []
+    for a in attributed:
+        ev = a.event
+        attrs: dict = {}
+        if ev.hlo_module:
+            attrs["hlo_module"] = ev.hlo_module
+        if ev.hlo_op:
+            attrs["hlo_op"] = ev.hlo_op
+        if a.scope:
+            attrs["scope"] = a.scope
+        if a.signature and a.signature.get("label"):
+            attrs["signature"] = a.signature["label"]
+        out.append({"v": _spans.SCHEMA, "kind": "span", "name": ev.name,
+                    "t0": ev.t0, "dur_ms": round(ev.dur_ms, 6),
+                    "depth": a.span_depth + 1, "parent": a.span_name,
+                    "pid": os.getpid(), "job": job,
+                    "tid": f"device:{ev.tid}", "trace_id": a.trace_id,
+                    "attrs": attrs})
+    return out
+
+
+def write_spill(events: List[dict], path: str) -> str:
+    """Append span-schema events to a ``trace_*.jsonl`` spill (the shape
+    obs/export.py globs), creating directories as needed."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a", encoding="utf-8") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return path

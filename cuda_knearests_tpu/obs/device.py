@@ -1,0 +1,376 @@
+"""kntpu-scope: programmatic device-time capture scoped to a solve window.
+
+PR 12's span tracer sees only the host -- "device time" was wall clock
+around a blocking ``dispatch.fetch``.  This module closes the gap with
+three measured quantities per window, all exercised end-to-end on the CPU
+backend profiler (tier-1) so the hardware path is proven before a chip
+ever appears:
+
+* **Device-time attribution** -- :func:`profile_window` runs a callable
+  under ``jax.profiler`` capture with a wall-anchored window annotation,
+  parses the capture (obs/attribution.py), and attributes every
+  executable event to the host span timeline + the ``kntpu:*`` named
+  scopes + the ExecutableCache signature registry.  Zero unattributed
+  executions is an asserted property, not a hope: the harness holds an
+  umbrella window span open for the whole capture.
+* **Measured-HBM validation** -- :class:`HbmSampler` samples device
+  memory through the window (``jax.Device.memory_stats()`` where the
+  backend reports it; the summed ``jax.live_arrays()`` footprint on the
+  CPU fallback) and :func:`hbm_fields` reconciles the window's measured
+  growth against the engine's own model (``hbm_bytes_estimate`` /
+  ``chip_hbm_model``) into a typed ``hbm_model_ok`` verdict: the model
+  must DOMINATE the measured growth within :data:`HBM_MODEL_HEADROOM`
+  (a systematic underestimate -- the preflight blessing a would-OOM
+  launch -- fails the verdict, and scripts/bench_diff.py gates on the
+  flip).
+* **One merged timeline** -- attributed device events are re-expressed
+  in the span event schema and spilled beside the host spans
+  (``KNTPU_TRACE_DIR``), so ``obs/export.py`` emits one host+device
+  Perfetto trace with no special cases.
+
+``bench.py`` rows stamp :func:`bench_capture_fields` (one extra captured
+solve after the timed runs -- the measurement itself stays uncaptured);
+``scripts/tpu_watch.py --capture`` drives the whole ladder in one
+command.  Everything jax-flavored imports lazily: the obs package must
+stay importable before any backend exists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import shutil
+import tempfile
+import threading
+import uuid
+from typing import Callable, List, Optional
+
+from . import attribution as _attr
+from . import spans as _spans
+
+#: The umbrella span the harness holds open for the whole window -- the
+#: fallback attribution target that makes zero-unattributed a guarantee.
+WINDOW_SPAN = "obs.capture_window"
+
+#: The model-dominates-measurement slack: the preflight budgets 80% of
+#: the device limit to one launch (pallas_solve._HBM_BUDGET_FRACTION),
+#: i.e. it reserves 1.25x headroom for XLA temporaries -- the verdict
+#: grants the measurement the same factor before calling the model an
+#: underestimate.
+HBM_MODEL_HEADROOM = 1.25
+
+
+class CaptureError(RuntimeError):
+    """A device capture could not run or produced no parseable trace."""
+
+
+# one capture per process at a time: jax.profiler sessions do not nest
+_ACTIVE = threading.Lock()
+
+
+def bench_capture_enabled() -> bool:
+    """The bench-row gate: BENCH_DEVICE_CAPTURE=0 disables the extra
+    captured solve entirely (this check is the only cost of 'off')."""
+    return os.environ.get("BENCH_DEVICE_CAPTURE", "1") != "0"
+
+
+def _trace_file(log_dir: str) -> str:
+    """The capture's Chrome trace file (the profiler writes one run dir
+    per session under ``plugins/profile/<stamp>/``)."""
+    for pattern in ("*.trace.json.gz", "perfetto_trace.json.gz"):
+        cands = sorted(glob.glob(os.path.join(
+            log_dir, "plugins", "profile", "*", pattern)))
+        if cands:
+            return cands[-1]
+    raise CaptureError(
+        f"no Chrome trace under {log_dir!r}: the profiler session "
+        f"produced no parseable capture on this backend")
+
+
+# -- measured HBM -------------------------------------------------------------
+
+class HbmSampler(threading.Thread):
+    """Samples device-memory footprint through a window: floor (first
+    sample), peak, and the source of truth -- ``memory_stats`` where the
+    backend reports ``bytes_in_use`` (TPU), else the summed ``nbytes``
+    of all live ``jax.Array`` buffers (the CPU backend reports no
+    allocator stats; live buffers are the measurable device footprint
+    there).  ``start()``/``stop()`` take one synchronous sample each, so
+    floor and peak exist even if the thread never gets scheduled."""
+
+    def __init__(self, period_s: float = 0.004):
+        super().__init__(daemon=True, name="kntpu-hbm-sampler")
+        self.period_s = max(0.001, float(period_s))
+        self._halt = threading.Event()
+        self.floor: Optional[int] = None
+        self.peak: int = 0
+        self.samples = 0
+        self.source = "unavailable"
+
+    @staticmethod
+    def _read() -> "tuple[int, str]":
+        import jax
+
+        try:
+            stats = jax.devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 -- some backends raise instead of returning None
+            stats = None
+        if stats and "bytes_in_use" in stats:
+            return int(stats["bytes_in_use"]), "memory_stats"
+        try:
+            return (int(sum(int(a.nbytes) for a in jax.live_arrays())),
+                    "live_arrays")
+        except Exception:  # noqa: BLE001 -- a backend without live-array introspection measures nothing, not an error
+            return 0, "unavailable"
+
+    def _sample(self) -> None:
+        v, src = self._read()
+        self.samples += 1
+        self.source = src
+        if self.floor is None:
+            self.floor = v
+        self.peak = max(self.peak, v)
+
+    def run(self) -> None:
+        while not self._halt.wait(self.period_s):
+            self._sample()
+
+    def start(self) -> None:  # type: ignore[override]
+        self._sample()                  # synchronous floor sample
+        super().start()
+
+    def stop(self) -> "HbmSampler":
+        self._halt.set()
+        if self.is_alive():
+            self.join(timeout=5.0)
+        self._sample()                  # synchronous closing sample
+        return self
+
+    def result(self) -> dict:
+        return {"peak": int(self.peak), "floor": int(self.floor or 0),
+                "samples": int(self.samples), "source": self.source}
+
+
+def hbm_fields(sample: dict, model_bytes: Optional[int]) -> dict:
+    """The measured-HBM bench stamp + the typed ``hbm_model_ok`` verdict.
+
+    Law: the window's measured growth (``peak - floor`` -- ambient
+    residency from before the window subtracts out) must not exceed the
+    engine's modeled footprint times :data:`HBM_MODEL_HEADROOM`.  A
+    systematic underestimate is exactly the failure the preflight model
+    must never have: it would bless launches that OOM the chip.  Engines
+    with no device plan to model (the oracle backend answers on the
+    host) have nothing to reconcile: the verdict is vacuously true and
+    says so."""
+    peak, floor = int(sample["peak"]), int(sample["floor"])
+    delta = max(0, peak - floor)
+    out = {
+        "hbm_measured_peak": peak,
+        "hbm_measured_floor": floor,
+        "hbm_window_delta_bytes": delta,
+        "hbm_measured_source": sample["source"],
+        "hbm_samples": int(sample["samples"]),
+        "hbm_model_bytes": (int(model_bytes)
+                            if model_bytes is not None else None),
+        "hbm_model_headroom": HBM_MODEL_HEADROOM,
+    }
+    if model_bytes is None:
+        out["hbm_model_ok"] = True
+        out["hbm_model_note"] = ("no device-plan model for this engine "
+                                 "(host-native route): nothing to "
+                                 "reconcile")
+        return out
+    out["hbm_model_ok"] = bool(delta <= model_bytes * HBM_MODEL_HEADROOM)
+    if not out["hbm_model_ok"]:
+        out["hbm_model_verdict"] = (
+            f"systematic underestimate: window grew {delta} bytes > "
+            f"model {int(model_bytes)} * {HBM_MODEL_HEADROOM} -- the "
+            f"preflight model would bless a launch this size")
+    return out
+
+
+def problem_hbm_model(problem) -> Optional[int]:
+    """The engine's own modeled device footprint for one solve of a
+    prepared single-chip KnnProblem: the launch-scale HBM model
+    (``pallas_solve.hbm_bytes_estimate``) summed over the plan's classes,
+    plus the assembled result rows.  None when the engine has no device
+    plan (oracle backend) -- the measured-HBM verdict is then vacuous."""
+    cfg = problem.config
+    if cfg.backend == "oracle":
+        return None
+    from ..ops.pallas_solve import hbm_bytes_estimate
+
+    k = int(cfg.k)
+    total = 0
+    if getattr(problem, "aplan", None) is not None:
+        for cp in problem.aplan.classes:
+            total += hbm_bytes_estimate(cp.qcap_pad, cp.ccap, k, cp.n_sc)
+        n = int(problem.aplan.n_points)
+    elif getattr(problem, "pack", None) is not None:
+        pack = problem.pack
+        total += hbm_bytes_estimate(pack.qx.shape[2], pack.cx.shape[2], k,
+                                    pack.qx.shape[0])
+        n = int(pack.inv_flat.shape[0])
+    elif getattr(problem, "plan", None) is not None:
+        plan = problem.plan
+        total += hbm_bytes_estimate(plan.qcap, plan.ccap, k,
+                                    plan.n_chunks * plan.batch)
+        n = int(problem.grid.n_points)
+    else:
+        return None
+    total += 2 * 4 * n * k  # assembled (n, k) ids + d2 result rows
+    return int(total)
+
+
+# -- the capture window -------------------------------------------------------
+
+@dataclasses.dataclass
+class WindowReport:
+    """Everything one captured window measured."""
+
+    capture_id: str
+    ret: object                      # the callable's return value
+    host_events: List[dict]          # span-schema events from the window
+    device_events: List[_attr.DeviceEvent]
+    attributed: List[_attr.Attribution]
+    unattributed: List[_attr.DeviceEvent]
+    outside_window: int
+    decomposition: dict
+    hbm: dict
+    mounted: List[dict]              # span-schema device events (export)
+    trace_path: Optional[str] = None  # kept only with keep_log_dir
+
+    def fields(self) -> dict:
+        """The bench-row stamp form."""
+        return {"device_time_decomposition": self.decomposition,
+                **self.hbm}
+
+
+def profile_window(fn: Callable[[], object], *,
+                   trace_id: Optional[str] = None,
+                   hbm_model_bytes: Optional[int] = None,
+                   log_dir: Optional[str] = None,
+                   keep_log_dir: bool = False,
+                   host_tracer_level: int = 1,
+                   sample_period_s: float = 0.004,
+                   job: str = "device") -> WindowReport:
+    """Run ``fn`` under a scoped profiler capture and return the parsed,
+    attributed, HBM-reconciled report.
+
+    The window is: profiler session -> capture-anchor annotation (whose
+    host wall time joins the clock axes) -> umbrella span -> ``fn`` ->
+    block until all dispatched work completes.  ``host_tracer_level=1``
+    records explicit annotations but not Python frames -- device/op
+    events come from the backend tracer regardless, and a bench capture
+    must not drown in interpreter noise.  Raises :class:`CaptureError`
+    when a capture is already active in this process or the backend
+    produced no parseable trace."""
+    import jax
+
+    if not _ACTIVE.acquire(blocking=False):
+        raise CaptureError("another device capture is active in this "
+                           "process (profiler sessions do not nest)")
+    own_dir = log_dir is None
+    try:
+        log_dir = log_dir or tempfile.mkdtemp(prefix="kntpu-devcap-")
+        capture_id = uuid.uuid4().hex[:10]
+        anchor_name = _attr.CAPTURE_PREFIX + capture_id
+        col = _spans.Collector()
+        _spans.add_sink(col)
+        sampler = HbmSampler(sample_period_s)
+        sampler.start()
+        try:
+            options = None
+            try:  # ProfileOptions moved across jax versions; optional
+                options = jax.profiler.ProfileOptions()
+                options.host_tracer_level = host_tracer_level
+            except Exception:  # noqa: BLE001 -- absent options only lose the tracer-level tweak
+                options = None
+            ctx = (jax.profiler.trace(log_dir, profiler_options=options)
+                   if options is not None else jax.profiler.trace(log_dir))
+            with ctx:
+                anchor_wall = _spans.wall(_spans.now())
+                with jax.profiler.TraceAnnotation(anchor_name), \
+                        _spans.span(WINDOW_SPAN, force=True,
+                                    trace_id=trace_id,
+                                    capture_id=capture_id):
+                    ret = fn()
+                    # trailing async work must land inside the window
+                    (jax.device_put(0.0) + 0).block_until_ready()
+        finally:
+            sampler.stop()
+            _spans.remove_sink(col)
+        trace_path = _trace_file(log_dir)
+        doc = _attr.load_chrome_trace(trace_path)
+        events, outside = _attr.rebase(_attr.chrome_events(doc),
+                                       anchor_wall, capture_id)
+        host = [e for e in col.events if e.get("kind") == "span"]
+        attributed, unattributed = _attr.attribute(events, host)
+        report = WindowReport(
+            capture_id=capture_id, ret=ret, host_events=host,
+            device_events=events, attributed=attributed,
+            unattributed=unattributed, outside_window=outside,
+            decomposition=_attr.decomposition(attributed, unattributed,
+                                              events=events),
+            hbm=hbm_fields(sampler.result(), hbm_model_bytes),
+            mounted=_attr.mount(attributed, job=job),
+            trace_path=trace_path if keep_log_dir else None)
+        return report
+    finally:
+        _ACTIVE.release()
+        if own_dir and not keep_log_dir and log_dir:
+            shutil.rmtree(log_dir, ignore_errors=True)
+
+
+def spill_mounted_from_env(report: WindowReport, tag: str = "") -> Optional[str]:
+    """When ``KNTPU_TRACE_DIR`` is set (whole-run tracing), spill the
+    window's mounted device events beside the host span spills so the
+    merged export shows the device lane -- same env contract as
+    ``spans.start_file_trace_from_env``."""
+    d = os.environ.get("KNTPU_TRACE_DIR", "")
+    if not d or not report.mounted:
+        return None
+    safe = "".join(c if c.isalnum() or c in "-_." else "-"
+                   for c in (tag or "device"))
+    return _attr.write_spill(report.mounted, os.path.join(
+        d, f"trace_{safe}-dev_{os.getpid()}.jsonl"))
+
+
+def bench_capture_fields(fn: Callable[[], object], *,
+                         hbm_model_bytes: Optional[int] = None,
+                         trace_id: Optional[str] = None,
+                         tag: str = "bench") -> dict:
+    """One captured window as bench-row fields; a capture failure stamps
+    a typed error field and NEVER kills the row -- observability must not
+    take the bench down."""
+    try:
+        report = profile_window(fn, trace_id=trace_id,
+                                hbm_model_bytes=hbm_model_bytes)
+        spill_mounted_from_env(report, tag=tag)
+        return report.fields()
+    except Exception as e:  # noqa: BLE001 -- a failed capture is a typed stamp, never a dead bench row
+        return {"device_capture_error": f"{type(e).__name__}: {e}"}
+
+
+def bench_capture_or_skip(fn: Callable[[], object], *,
+                          hbm_model_bytes: Optional[int] = None,
+                          trace_id: Optional[str] = None,
+                          tag: str = "bench",
+                          solve_s: Optional[float] = None) -> dict:
+    """The ONE enabled/skip contract every bench row shares: capture
+    unless BENCH_DEVICE_CAPTURE=0 opts out or the measured ``solve_s``
+    exceeds the BENCH_DEVICE_CAPTURE_MAX_S wall guard (default 180 s --
+    the extra captured solve must not starve a wall budget).  Both
+    skips stamp ``device_capture_skipped``, never silent: the capture
+    harness's verdict distinguishes an opt-out from a missing
+    decomposition by exactly this stamp."""
+    if not bench_capture_enabled():
+        return {"device_capture_skipped": "BENCH_DEVICE_CAPTURE=0"}
+    max_s = float(os.environ.get("BENCH_DEVICE_CAPTURE_MAX_S", "180"))
+    if solve_s is not None and solve_s > max_s:
+        return {"device_capture_skipped":
+                f"solve_s {solve_s:.1f} > BENCH_DEVICE_CAPTURE_MAX_S "
+                f"{max_s:g}"}
+    return bench_capture_fields(fn, hbm_model_bytes=hbm_model_bytes,
+                                trace_id=trace_id, tag=tag)

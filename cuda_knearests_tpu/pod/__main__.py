@@ -201,6 +201,24 @@ def _bench(points_per_chip: int, k: int) -> int:
         : min(2000, n)].astype(np.int32)
     ref_ids, _ = pp._oracle().knn(points[sample], k, exclude_ids=sample)
     recall = set_recall(neighbors[sample], ref_ids)
+    # kntpu-scope stamps (DESIGN.md section 20): one extra captured
+    # solve -- device-time attribution + the measured-HBM verdict against
+    # the pod's own per-chip model (chip_hbm_model high water)
+    from cuda_knearests_tpu.obs import device as _obsdev
+
+    # the shared enabled/skip contract (skips stamped, never silent)
+    cap_fields = _obsdev.bench_capture_or_skip(
+        run, hbm_model_bytes=pp.hbm["hbm_high_water_bytes"],
+        tag=f"pod{ndev}", solve_s=s)
+    # roofline achieved-vs-peak (utils/roofline.py): the pod chip plans
+    # are adaptive class schedules, so the sharded traffic accounting
+    # applies chip-by-chip unchanged
+    from cuda_knearests_tpu.utils.roofline import (roofline_fields,
+                                                   sharded_traffic)
+
+    cap_fields.update(roofline_fields(
+        sharded_traffic(pp), s, jax.devices()[0].platform,
+        n_devices=ndev))
     row = {
         "config": f"pod weak-scaling: {points_per_chip} points/chip over "
                   f"{ndev} chip(s) (k={k}, cell-partitioned)",
@@ -219,7 +237,9 @@ def _bench(points_per_chip: int, k: int) -> int:
         "host_syncs": sync.host_syncs,
         "d2h_bytes": sync.d2h_bytes,
         **_sync_proof("pod-solve", sync.host_syncs),
+        **cap_fields,
         "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
     }
     print(json.dumps(row), flush=True)
     return 0 if row["sync_bound_ok"] and recall >= 0.999 else 1
@@ -244,6 +264,13 @@ def main(argv=None) -> int:
                     help="cap the smoke fixture size (0 = full 20k)")
     args = ap.parse_args(argv)
     _force_devices(max(1, args.devices))
+    # whole-run tracing (KNTPU_TRACE_DIR): this child's host spans spill
+    # beside the device lanes its captures mount, so the merged export
+    # shows pod children as their own (pid, job) process rows
+    from cuda_knearests_tpu.obs import spans as _spans
+
+    _spans.set_process_tag(f"pod:{max(1, args.devices)}dev")
+    _spans.start_file_trace_from_env(f"pod{max(1, args.devices)}")
     if args.bench:
         return _bench(max(1, args.points_per_chip), max(1, args.k))
     return _smoke(args.smoke_n)

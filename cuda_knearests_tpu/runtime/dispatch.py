@@ -263,6 +263,34 @@ def signature(tree: Any, *statics: Any) -> Tuple:
     return leaves + tuple(statics)
 
 
+def executable_profile(exe: Any) -> dict:
+    """Identity + cost census of one AOT-compiled executable: the XLA
+    module name (the join key captured device events carry as
+    ``args.hlo_module``) and ``cost_analysis()`` flops / bytes accessed.
+    Every extraction is best-effort -- backends and jax versions differ on
+    what they expose, and a missing census loses provenance, never a
+    launch."""
+    out: dict = {}
+    try:
+        mods = exe._executable.xla_executable.hlo_modules()
+        if mods:
+            out["module"] = str(mods[0].name)
+    except Exception:  # noqa: BLE001 -- the module-name chain is private API; absence just loses the capture join
+        pass
+    try:
+        cost = exe.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if isinstance(cost, dict):
+            if isinstance(cost.get("flops"), (int, float)):
+                out["flops"] = float(cost["flops"])
+            if isinstance(cost.get("bytes accessed"), (int, float)):
+                out["bytes_accessed"] = float(cost["bytes accessed"])
+    except Exception:  # noqa: BLE001 -- cost analysis is advisory; some backends refuse it
+        pass
+    return out
+
+
 class ExecutableCache:
     """Signature-keyed cache of AOT-compiled executables.
 
@@ -284,6 +312,10 @@ class ExecutableCache:
     an eviction-thrashing cap (more live signatures than entries) is
     visible, not silent."""
 
+    #: compile-log ring bound: enough for every live signature of a
+    #: serving process, bounded for its lifetime.
+    COMPILE_LOG_CAP = 64
+
     def __init__(self, maxsize: int = DEFAULT_EXEC_CACHE_ENTRIES):
         self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._lock = threading.Lock()
@@ -293,6 +325,14 @@ class ExecutableCache:
         self.evictions = 0
         self.enabled = True
         self.disabled_by: Optional[str] = None
+        # compile observability (kntpu-scope, DESIGN.md section 20): per-
+        # build wall seconds + the compiled module's cost census, kept as
+        # a bounded log and aggregate counters; every record also feeds
+        # obs.attribution.MODULE_REGISTRY so captured device events
+        # resolve their hlo_module back to the signature that built it
+        self.compiled = 0
+        self.compile_s_total = 0.0
+        self._compile_log: list = []
 
     def get_or_build(self, key: Tuple, build: Callable[[], Any]):
         """The cached executable for ``key``, building (and caching) on miss.
@@ -306,6 +346,7 @@ class ExecutableCache:
                 self._cache.move_to_end(key)
                 return self._cache[key]
             self.misses += 1
+        t0 = _spans.now()
         try:
             exe = build()
         except Exception as e:  # noqa: BLE001 -- AOT lowering is an optimization; a backend that cannot lower falls back to the jitted path, never fails the query
@@ -320,11 +361,31 @@ class ExecutableCache:
                 f"queries fall back to the jitted path): {self.disabled_by}",
                 RuntimeWarning, stacklevel=2)
             return None
+        t1 = _spans.now()
+        record = {"label": (str(key[0]) if key and isinstance(key[0], str)
+                            else ""),
+                  "compile_s": round(t1 - t0, 6),
+                  **executable_profile(exe)}
         with self._lock:
             self._cache[key] = exe
             while len(self._cache) > self.maxsize:
                 self._cache.popitem(last=False)
                 self.evictions += 1
+            self.compiled += 1
+            self.compile_s_total += t1 - t0
+            self._compile_log.append(record)
+            del self._compile_log[:-self.COMPILE_LOG_CAP]
+        try:  # the hlo_module -> signature join the capture parser reads
+            from ..obs import attribution as _attribution
+
+            _attribution.register_executable(
+                record.get("module"), label=record["label"],
+                compile_s=record["compile_s"],
+                flops=record.get("flops"),
+                bytes_accessed=record.get("bytes_accessed"))
+        except Exception:  # noqa: BLE001 -- the registry is observability; its failure must never fail a launch
+            pass
+        _spans.emit("dispatch.compile", t0, t1, **record)
         return exe
 
     def clear(self) -> None:
@@ -335,6 +396,15 @@ class ExecutableCache:
             self.evictions = 0
             self.enabled = True
             self.disabled_by = None
+            self.compiled = 0
+            self.compile_s_total = 0.0
+            self._compile_log = []
+
+    def compile_records(self) -> list:
+        """The bounded per-build log: label, compile wall seconds, and
+        the compiled module's cost census where the backend exposes it."""
+        with self._lock:
+            return [dict(r) for r in self._compile_log]
 
     def stats_dict(self) -> dict:
         with self._lock:
@@ -342,7 +412,9 @@ class ExecutableCache:
                    "exec_cache_misses": self.misses,
                    "exec_cache_evictions": self.evictions,
                    "exec_cache_size": len(self._cache),
-                   "exec_cache_cap": self.maxsize}
+                   "exec_cache_cap": self.maxsize,
+                   "exec_cache_compiled": self.compiled,
+                   "exec_cache_compile_s": round(self.compile_s_total, 6)}
             if self.disabled_by is not None:
                 out["exec_cache_disabled_by"] = self.disabled_by
             return out

@@ -180,6 +180,7 @@ def _run_job(job: dict) -> dict:
         raise ValueError(f"unknown worker job {job.get('job')!r}")
     row.setdefault("platform", platform)
     row.setdefault("n_devices", len(jax.devices()))
+    row.setdefault("device_kind", jax.devices()[0].device_kind)
     return row
 
 

@@ -462,9 +462,11 @@ class ServeDaemon:
             "fof_requests": self.fof_requests,
             "fof_memo_hits": self.fof_memo_hits,
             "refused": self.refused,
-            # executable-cache pressure (hits/misses/evictions/cap): the
-            # zero-recompile steady state AND eviction thrashing are both
-            # visible per session, not just process-wide
+            # executable-cache pressure (hits/misses/evictions/cap) plus
+            # compile observability (exec_cache_compiled /
+            # exec_cache_compile_s, kntpu-scope): the zero-recompile
+            # steady state, eviction thrashing, AND where compile wall
+            # time went are all visible per session, not just process-wide
             **_dispatch.EXEC_CACHE.stats_dict(),
             "failure_kinds": dict(self.failure_kinds),
             "flushes": dict(self.batcher.flushes),

@@ -1,16 +1,94 @@
-"""Device discovery and property dump.
+"""Device discovery, property dump, and the per-device-kind peaks table.
 
 Reference parity (C12, /root/reference/test_knearests.cu:83-115 printDevProp):
 prints every accelerator visible to JAX with the properties that matter for this
 workload (platform, memory, core counts where exposed), plus process/topology info
 the multi-chip path cares about.
+
+:data:`DEVICE_PEAKS` is the one source of roofline peak constants
+(utils/roofline.py used to hand-enter the v5e HBM number inline): public
+per-device-kind HBM bandwidth and MXU peak FLOP/s, matched by device-kind
+substring with a typed CPU fallback entry.  Every entry carries a
+``basis`` string naming where the number comes from -- a bench row's
+pct-of-peak claim is only as good as its peak's provenance.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+
+#: Public peak table, keyed by a canonical entry name; ``match`` holds
+#: device_kind substrings (lowercased) that select the entry.  HBM GB/s
+#: and bf16 MXU TFLOP/s from the public chip specs
+#: (jax-ml.github.io/scaling-book); the CPU entry is a NOMINAL host
+#: memory figure (4-channel DDR4-3200) so fallback rows still render an
+#: order-of-magnitude roofline -- its basis string says exactly that.
+DEVICE_PEAKS: Dict[str, Dict[str, Any]] = {
+    "tpu-v5e": {"match": ("v5e", "v5 lite", "v5lite"),
+                "hbm_gbps": 819.0, "peak_tflops": 197.0,
+                "flops_precision": "bf16",
+                "basis": "public TPU v5e spec"},
+    "tpu-v5p": {"match": ("v5p",),
+                "hbm_gbps": 2765.0, "peak_tflops": 459.0,
+                "flops_precision": "bf16",
+                "basis": "public TPU v5p spec"},
+    "tpu-v4": {"match": ("v4",),
+               "hbm_gbps": 1228.0, "peak_tflops": 275.0,
+               "flops_precision": "bf16",
+               "basis": "public TPU v4 spec"},
+    "tpu-v3": {"match": ("v3",),
+               "hbm_gbps": 900.0, "peak_tflops": 123.0,
+               "flops_precision": "bf16",
+               "basis": "public TPU v3 spec"},
+    "tpu-v2": {"match": ("v2",),
+               "hbm_gbps": 700.0, "peak_tflops": 46.0,
+               "flops_precision": "bf16",
+               "basis": "public TPU v2 spec"},
+    "cpu": {"match": ("cpu", "host"),
+            "hbm_gbps": 51.2, "peak_tflops": None,
+            "flops_precision": None,
+            "basis": "nominal 4-channel DDR4-3200 host (CPU fallback: "
+                     "order-of-magnitude, not a measured claim)"},
+}
+
+#: Platform fallback when the device kind matches no entry: an unnamed
+#: TPU is assumed v5e (the fleet this repo targets -- stamped
+#: ``assumed`` so the provenance is visible), an unnamed CPU-ish host
+#: takes the nominal CPU entry.
+_PLATFORM_DEFAULT = {"tpu": "tpu-v5e", "cpu": "cpu"}
+
+
+def device_peaks(device_kind: Optional[str] = None,
+                 platform: Optional[str] = None) -> Optional[dict]:
+    """The peaks entry for a device kind (substring match), falling back
+    by platform; None when neither resolves.  The returned dict carries
+    ``entry`` (the table key) and ``assumed=True`` on platform-default
+    fallbacks."""
+    kind = (device_kind or "").lower()
+    if kind:
+        for name, ent in DEVICE_PEAKS.items():
+            if any(m in kind for m in ent["match"]):
+                return {"entry": name,
+                        **{k: v for k, v in ent.items() if k != "match"}}
+    key = _PLATFORM_DEFAULT.get((platform or "").lower())
+    if key is not None:
+        ent = DEVICE_PEAKS[key]
+        return {"entry": key, "assumed": True,
+                **{k: v for k, v in ent.items() if k != "match"}}
+    return None
+
+
+def current_device_kind() -> Tuple[Optional[str], Optional[str]]:
+    """(device_kind, platform) of the default device, or (None, None)
+    when no backend is reachable -- NEVER initializes a backend that
+    is not already safe to touch from the caller's context."""
+    try:
+        d = jax.devices()[0]
+        return str(d.device_kind), str(d.platform)
+    except Exception:  # noqa: BLE001 -- a dark transport must not fail a stamp
+        return None, None
 
 
 def device_properties() -> List[Dict[str, Any]]:

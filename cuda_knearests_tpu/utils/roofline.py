@@ -30,7 +30,10 @@ Traffic model (per steady-state solve call; 4-byte f32/i32 elements):
 - FLOPs: 8 per (query, candidate) pair -- 3 subs, 3 muls, 2 adds
   (knearests.cu:125's accumulation, identical here).
 
-Peaks: TPU v5e HBM = 819 GB/s (public spec, jax-ml.github.io/scaling-book).
+Peaks come from the per-device-kind table in ``utils/devinfo.py``
+(DEVICE_PEAKS): HBM bandwidth and MXU FLOP/s matched by the measured
+device's kind, with a typed nominal CPU fallback entry -- every
+pct-of-peak stamp names its peak's provenance (``roofline_peak_source``).
 VMEM peak bandwidth is not publicly pinned; vmem numbers are reported as
 achieved GB/s only, with no pct-of-peak claim.
 """
@@ -39,7 +42,11 @@ from __future__ import annotations
 
 from typing import Optional
 
-V5E_HBM_GBPS = 819.0
+from .devinfo import DEVICE_PEAKS, current_device_kind, device_peaks
+
+#: Back-compat alias: the old hand-entered constant, now sourced from
+#: the devinfo table (tests and older callers import it from here).
+V5E_HBM_GBPS = DEVICE_PEAKS["tpu-v5e"]["hbm_gbps"]
 
 _BYTES = 4  # f32 coords/dists, i32 ids
 _FLOPS_PER_PAIR = 8
@@ -145,14 +152,18 @@ def sharded_traffic(sp) -> Optional[dict]:
 
 
 def roofline_fields(traffic: Optional[dict], solve_s: float,
-                    platform: str, n_devices: int = 1) -> dict:
+                    platform: str, n_devices: int = 1,
+                    device_kind: Optional[str] = None) -> dict:
     """Bench-row fields from static counts + measured steady-state seconds.
 
-    pct_hbm_roofline only appears on TPU hosts (the peak constant is the
-    v5e spec; a CPU host's memory peak is neither known nor claimed).
-    ``n_devices``: chips the traffic was spread over concurrently -- a
-    sharded solve's aggregate bytes/s compare against n_devices * the
-    single-chip peak, not one chip's."""
+    The peak side resolves from the devinfo DEVICE_PEAKS table by the
+    measured device's kind (probed from the live backend when the probe's
+    platform matches ``platform``; the explicit ``device_kind`` argument
+    wins) with a typed CPU fallback -- every pct-of-peak claim stamps the
+    peak it compared against and that peak's provenance.  ``n_devices``:
+    chips the traffic was spread over concurrently -- a sharded solve's
+    aggregate bytes/s compare against n_devices * the single-chip peak,
+    not one chip's."""
     if not traffic or solve_s <= 0:
         return {}
     out = {
@@ -166,10 +177,31 @@ def roofline_fields(traffic: Optional[dict], solve_s: float,
         out["modeled_vmem_gb"] = round(traffic["vmem"] / 1e9, 4)
         out["achieved_vmem_gbps"] = round(
             traffic["vmem"] / solve_s / 1e9, 2)
-    if platform == "tpu":
+    if device_kind is None:
+        probed_kind, probed_platform = current_device_kind()
+        # only adopt the live probe when it describes the platform this
+        # measurement claims -- a CPU-host probe must not relabel a row
+        # computed for a TPU artifact (and vice versa)
+        if probed_kind is not None and probed_platform == platform:
+            device_kind = probed_kind
+    if device_kind:
+        out["device_kind"] = device_kind
+    peaks = device_peaks(device_kind, platform)
+    if peaks and peaks.get("hbm_gbps"):
         out["pct_hbm_roofline"] = round(
             100.0 * out["achieved_hbm_gbps"]
-            / (V5E_HBM_GBPS * max(1, n_devices)), 2)
+            / (peaks["hbm_gbps"] * max(1, n_devices)), 2)
+        out["roofline_peak_gbps"] = peaks["hbm_gbps"]
+        out["roofline_peak_source"] = (
+            f"{peaks['entry']}"
+            + (" (assumed from platform)" if peaks.get("assumed") else "")
+            + f": {peaks['basis']}")
         if n_devices > 1:
             out["roofline_basis"] = f"aggregate over {n_devices} chips"
+    if peaks and peaks.get("peak_tflops"):
+        out["pct_flops_roofline"] = round(
+            100.0 * (out["achieved_gflops"] / 1e3)
+            / (peaks["peak_tflops"] * max(1, n_devices)), 4)
+        out["roofline_peak_tflops"] = peaks["peak_tflops"]
+        out["roofline_flops_precision"] = peaks.get("flops_precision")
     return out

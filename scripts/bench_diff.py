@@ -15,8 +15,13 @@ trajectory from a pile of files into a gate:
   any kind with ``--tol kind=frac``.
 * **Strict fields**: ``recall`` must not drop by more than 1e-3;
   structural booleans (``slo_ok_all``, ``steady_ok``, ``failover_ok``,
-  ``containment_ok``, ``sync_bound_ok``, ``recall_ok``) must never flip
-  true -> false; a current row carrying ``error`` gates.
+  ``containment_ok``, ``sync_bound_ok``, ``recall_ok``,
+  ``hbm_model_ok``) must never flip true -> false; a current row
+  carrying ``error`` gates.
+* **Observability fields** (kntpu-scope): ``hbm_measured_peak``, the
+  decomposition's ``device_total_ms``, and the roofline fractions each
+  carry their own wide worse-direction band (AUX_FIELD_TOLERANCE) --
+  step changes gate, host noise does not.
 * **Typed verdict rows**: one JSON line per comparison
   (``verdict`` in {ok, improved, regressed, errored, missing, new}) plus
   one summary line; rc 0 iff nothing gated.
@@ -51,10 +56,36 @@ KIND_TOLERANCE = {
 }
 
 #: Structural booleans that must never flip true -> false.
+#: ``hbm_model_ok`` (kntpu-scope) is strict: a measured-HBM verdict
+#: flipping false means the preflight model now UNDERESTIMATES the chip
+#: -- the exact failure that blesses a would-OOM launch.
 STRICT_BOOLS = ("slo_ok_all", "steady_ok", "failover_ok",
-                "containment_ok", "sync_bound_ok", "recall_ok")
+                "containment_ok", "sync_bound_ok", "recall_ok",
+                "hbm_model_ok")
 
 RECALL_EPS = 1e-3
+
+#: kntpu-scope observability fields: field -> (tolerated fractional move
+#: in the WORSE direction, which direction is worse).  Device time and
+#: memory peaks breathe with the host far more than throughput does, so
+#: the bands are deliberately wide -- these catch step changes (a 2x
+#: memory regression, a halved roofline fraction), not noise.
+AUX_FIELD_TOLERANCE = {
+    "hbm_measured_peak": (0.5, "higher"),     # peak bytes may grow <= 50%
+    "device_total_ms": (1.0, "higher"),       # device time may grow <= 2x
+    "pct_hbm_roofline": (0.5, "lower"),       # roofline frac may halve
+    "pct_flops_roofline": (0.5, "lower"),
+}
+
+
+def _aux_value(row: dict, field: str):
+    """An observability field's numeric value (device_total_ms lives
+    inside the nested device_time_decomposition stamp)."""
+    if field == "device_total_ms":
+        deco = row.get("device_time_decomposition")
+        return deco.get("device_total_ms") if isinstance(deco, dict) \
+            else None
+    return row.get(field)
 
 
 def row_key(row: dict) -> Optional[str]:
@@ -181,6 +212,19 @@ def compare_row(key: str, base: dict, cur: dict,
                 gate(flag, f"baseline true, current {cur.get(flag)!r}")
             else:
                 passed(flag)
+
+    for field, (frac, worse) in AUX_FIELD_TOLERANCE.items():
+        bv2, cv2 = _aux_value(base, field), _aux_value(cur, field)
+        if not (isinstance(bv2, (int, float))
+                and isinstance(cv2, (int, float)) and bv2 > 0):
+            continue
+        ratio = cv2 / bv2
+        if worse == "higher" and ratio > 1.0 + frac:
+            gate(field, f"{cv2:g} > {bv2:g} * (1 + {frac:g})")
+        elif worse == "lower" and ratio < 1.0 - frac:
+            gate(field, f"{cv2:g} < {bv2:g} * (1 - {frac:g})")
+        else:
+            passed(field)
     return verdict
 
 

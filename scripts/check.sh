@@ -217,7 +217,18 @@ done
 # the merged Perfetto trace + one metrics snapshot as artifacts (CI
 # uploads ${KNTPU_OBS_DIR}).
 echo "== obs smoke (span schema + disabled-overhead bound + Perfetto export, CPU-only) =="
-JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.obs \
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.obs --stage host \
+    --out-dir "${KNTPU_OBS_DIR:-/tmp/kntpu-obs}" || rc=1
+
+# Obs-device smoke (DESIGN.md section 20, kntpu-scope): capture one solve
+# under the REAL jax.profiler on the CPU backend, attribute every
+# executable event to host spans / named scopes / signatures (zero
+# unattributed asserted), reconcile the measured-HBM peak against the
+# engine's own model (hbm_model_ok), mount the device lane into the same
+# merged Perfetto trace, and bound the capture-off fast path <2% like
+# the PR 12 disabled-span gate.
+echo "== obs-device smoke (profiler capture -> attribute -> join round trip, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.obs --stage device \
     --out-dir "${KNTPU_OBS_DIR:-/tmp/kntpu-obs}" || rc=1
 
 # Bench regression gate (DESIGN.md section 19): the committed BENCH

@@ -1,69 +1,33 @@
-"""One-off TPU profiling: adaptive vs legacy solve on the 900k north star.
+"""DEPRECATED shim: profiling is owned by the kntpu-scope harness now.
 
-Run on the live chip:  python scripts/profile_tpu.py
+This script predates the observability stack: it hand-timed four solve
+configs with ad-hoc wall clocks and no capture, attribution, or
+artifact discipline.  There is exactly ONE way to capture now
+(DESIGN.md section 20):
+
+    python scripts/tpu_watch.py --capture
+
+which runs the pod weak-scaling ladder + the north star under
+programmatic ``jax.profiler`` capture, attributes device time to
+executable signatures and named scopes, validates the measured-HBM
+model, merges one host+device Perfetto timeline, and banks (or, on
+CPU/forced-host, provably refuses to bank) a provenance-complete
+record.  This shim forwards there so old muscle memory still lands on
+the one capture path.
 """
-import dataclasses
 import os
 import sys
-import time
 
-sys.path.insert(0, os.getcwd())  # PYTHONPATH breaks axon plugin discovery
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-
-from cuda_knearests_tpu.utils.platform import enable_compile_cache
-
-enable_compile_cache()  # remote-tunnel compiles persist across runs
-import numpy as np
-
-from cuda_knearests_tpu import KnnConfig, KnnProblem
-from cuda_knearests_tpu.io import get_dataset
+import tpu_watch  # noqa: E402
 
 
-def steady(fn, iters=5):
-    fn()  # warmup/compile
-    ts = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        fn()
-        ts.append(time.perf_counter() - t0)
-    return min(ts)
-
-
-def run(tag, cfg, points):
-    t0 = time.perf_counter()
-    p = KnnProblem.prepare(points, cfg)
-    jax.block_until_ready(jax.tree_util.tree_leaves(
-        (p.grid.points, p.aplan, p.plan)))
-    prep_s = time.perf_counter() - t0
-
-    def s():
-        res = p.solve()
-        jax.block_until_ready((res.neighbors, res.dists_sq, res.certified))
-
-    sol = steady(s)
-    n = points.shape[0]
-    extra = ""
-    if p.aplan is not None:
-        extra = " classes=" + ",".join(
-            f"{c.route}(r={c.radius},Sc={c.n_sc},q={c.qcap_pad},c={c.ccap})"
-            for c in p.aplan.classes)
-    cert = float(np.asarray(p.result.certified).mean())
-    print(f"{tag}: prepare {prep_s:.3f}s solve {sol * 1e3:.1f}ms "
-          f"qps {n / sol / 1e6:.3f}M cert {cert:.4f}{extra}", flush=True)
-
-
-def main():
-    points = get_dataset("900k_blue_cube.xyz")
-    print(f"platform={jax.devices()[0].platform} n={points.shape[0]}",
-          flush=True)
-    base = KnnConfig(k=10)
-    run("adaptive sc3 (default)", base, points)
-    run("legacy   sc3", dataclasses.replace(base, adaptive=False), points)
-    run("legacy   sc4", dataclasses.replace(base, adaptive=False, supercell=4),
-        points)
-    run("adaptive sc4", dataclasses.replace(base, supercell=4), points)
+def main() -> int:
+    print("[profile_tpu] DEPRECATED: consolidated onto the kntpu-scope "
+          "capture harness -- running `tpu_watch --capture`", flush=True)
+    return tpu_watch.main(["--capture", *sys.argv[1:]])
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,91 +1,32 @@
-"""Collect the full TPU perf record in one run (post-layout-fix matrix).
+"""DEPRECATED shim: record collection is owned by kntpu-scope now.
 
-Run on a live chip: python scripts/tpu_record.py [--quick]
-Prints one labeled line per measurement; safe to rerun (bounded time).
+This script predates the observability stack: it printed hand-timed
+one-line measurements with no rc-stamped artifacts, no platform
+discipline, and no device-time attribution.  There is exactly ONE way
+to capture now (DESIGN.md section 20):
+
+    python scripts/tpu_watch.py --capture
+
+which runs the pod weak-scaling ladder + the north star under
+programmatic ``jax.profiler`` capture, verifies every ``_artifact_good``
+stamp plus the device-time decomposition, and banks (or, on CPU/forced-
+host, provably refuses to bank) a provenance-complete record.  This
+shim forwards there so old muscle memory still lands on the one
+capture path.
 """
-import argparse
 import os
 import sys
-import time
 
-sys.path.insert(0, os.getcwd())  # PYTHONPATH breaks axon plugin discovery
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-
-from cuda_knearests_tpu.utils.platform import enable_compile_cache
-
-enable_compile_cache()  # remote-tunnel compiles persist across runs
-import numpy as np
-
-from cuda_knearests_tpu import KnnConfig, KnnProblem
-from cuda_knearests_tpu.io import get_dataset, generate_uniform
-from cuda_knearests_tpu.parallel.sharded import ShardedKnnProblem
+import tpu_watch  # noqa: E402
 
 
-def steady(fn, iters=5):
-    fn()
-    ts = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        fn()
-        ts.append(time.perf_counter() - t0)
-    return min(ts)
-
-
-def single(tag, points, cfg):
-    p = KnnProblem.prepare(points, cfg)
-
-    def s():
-        r = p.solve()
-        jax.block_until_ready((r.neighbors, r.dists_sq, r.certified))
-
-    t = steady(s)
-    n = points.shape[0]
-    cert = float(np.asarray(p.result.certified).mean())
-    print(f"{tag}: {t * 1e3:.1f}ms {n / t / 1e6:.3f}M q/s cert={cert:.4f}",
-          flush=True)
-    return p
-
-
-def sharded(tag, points, ndev, cfg, iters=3):
-    sp = ShardedKnnProblem.prepare(points, n_devices=ndev, config=cfg)
-
-    def s():
-        jax.block_until_ready(sp.solve_device())
-
-    t = steady(s, iters)
-    n = points.shape[0]
-    print(f"{tag}: {t * 1e3:.1f}ms {n / t / 1e6:.3f}M q/s total "
-          f"({n / t / ndev / 1e6:.3f}M/chip)", flush=True)
-
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true",
-                    help="north star + 10M sharded only")
-    args = ap.parse_args()
-    print(f"platform={jax.devices()[0].platform}", flush=True)
-
-    blue = get_dataset("900k_blue_cube.xyz")
-    single("north star 900k k=10", blue, KnnConfig(k=10))
-    sharded("sharded 10M k=10 ndev=1", generate_uniform(10_000_000, seed=10),
-            1, KnnConfig(k=10))
-    if args.quick:
-        return
-    single("blue 900k k=20", blue, KnnConfig(k=20))
-    p300 = get_dataset("pts300K.xyz")
-    single("grid 300k k=10", p300, KnnConfig(k=10))
-    single("batched 300k k=50", p300, KnnConfig(k=50))
-    # clustered fixture on the kernel path (VERDICT r2 weak #6: stays within
-    # ~2x of uniform throughput, no global demotion)
-    rng = np.random.default_rng(5)
-    cl = np.clip(np.concatenate([
-        450.0 + 40.0 * rng.standard_normal((800_000, 3)),
-        rng.random((100_000, 3)) * 1000.0]), 0.0, 1000.0).astype(np.float32)
-    p = single("clustered 900k k=10", cl, KnnConfig(k=10))
-    print("  classes:", [(c.route, c.radius, c.n_sc, c.qcap_pad, c.ccap)
-                         for c in p.aplan.classes], flush=True)
+def main() -> int:
+    print("[tpu_record] DEPRECATED: consolidated onto the kntpu-scope "
+          "capture harness -- running `tpu_watch --capture`", flush=True)
+    return tpu_watch.main(["--capture", *sys.argv[1:]])
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

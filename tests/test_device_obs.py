@@ -1,0 +1,480 @@
+"""kntpu-scope (ISSUE 15): device-time attribution, measured-HBM
+validation, compile observability, and the capture harness.
+
+The acceptance pins live here: a CPU-backend capture of a 20k solve
+yields device events that ALL attribute to exactly one host span (zero
+unattributed asserted), the ``kntpu:*`` named scopes and executable
+signatures resolve, the measured-HBM verdict is a true ``hbm_model_ok``
+against the engine's own model, bench rows stamp the decomposition, and
+the bench_diff gate treats ``hbm_model_ok`` as a strict structural
+boolean.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu.obs import attribution as attr
+from cuda_knearests_tpu.obs import device as obs_device
+from cuda_knearests_tpu.obs import spans as obs_spans
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- pure parsing / attribution units (no jax) --------------------------------
+
+def _span_ev(name, t0, dur_ms, depth=0, parent="", trace_id=None):
+    return {"v": obs_spans.SCHEMA, "kind": "span", "name": name,
+            "t0": t0, "dur_ms": dur_ms, "depth": depth, "parent": parent,
+            "pid": 1, "job": "t", "tid": "main", "trace_id": trace_id,
+            "attrs": {}}
+
+
+def test_rebase_maps_profiler_axis_onto_wall_and_filters_window():
+    cap_id = "abc123"
+    raw = [
+        {"ph": "X", "ts": 1000.0, "dur": 5000.0, "pid": 7, "tid": "t1",
+         "name": attr.CAPTURE_PREFIX + cap_id},
+        {"ph": "X", "ts": 2000.0, "dur": 100.0, "pid": 7, "tid": "t2",
+         "name": "fusion.1", "args": {"hlo_module": "jit_f",
+                                      "hlo_op": "fusion.1"}},
+        # pre-window exec event (midpoint far before the anchor): dropped
+        {"ph": "X", "ts": -200000.0, "dur": 10.0, "pid": 7, "tid": "t2",
+         "name": "fusion.0", "args": {"hlo_module": "jit_old",
+                                      "hlo_op": "fusion.0"}},
+        # exporter-split annotation: short name + args.long_name
+        {"ph": "X", "ts": 1500.0, "dur": 1000.0, "pid": 7, "tid": "t1",
+         "name": "solve", "args": {"long_name": "kntpu:solve"}},
+    ]
+    anchor_wall = 100.0
+    events, outside = attr.rebase(raw, anchor_wall, cap_id)
+    assert outside == 1
+    by_kind = {ev.kind: ev for ev in events}
+    ex = by_kind["exec"]
+    # the exec event started 1ms after the anchor -> wall 100.001
+    assert ex.t0 == pytest.approx(100.001)
+    assert ex.hlo_module == "jit_f" and ex.hlo_op == "fusion.1"
+    assert by_kind["scope"].name == "kntpu:solve"
+    assert by_kind["anchor"].name == attr.CAPTURE_PREFIX + cap_id
+
+
+def test_rebase_without_anchor_raises():
+    with pytest.raises(ValueError, match="capture anchor"):
+        attr.rebase([{"ph": "X", "ts": 0.0, "dur": 1.0, "name": "x"}],
+                    0.0, "missing")
+
+
+def test_attribute_picks_deepest_span_and_launch_order_scope():
+    cap_id = "zz"
+    raw = [
+        {"ph": "X", "ts": 0.0, "dur": 1_000_000.0, "name":
+         attr.CAPTURE_PREFIX + cap_id},
+        # host-side launch of jit_f inside the named scope
+        {"ph": "X", "ts": 10_000.0, "dur": 5_000.0,
+         "name": "kntpu:my-phase"},
+        {"ph": "X", "ts": 11_000.0, "dur": 1_000.0,
+         "name": "PjitFunction(f)"},
+        # the compute runs AFTER the scope closed (async dispatch)
+        {"ph": "X", "ts": 40_000.0, "dur": 10_000.0, "name": "fusion",
+         "args": {"hlo_module": "jit_f", "hlo_op": "fusion"}},
+    ]
+    events, _ = attr.rebase(raw, 50.0, cap_id)
+    host = [_span_ev("outer", 49.9, 2000.0, depth=0, trace_id="r-9"),
+            _span_ev("inner", 50.0, 1000.0, depth=1, parent="outer")]
+    attributed, unattributed = attr.attribute(events, host)
+    assert not unattributed
+    (a,) = attributed
+    assert a.span_name == "inner"          # deepest containing span
+    assert a.trace_id is None or a.trace_id == host[1].get("trace_id")
+    assert a.scope == "kntpu:my-phase"     # via the launch-order join
+    deco = attr.decomposition(attributed, unattributed)
+    assert deco["unattributed"] == 0 and deco["events"] == 1
+    assert deco["by_module"] == {"jit_f": pytest.approx(10.0)}
+    assert deco["by_scope"] == {"kntpu:my-phase": pytest.approx(10.0)}
+
+
+def test_attribute_reports_uncovered_events():
+    cap_id = "qq"
+    raw = [
+        {"ph": "X", "ts": 0.0, "dur": 1_000_000.0,
+         "name": attr.CAPTURE_PREFIX + cap_id},
+        {"ph": "X", "ts": 500.0, "dur": 10.0, "name": "fusion",
+         "args": {"hlo_module": "jit_g"}},
+    ]
+    events, _ = attr.rebase(raw, 10.0, cap_id)
+    attributed, unattributed = attr.attribute(events, [])   # no spans
+    assert not attributed and len(unattributed) == 1
+
+
+def test_module_registry_roundtrip():
+    attr.register_executable("jit_test_mod", label="ops.test",
+                             compile_s=0.5, flops=1e9,
+                             bytes_accessed=2e6)
+    info = attr.executable_info("jit_test_mod")
+    assert info["label"] == "ops.test" and info["flops"] == 1e9
+    assert attr.executable_info("nope") is None
+    assert attr.executable_info(None) is None
+
+
+def test_mount_events_validate_against_span_schema(tmp_path):
+    ev = attr.DeviceEvent(name="fusion", t0=5.0, dur_ms=1.0, pid=3,
+                          tid="9", kind="exec", hlo_module="jit_m",
+                          hlo_op="fusion")
+    a = attr.Attribution(event=ev, span_name="knn.solve", span_depth=1,
+                         trace_id="r-1", scope="kntpu:s",
+                         signature={"label": "lbl"})
+    mounted = attr.mount([a])
+    assert len(mounted) == 1
+    assert obs_spans.validate_event(mounted[0]) is None
+    m = mounted[0]
+    assert m["parent"] == "knn.solve" and m["depth"] == 2
+    assert m["tid"] == "device:9" and m["trace_id"] == "r-1"
+    assert m["attrs"]["hlo_module"] == "jit_m"
+    assert m["attrs"]["signature"] == "lbl"
+    path = attr.write_spill(mounted, str(tmp_path / "trace_dev_1.jsonl"))
+    assert json.loads(open(path).read().splitlines()[0])["name"] == "fusion"
+
+
+# -- the measured-HBM verdict law ---------------------------------------------
+
+def test_hbm_verdict_law():
+    sample = {"peak": 1_500, "floor": 1_000, "samples": 5,
+              "source": "live_arrays"}
+    ok = obs_device.hbm_fields(sample, model_bytes=1_000)
+    assert ok["hbm_model_ok"] is True                  # 500 <= 1000*1.25
+    assert ok["hbm_window_delta_bytes"] == 500
+    bad = obs_device.hbm_fields(sample, model_bytes=300)
+    assert bad["hbm_model_ok"] is False                # 500 > 300*1.25
+    assert "underestimate" in bad["hbm_model_verdict"]
+    vac = obs_device.hbm_fields(sample, model_bytes=None)
+    assert vac["hbm_model_ok"] is True and "hbm_model_note" in vac
+
+
+def test_hbm_sampler_reads_something_on_cpu():
+    s = obs_device.HbmSampler(period_s=0.002)
+    s.start()
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 1024))           # a live device buffer
+    x.block_until_ready()
+    res = s.stop().result()
+    assert res["samples"] >= 2
+    assert res["source"] in ("memory_stats", "live_arrays")
+    assert res["peak"] >= res["floor"] >= 0
+    del x
+
+
+def test_problem_hbm_model_routes(pts20k):
+    from cuda_knearests_tpu import KnnConfig, KnnProblem
+
+    pts = np.ascontiguousarray(pts20k[:4000])
+    adaptive = KnnProblem.prepare(pts, KnnConfig(k=8))
+    assert obs_device.problem_hbm_model(adaptive) > 0
+    legacy = KnnProblem.prepare(pts, KnnConfig(k=8, adaptive=False))
+    assert obs_device.problem_hbm_model(legacy) > 0
+    from cuda_knearests_tpu.oracle import native_available
+
+    if native_available():
+        oracle = KnnProblem.prepare(pts, KnnConfig(k=8, backend="oracle"))
+        assert obs_device.problem_hbm_model(oracle) is None
+
+
+# -- the capture -> parse -> join round trip (the acceptance pin) -------------
+
+def test_capture_roundtrip_20k_zero_unattributed(pts20k):
+    """ISSUE 15 acceptance: a captured 20k solve on the CPU backend
+    profiler yields executable events that ALL attribute to exactly one
+    host span, with the kntpu named scope resolved, a true hbm_model_ok
+    against the engine's own model, and mounted events that merge into
+    the same Perfetto timeline as the host spans."""
+    import jax
+
+    from cuda_knearests_tpu import KnnConfig, KnnProblem
+
+    problem = KnnProblem.prepare(pts20k, KnnConfig(k=8))
+
+    def run():
+        res = problem.solve()
+        jax.block_until_ready((res.neighbors, res.dists_sq,
+                               res.certified))
+
+    run()  # warmup: capture a steady-state solve like the bench does
+    report = obs_device.profile_window(
+        run, trace_id="cap-1",
+        hbm_model_bytes=obs_device.problem_hbm_model(problem))
+    assert report.attributed, "no executable events captured"
+    assert report.unattributed == [], \
+        [e.name for e in report.unattributed[:5]]
+    deco = report.decomposition
+    assert deco["unattributed"] == 0
+    assert deco["device_total_ms"] > 0
+    assert any(m.startswith("jit_") for m in deco["by_module"])
+    assert any(s.startswith("kntpu:") for s in deco["by_scope"]), \
+        deco["by_scope"]
+    # every attributed event names exactly one span, all schema-valid
+    assert all(a.span_name for a in report.attributed)
+    assert all(obs_spans.validate_event(ev) is None
+               for ev in report.mounted)
+    # the measured-HBM verdict: model dominates the window growth
+    assert report.hbm["hbm_model_ok"] is True, report.hbm
+    assert report.hbm["hbm_measured_peak"] >= 0
+    assert report.hbm["hbm_samples"] >= 2
+
+
+def test_capture_merges_host_and_device_into_one_timeline(tmp_path):
+    import jax
+
+    from cuda_knearests_tpu import KnnConfig, KnnProblem
+    from cuda_knearests_tpu.io import generate_uniform
+    from cuda_knearests_tpu.obs import export as obs_export
+
+    pts = generate_uniform(3000, seed=9)
+    problem = KnnProblem.prepare(pts, KnnConfig(k=6))
+
+    def run():
+        res = problem.solve()
+        jax.block_until_ready((res.neighbors, res.dists_sq,
+                               res.certified))
+
+    run()
+    # host spans spill like any traced process; the device lane mounts
+    # beside them; export merges both with zero special-casing
+    sink = obs_spans.start_file_trace(
+        str(tmp_path / f"trace_host_{os.getpid()}.jsonl"))
+    try:
+        report = obs_device.profile_window(run, trace_id="merge-1")
+    finally:
+        sink.close()
+    attr.write_spill(report.mounted,
+                     str(tmp_path / f"trace_dev_{os.getpid()}.jsonl"))
+    summary = obs_export.export_dir(str(tmp_path),
+                                    str(tmp_path / "merged.json"))
+    assert summary["files"] == 2 and summary["events"] > 0
+    chrome = json.load(open(tmp_path / "merged.json"))
+    tids = {str(e.get("tid")) for e in chrome["traceEvents"]
+            if e.get("ph") == "X"}
+    assert any(t.startswith("device:") for t in tids), tids
+    assert any(not t.startswith("device:") for t in tids), tids
+
+
+def test_capture_env_spill(tmp_path, monkeypatch):
+    monkeypatch.setenv("KNTPU_TRACE_DIR", str(tmp_path))
+    ev = attr.DeviceEvent(name="f", t0=1.0, dur_ms=1.0, pid=2, tid="1",
+                          kind="exec", hlo_module="jit_m")
+    a = attr.Attribution(event=ev, span_name="s", span_depth=0,
+                         trace_id=None, scope=None, signature=None)
+    report = obs_device.WindowReport(
+        capture_id="x", ret=None, host_events=[], device_events=[ev],
+        attributed=[a], unattributed=[], outside_window=0,
+        decomposition={}, hbm={}, mounted=attr.mount([a]))
+    path = obs_device.spill_mounted_from_env(report, tag="t")
+    assert path and os.path.basename(path).startswith("trace_t-dev_")
+    monkeypatch.delenv("KNTPU_TRACE_DIR")
+    assert obs_device.spill_mounted_from_env(report) is None
+
+
+# -- compile observability (ExecutableCache) ----------------------------------
+
+def test_exec_cache_records_compile_time_and_cost(monkeypatch):
+    from cuda_knearests_tpu.runtime import dispatch as _dispatch
+
+    cache = _dispatch.ExecutableCache(maxsize=4)
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return (x * x + 1.0).sum()
+
+    x = jnp.ones((128, 128))
+    built = cache.get_or_build(
+        ("test.f",) + _dispatch.signature((x,)),
+        lambda: jax.jit(f).lower(x).compile())
+    assert built is not None
+    recs = cache.compile_records()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["label"] == "test.f" and rec["compile_s"] > 0
+    # the CPU backend exposes both the module name and the cost census
+    assert rec.get("module", "").startswith("jit_")
+    assert rec.get("flops", 0) > 0
+    stats = cache.stats_dict()
+    assert stats["exec_cache_compiled"] == 1
+    assert stats["exec_cache_compile_s"] > 0
+    # the registry join the capture parser reads
+    info = attr.executable_info(rec["module"])
+    assert info and info["label"] == "test.f"
+    assert info["compile_s"] == rec["compile_s"]
+    cache.clear()
+    assert cache.stats_dict()["exec_cache_compiled"] == 0
+    assert cache.compile_records() == []
+
+
+def test_exec_cache_compile_log_stays_bounded():
+    from cuda_knearests_tpu.runtime import dispatch as _dispatch
+
+    cache = _dispatch.ExecutableCache(maxsize=256)
+    for i in range(cache.COMPILE_LOG_CAP + 8):
+        cache.get_or_build((f"k{i}",), lambda: object())
+    assert len(cache.compile_records()) == cache.COMPILE_LOG_CAP
+    assert cache.stats_dict()["exec_cache_compiled"] \
+        == cache.COMPILE_LOG_CAP + 8
+
+
+# -- devinfo peaks table ------------------------------------------------------
+
+def test_device_peaks_table_lookup():
+    from cuda_knearests_tpu.utils.devinfo import device_peaks
+
+    v5e = device_peaks("TPU v5 lite")
+    assert v5e["entry"] == "tpu-v5e" and v5e["hbm_gbps"] == 819.0
+    assert "assumed" not in v5e
+    v4 = device_peaks("TPU v4")
+    assert v4["entry"] == "tpu-v4" and v4["peak_tflops"] == 275.0
+    cpu = device_peaks("cpu")
+    assert cpu["entry"] == "cpu" and cpu["peak_tflops"] is None
+    assert "nominal" in cpu["basis"]
+    # platform fallback: unnamed TPU assumes v5e, stamped assumed
+    unk = device_peaks("weird-kind", platform="tpu")
+    assert unk["entry"] == "tpu-v5e" and unk["assumed"] is True
+    assert device_peaks("weird-kind", platform="rocm") is None
+    assert device_peaks(None, platform=None) is None
+
+
+# -- bench rows stamp the kntpu-scope fields ----------------------------------
+
+def _load_bench():
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    return bench
+
+
+def test_bench_north_star_row_stamps_capture_fields(monkeypatch):
+    """ISSUE 15 acceptance: the kNN bench row stamps
+    device_time_decomposition, hbm_measured_peak, and a true
+    hbm_model_ok on the CPU backend."""
+    monkeypatch.setenv("BENCH_NORTH_N", "3000")
+    monkeypatch.setenv("BENCH_ORACLE_SAMPLE", "400")
+    monkeypatch.setenv("BENCH_BRUTE_SAMPLE", "200")
+    bench = _load_bench()
+    row = bench.bench_north_star()
+    assert row["hbm_model_ok"] is True, row
+    assert isinstance(row["hbm_measured_peak"], int)
+    deco = row["device_time_decomposition"]
+    assert isinstance(deco, dict) and deco["unattributed"] == 0
+    # oracle rows execute no device program; engine rows must attribute
+    if row["backend"] != "oracle":
+        assert deco["events"] > 0 and deco["device_total_ms"] > 0
+
+
+def test_bench_capture_disabled_is_stamped(monkeypatch, pts20k):
+    monkeypatch.setenv("BENCH_DEVICE_CAPTURE", "0")
+    bench = _load_bench()
+    from cuda_knearests_tpu import KnnConfig, KnnProblem
+
+    problem = KnnProblem.prepare(
+        np.ascontiguousarray(pts20k[:2000]), KnnConfig(k=6))
+    fields = bench._device_capture_fields(problem, solve_s=0.1)
+    assert fields == {"device_capture_skipped": "BENCH_DEVICE_CAPTURE=0"}
+    monkeypatch.delenv("BENCH_DEVICE_CAPTURE")
+    monkeypatch.setenv("BENCH_DEVICE_CAPTURE_MAX_S", "5")
+    fields = bench._device_capture_fields(problem, solve_s=50.0)
+    assert "device_capture_skipped" in fields
+    assert "BENCH_DEVICE_CAPTURE_MAX_S" in fields["device_capture_skipped"]
+
+
+@pytest.mark.slow
+def test_pod_bench_row_stamps_capture_fields():
+    """The pod weak-scaling child stamps the decomposition + the
+    measured-HBM verdict against chip_hbm_model (forced host devices)."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "cuda_knearests_tpu.pod", "--bench",
+         "--devices", "2", "--points-per-chip", "1500", "--k", "8"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    row = json.loads([ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert row["hbm_model_ok"] is True, row
+    assert row["device_time_decomposition"]["unattributed"] == 0
+    assert row["device_time_decomposition"]["events"] > 0
+    assert row["device_kind"]
+
+
+# -- roofline: table-driven peaks ---------------------------------------------
+
+def test_roofline_stamps_peak_provenance_and_flops_pct():
+    from cuda_knearests_tpu.utils.roofline import roofline_fields
+
+    t = {"hbm_total": 8.19e9, "flops": 1.97e14, "vmem": 0,
+         "hbm_read": 0, "hbm_write": 0, "pairs": 0}
+    tpu = roofline_fields(t, 1.0, "tpu", device_kind="TPU v5e")
+    assert tpu["pct_hbm_roofline"] == pytest.approx(100 * 8.19 / 819.0)
+    assert tpu["roofline_peak_gbps"] == 819.0
+    assert "tpu-v5e" in tpu["roofline_peak_source"]
+    # 1.97e14 flops in 1 s = 197 TFLOP/s = exactly the v5e bf16 peak
+    assert tpu["pct_flops_roofline"] == pytest.approx(100.0)
+    assert tpu["device_kind"] == "TPU v5e"
+    v4 = roofline_fields(t, 1.0, "tpu", device_kind="TPU v4")
+    assert v4["roofline_peak_gbps"] == 1228.0
+    # CPU fallback: pct rendered against the NOMINAL entry, provenance
+    # stamped -- no silent claim
+    cpu = roofline_fields(t, 1.0, "cpu", device_kind="cpu")
+    assert "nominal" in cpu["roofline_peak_source"]
+    assert "pct_flops_roofline" not in cpu     # no CPU FLOP peak claimed
+
+
+# -- bench_diff: strict hbm_model_ok + observability tolerances ---------------
+
+def _load_bench_diff():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO, "scripts", "bench_diff.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_diff_gates_hbm_model_ok_flip_and_aux_fields():
+    bd = _load_bench_diff()
+    base = {"config": "row", "value": 100.0, "hbm_model_ok": True,
+            "hbm_measured_peak": 1000, "pct_hbm_roofline": 40.0,
+            "device_time_decomposition": {"device_total_ms": 10.0}}
+    assert "hbm_model_ok" in bd.STRICT_BOOLS
+    v = bd.compare_row("row", base, dict(base, hbm_model_ok=False),
+                       {"engine": 0.2})
+    assert v["verdict"] == "regressed"
+    # memory peak doubling gates; +20% passes
+    v = bd.compare_row("row", base, dict(base, hbm_measured_peak=2000),
+                       {"engine": 0.2})
+    assert v["verdict"] == "regressed"
+    v = bd.compare_row("row", base, dict(base, hbm_measured_peak=1200),
+                       {"engine": 0.2})
+    assert v["verdict"] == "ok"
+    # roofline fraction halving-and-more gates
+    v = bd.compare_row("row", base, dict(base, pct_hbm_roofline=10.0),
+                       {"engine": 0.2})
+    assert v["verdict"] == "regressed"
+    # device time 3x gates, 1.5x passes
+    v = bd.compare_row(
+        "row", base,
+        dict(base, device_time_decomposition={"device_total_ms": 30.0}),
+        {"engine": 0.2})
+    assert v["verdict"] == "regressed"
+    v = bd.compare_row(
+        "row", base,
+        dict(base, device_time_decomposition={"device_total_ms": 15.0}),
+        {"engine": 0.2})
+    assert v["verdict"] == "ok"
+    # the self-test's seeded regression now also trips the new strict bool
+    seeded = bd.seed_regression({"row": base})
+    assert seeded["row"]["hbm_model_ok"] is False

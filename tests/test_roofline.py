@@ -62,11 +62,20 @@ def test_roofline_fields_gates():
          "hbm_read": 0, "hbm_write": 0, "pairs": 0}
     on_tpu = roofline_fields(t, 1.0, "tpu")
     assert on_tpu["achieved_hbm_gbps"] == pytest.approx(8.19)
+    # an unnamed TPU assumes the v5e entry -- stamped as assumed in the
+    # peak provenance (devinfo.DEVICE_PEAKS), same math as the old
+    # hand-entered constant
     assert on_tpu["pct_hbm_roofline"] == pytest.approx(
         100 * 8.19 / V5E_HBM_GBPS)
+    assert "assumed" in on_tpu["roofline_peak_source"]
     assert on_tpu["achieved_vmem_gbps"] == pytest.approx(2.0)
+    assert on_tpu["roofline_flops_precision"] == "bf16"
     on_cpu = roofline_fields(t, 1.0, "cpu")
-    assert "pct_hbm_roofline" not in on_cpu  # no CPU peak is claimed
+    # the CPU fallback renders pct against the table's NOMINAL host
+    # entry -- provenance stamped, never a silent hardware claim
+    assert "pct_hbm_roofline" in on_cpu
+    assert "nominal" in on_cpu["roofline_peak_source"]
+    assert "pct_flops_roofline" not in on_cpu  # no CPU FLOP peak claimed
     assert roofline_fields(None, 1.0, "tpu") == {}
     assert roofline_fields(t, 0.0, "tpu") == {}
 

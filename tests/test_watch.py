@@ -199,6 +199,198 @@ def test_artifact_good_pod_row_kind(tmp_path):
     assert not tpu_watch._artifact_good(str(p))
 
 
+# -- kntpu-scope capture harness (ISSUE 15) -----------------------------------
+
+def _capture_row(platform="tpu", **over):
+    row = {"platform": platform, "unit": "queries/sec", "value": 1.0,
+           "recall": 1.0,
+           "device_time_decomposition": {"device_total_ms": 5.0,
+                                         "events": 3, "unattributed": 0},
+           "hbm_measured_peak": 1000, "hbm_model_ok": True}
+    row.update(over)
+    return row
+
+
+def test_capture_line_verdicts():
+    assert tpu_watch._capture_line_bad(_capture_row()) is None
+    # kd-tree CPU bar and explicit skips are exempt
+    assert tpu_watch._capture_line_bad(
+        {"config": "kd_tree CPU kNN", "unit": "queries/sec",
+         "value": 1.0}) is None
+    assert tpu_watch._capture_line_bad(
+        {"unit": "queries/sec", "value": 1.0,
+         "device_capture_skipped": "BENCH_DEVICE_CAPTURE=0"}) is None
+    # missing decomposition / unattributed events / hbm verdict all fail
+    row = _capture_row()
+    del row["device_time_decomposition"]
+    assert "missing device_time" in tpu_watch._capture_line_bad(row)
+    assert "unattributed" in tpu_watch._capture_line_bad(_capture_row(
+        device_time_decomposition={"device_total_ms": 5.0, "events": 3,
+                                   "unattributed": 2}))
+    assert "hbm_model_ok" in tpu_watch._capture_line_bad(
+        _capture_row(hbm_model_ok=False))
+    row = _capture_row()
+    del row["hbm_measured_peak"]
+    assert "hbm_measured_peak" in tpu_watch._capture_line_bad(row)
+    assert "error" in tpu_watch._capture_line_bad({"error": "boom"})
+
+
+def _capture_env(monkeypatch, tmp_path, platform, rows=None):
+    """Fake the probe + the bench children: each step writes an artifact
+    of capture-stamped rows on the given platform."""
+    rows = rows or [_capture_row(platform=platform)]
+
+    def fake_run(argv, out_path, timeout_s, env_extra=None,
+                 allow_partial=False, good_check=None):
+        # the short-circuit must use the capture-banked predicate, not
+        # the plain _artifact_good (a CPU dry run or a capture-bad
+        # hardware artifact must re-run, never freeze)
+        assert good_check is tpu_watch._capture_banked_good
+        if good_check(out_path):
+            return 0
+        # the capture children must spill traces + capture stamps
+        assert (env_extra or {}).get("BENCH_DEVICE_CAPTURE") == "1"
+        assert (env_extra or {}).get("KNTPU_TRACE_DIR")
+        with open(out_path, "w") as f:
+            json.dump({"rc": 0, "lines": rows}, f)
+        return 0
+
+    monkeypatch.setattr(tpu_watch, "run_and_record", fake_run)
+    monkeypatch.setattr(tpu_watch, "_probe_default_backend",
+                        lambda t: platform)
+    return ["--capture", "--outdir", str(tmp_path), "--tag", "c"]
+
+
+def test_capture_banks_on_accelerator_platform(monkeypatch, tmp_path):
+    argv = _capture_env(monkeypatch, tmp_path, "tpu")
+    assert tpu_watch.main(argv) == 0
+    rec = json.load(open(tmp_path / "c_CAPTURE_record.json"))
+    assert rec["banked"] is True
+    assert set(rec["artifacts"]) == {"c_capture_pod_ladder.json",
+                                     "c_capture_north_star.json"}
+    assert not os.path.exists(tmp_path / "c_capture_refusal.json")
+
+
+def test_capture_refuses_to_bank_on_cpu(monkeypatch, tmp_path):
+    """ISSUE 15 acceptance: the --capture dry-run on a CPU/forced-host
+    platform completes the whole loop but PROVABLY refuses to bank --
+    rc 3 and a machine-readable refusal artifact naming the platform."""
+    argv = _capture_env(monkeypatch, tmp_path, "cpu")
+    assert tpu_watch.main(argv) == tpu_watch.RC_CAPTURE_REFUSED
+    ref = json.load(open(tmp_path / "c_capture_refusal.json"))
+    assert ref["banked"] is False
+    assert "cpu" in ref["reason"] and "dry-run" in ref["reason"]
+    assert not os.path.exists(tmp_path / "c_CAPTURE_record.json")
+
+
+def test_capture_verification_failure_is_rc1(monkeypatch, tmp_path):
+    # accelerator platform but a row missing its decomposition: that is
+    # a verification failure (rc 1), not the platform dry-run (rc 3)
+    bad = _capture_row(platform="tpu")
+    del bad["device_time_decomposition"]
+    argv = _capture_env(monkeypatch, tmp_path, "tpu", rows=[bad])
+    assert tpu_watch.main(argv) == 1
+    ref = json.load(open(tmp_path / "c_capture_refusal.json"))
+    assert "device_time_decomposition" in ref["reason"]
+
+
+def test_capture_dark_transport_is_rc2(monkeypatch, tmp_path):
+    monkeypatch.setattr(tpu_watch, "_probe_default_backend",
+                        lambda t: None)
+    assert tpu_watch.main(["--capture", "--outdir", str(tmp_path),
+                           "--tag", "c"]) == 2
+
+
+def test_capture_dry_run_artifact_never_blocks_hardware_window(
+        monkeypatch, tmp_path):
+    """Code-review regression: a banked CPU dry-run artifact must NOT
+    short-circuit a later real-hardware --capture (the old
+    _artifact_good short-circuit would pin the refusal forever)."""
+    # window 1: CPU dry run writes cpu-stamped artifacts, refuses
+    argv = _capture_env(monkeypatch, tmp_path, "cpu")
+    assert tpu_watch.main(argv) == tpu_watch.RC_CAPTURE_REFUSED
+    # window 2: the chip appears -- the children must RE-RUN (the fake
+    # overwrites with tpu rows) and the record banks
+    argv = _capture_env(monkeypatch, tmp_path, "tpu")
+    assert tpu_watch.main(argv) == 0
+    rec = json.load(open(tmp_path / "c_CAPTURE_record.json"))
+    assert rec["banked"] is True
+    # the stale refusal verdict from the dry run is superseded, not
+    # left sitting beside the banked record
+    assert not os.path.exists(tmp_path / "c_capture_refusal.json")
+
+
+def test_capture_banked_good_requires_accelerator_stamp(tmp_path):
+    p = tmp_path / "cap.json"
+    p.write_text(json.dumps({"rc": 0, "lines": [_capture_row()]}))
+    assert tpu_watch._capture_banked_good(str(p))
+    p.write_text(json.dumps(
+        {"rc": 0, "lines": [_capture_row(platform="cpu")]}))
+    assert not tpu_watch._capture_banked_good(str(p))
+    # capture-bad hardware artifact (device_capture_error) re-runs too
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        _capture_row(device_capture_error="profiler unavailable")]}))
+    assert not tpu_watch._capture_banked_good(str(p))
+    # capture-good but _artifact_good-bad (north_star=false fallback
+    # self-assessment) must re-run, not freeze into a forever-refusal
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        _capture_row(north_star=False)]}))
+    assert not tpu_watch._capture_banked_good(str(p))
+
+
+def test_capture_bank_refuses_all_skipped_artifacts(monkeypatch,
+                                                    tmp_path):
+    """An accelerator artifact whose every row opted out of capture
+    (device_capture_skipped) passes the per-row discipline but must NOT
+    bank: a CAPTURE record with zero actual captures is not one."""
+    skipped = {"platform": "tpu", "unit": "queries/sec", "value": 1.0,
+               "recall": 1.0,
+               "device_capture_skipped": "BENCH_DEVICE_CAPTURE=0"}
+    argv = _capture_env(monkeypatch, tmp_path, "tpu", rows=[skipped])
+    assert tpu_watch.main(argv) == 1
+    ref = json.load(open(tmp_path / "c_capture_refusal.json"))
+    assert "nothing was captured" in ref["reason"]
+
+
+def test_capture_good_artifact_discipline(tmp_path):
+    p = tmp_path / "cap.json"
+    p.write_text(json.dumps({"rc": 0, "lines": [_capture_row()]}))
+    assert tpu_watch._capture_good(str(p))
+    # a CPU capture is still a VALID dry-run product for _capture_good
+    # (banking is where the platform gates)
+    p.write_text(json.dumps(
+        {"rc": 0, "lines": [_capture_row(platform="cpu")]}))
+    assert tpu_watch._capture_good(str(p))
+    p.write_text(json.dumps(
+        {"rc": 0, "lines": [_capture_row(hbm_model_ok=False)]}))
+    assert not tpu_watch._capture_good(str(p))
+    p.write_text(json.dumps({"rc": 1, "lines": [_capture_row()]}))
+    assert not tpu_watch._capture_good(str(p))
+
+
+def test_deprecated_capture_shims_forward(monkeypatch, tmp_path):
+    """profile_tpu.py / tpu_record.py are thin wrappers over the ONE
+    capture path (tpu_watch --capture): exactly one way to capture."""
+    import importlib.util
+
+    called = {}
+
+    def fake_main(argv):
+        called["argv"] = argv
+        return 0
+
+    monkeypatch.setattr(tpu_watch, "main", fake_main)
+    for shim in ("profile_tpu", "tpu_record"):
+        spec = importlib.util.spec_from_file_location(
+            shim, os.path.join(os.path.dirname(tpu_watch.__file__),
+                               f"{shim}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        monkeypatch.setattr(sys, "argv", [f"{shim}.py"])
+        assert mod.main() == 0
+        assert called["argv"][0] == "--capture"
+
+
 def test_artifact_good_partial_accepts_result_rows(tmp_path):
     """Experiment-matrix artifacts (kernel A/B, phases): a per-config error
     row is a result (e.g. blocked failing Mosaic); the step must not be
