@@ -368,6 +368,7 @@ def bench_north_star() -> dict:
            if backend_used == "oracle" else {}),
         "recall_at_10": round(recall, 6),
         "recall": round(recall, 6),
+        "precision": problem.config.resolved_precision(),
         "solve_s": round(solve_s, 4),
         "cpu_oracle_qps": round(cpu_qps, 1),
         "oracle_sampled": sample_n,
@@ -412,6 +413,7 @@ def bench_config(name: str) -> dict:
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": "oracle",  # provenance: this row IS the CPU bar
                 "recall": 1.0,  # the exact oracle defines recall
+                "precision": "f64",  # kd-tree oracle scores in double
                 "seconds": round(s, 4), "n_points": points.shape[0]}
     if name == "grid_300k_k10":
         points = get_dataset("pts300K.xyz")
@@ -421,6 +423,7 @@ def bench_config(name: str) -> dict:
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
                 "recall": 1.0,  # exact path (certificates + fallback)
+                "precision": prob.config.resolved_precision(),
                 "solve_s": round(s, 4), "n_points": points.shape[0], **sync,
                 **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "blue_900k_k20":
@@ -431,6 +434,7 @@ def bench_config(name: str) -> dict:
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
                 "recall": 1.0,  # exact path (certificates + fallback)
+                "precision": prob.config.resolved_precision(),
                 "solve_s": round(s, 4), "n_points": points.shape[0], **sync,
                 **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "batched_300k_k50":
@@ -441,6 +445,7 @@ def bench_config(name: str) -> dict:
                 "value": round(qps, 1), "unit": "queries/sec",
                 "backend": prob.config.backend,
                 "recall": 1.0,  # exact path (certificates + fallback)
+                "precision": prob.config.resolved_precision(),
                 "solve_s": round(s, 4), "n_points": points.shape[0], **sync,
                 **roofline_fields(problem_traffic(prob), s, plat)}
     if name == "clustered_300k_adaptive":
@@ -505,6 +510,7 @@ def bench_config(name: str) -> dict:
                **global_fields,
                "n_points": n, "recall_at_10": round(recall, 6),
                "recall": round(recall, 6),
+               "precision": prob_a.config.resolved_precision(),
                "oracle_sampled": sample_n,
                "certified_fraction": float(np.asarray(
                    prob_a.result.certified).mean()),
@@ -574,6 +580,7 @@ def bench_config(name: str) -> dict:
                "solve_s": round(s, 4), "n_points": n,
                "recall_at_10": round(recall, 6),
                "recall": round(recall, 6),
+               "precision": sp.config.resolved_precision(),
                "oracle_sampled": sample_n,
                "certified_fraction": round(certified, 6),
                **sync_fields,
@@ -658,10 +665,19 @@ def bench_frontier() -> list:
     precision (``recall_discipline: '2B-banded'``, the fuzz
     comparator's discipline -- DESIGN.md section 16); the refined exact
     tier and the d=6 row are held to band-free f64 exactness.
+
+    Precision tiers (ISSUE 16): every (rt) point runs at BOTH scoring
+    tiers.  bf16 rows measure recall at bf16's own declared band
+    (measure.declared_band(precision='bf16')) and stamp
+    ``speedup_vs_f32`` -- the bf16/f32 wall ratio at the same (n, k, rt),
+    the number the tier exists to move.  A tuned-plan store, when active
+    (KNTPU_TUNE_STORE), fills query_chunk through the config.resolve_tuned
+    seam and the rows stamp what applied (``tuned``/``query_chunk``).
     ``BENCH_FRONTIER_N`` / ``BENCH_FRONTIER_D6_N`` scale the fixtures for
     constrained runners."""
     import numpy as np
 
+    from cuda_knearests_tpu.config import KnnConfig, resolve_tuned
     from cuda_knearests_tpu.io import get_dataset
     from cuda_knearests_tpu.mxu import solve_general
     from cuda_knearests_tpu.mxu.measure import (declared_band, f64_kth,
@@ -674,7 +690,8 @@ def bench_frontier() -> list:
     if n_target < orig_n:
         points = np.ascontiguousarray(points[:n_target])
     n = points.shape[0]
-    band = declared_band(points)
+    band = {prec: declared_band(points, precision=prec)
+            for prec in ("f32", "bf16")}
     # ONE O(n^2 d) f64 oracle pass: kth/avail depend only on (points, k),
     # so the per-rt rows share them (only the band discipline differs)
     kth, avail = f64_kth(points, k)
@@ -683,42 +700,63 @@ def bench_frontier() -> list:
     for rt in _FRONTIER_RTS:
         exact = rt >= 1.0
         refine = "brute" if exact else "none"
-        state: dict = {}
+        f32_s = None
+        for prec in ("f32", "bf16"):
+            # the tuned-plan seam: precision is THIS row's swept axis (set
+            # explicitly, so a stored plan never overrides it), query_chunk
+            # rides whatever the active store tuned for this signature
+            cfg = resolve_tuned(
+                KnnConfig(k=k, recall_target=rt, scorer="mxu",
+                          precision=prec), (n, 3))
+            state: dict = {}
 
-        def run():
-            state["res"] = solve_general(points, k=k, recall_target=rt,
-                                         scorer="mxu", refine=refine)
+            def run():
+                state["res"] = solve_general(
+                    points, k=k, recall_target=rt, scorer="mxu",
+                    refine=refine, precision=prec,
+                    query_chunk=cfg.query_chunk)
 
-        run()  # compile + warmup
-        _watchdog.heartbeat()
-        s = _steady_state(run, iters=3, max_seconds=_budget_s())
-        res = state["res"]
-        # approximate rows measure at the route's declared 2B scoring
-        # precision (the fuzz comparator's discipline -- band-free f64
-        # ordering is a claim refine='none' never makes, and it bites
-        # exactly when the bound reaches 1.0); the refined exact tier
-        # claims true exactness and is held to it band-free
-        hits = row_hits(points, res.neighbors, kth,
-                        band=None if exact else band)
-        recall = float(hits.sum()) / total if total else 1.0
-        _watchdog.heartbeat()  # the f64 oracle pass is slow but local
-        rows.append({
-            "config": f"mxu frontier pts20K.xyz (k={k}, "
-                      f"recall_target={rt:g}, refine={refine})",
-            "value": round(n / s, 1), "unit": "queries/sec",
-            "backend": f"mxu-{res.backend}",
-            "recall_target": rt,
-            "recall_bound": round(res.bound, 6),
-            "recall": round(recall, 6),
-            "recall_ok": bool(recall >= res.bound),
-            "recall_discipline": "exact" if exact else "2B-banded",
-            "m": res.m, "n_blocks": res.n_blocks,
-            "certified_fraction": round(float(res.certified.mean()), 6)
-            if n else 1.0,
-            "uncert_count": int(res.uncert_count),
-            "solve_s": round(s, 4), "n_points": n, "k": k, "d": 3,
-            **({"scaled_down_from": orig_n} if n < orig_n else {}),
-        })
+            run()  # compile + warmup
+            _watchdog.heartbeat()
+            s = _steady_state(run, iters=3, max_seconds=_budget_s())
+            res = state["res"]
+            if prec == "f32":
+                f32_s = s
+            # approximate rows measure at the route's declared 2B scoring
+            # precision FOR THE TIER THAT RAN (the fuzz comparator's
+            # discipline -- band-free f64 ordering is a claim refine='none'
+            # never makes, and bf16's wider band is exactly its declared
+            # contract); the refined exact tier claims true exactness and
+            # is held to it band-free at both precisions
+            hits = row_hits(points, res.neighbors, kth,
+                            band=None if exact else band[prec])
+            recall = float(hits.sum()) / total if total else 1.0
+            _watchdog.heartbeat()  # the f64 oracle pass is slow but local
+            rows.append({
+                "config": f"mxu frontier pts20K.xyz (k={k}, "
+                          f"recall_target={rt:g}, refine={refine}"
+                          + ("" if prec == "f32" else f", precision={prec}")
+                          + ")",
+                "value": round(n / s, 1), "unit": "queries/sec",
+                "backend": f"mxu-{res.backend}",
+                "recall_target": rt,
+                "recall_bound": round(res.bound, 6),
+                "recall": round(recall, 6),
+                "recall_ok": bool(recall >= res.bound),
+                "recall_discipline": "exact" if exact else "2B-banded",
+                "precision": res.precision,
+                "tuned": cfg.query_chunk is not None,
+                **({"query_chunk": cfg.query_chunk}
+                   if cfg.query_chunk is not None else {}),
+                **({"speedup_vs_f32": round(f32_s / s, 3)}
+                   if prec == "bf16" and f32_s else {}),
+                "m": res.m, "n_blocks": res.n_blocks,
+                "certified_fraction": round(float(res.certified.mean()), 6)
+                if n else 1.0,
+                "uncert_count": int(res.uncert_count),
+                "solve_s": round(s, 4), "n_points": n, "k": k, "d": 3,
+                **({"scaled_down_from": orig_n} if n < orig_n else {}),
+            })
 
     # the d != 3 row: same engine, same stamps, exact tier
     d = 6
@@ -746,6 +784,7 @@ def bench_frontier() -> list:
         "recall": round(recall6, 6),
         "recall_ok": bool(recall6 >= res6.bound),
         "recall_discipline": "exact",
+        "precision": res6.precision,
         "m": res6.m, "n_blocks": res6.n_blocks,
         "certified_fraction": round(float(res6.certified.mean()), 6),
         "uncert_count": int(res6.uncert_count),
@@ -843,6 +882,7 @@ def _fleet_scenario(name: str) -> dict:
         "unit": "queries/sec",
         "backend": "fleet",
         "recall": 1.0,  # exact serving path (certificates + fallback)
+        "precision": "f32",  # serving routes score exact f32 only
         "n_points": n,
         "steady_ok": bool(summary["recompiles"] == 0
                           and summary["exec_cache_enabled"]
@@ -929,6 +969,7 @@ def serve_scenario(name: str) -> dict:
         "unit": "queries/sec",
         "backend": problem.config.backend,
         "recall": 1.0,  # exact serving path (certificates + fallback)
+        "precision": problem.config.resolved_precision(),
         "n_points": points.shape[0],
         **{key: summary[key] for key in (
             "requests", "completed_queries", "failed_requests", "refused",

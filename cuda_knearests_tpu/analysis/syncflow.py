@@ -55,7 +55,7 @@ _PKG_NAME = os.path.basename(_PKG_ROOT)
 # surfaces are out of scope (they *wrap* solve windows; their own fetches
 # would double-count the windows they measure).
 SCOPE = ("api.py", "ops", "parallel", "cluster", "serve", "runtime", "mxu",
-         "pod")
+         "pod", "tune")
 
 _ANNOT_RE = re.compile(r"#\s*syncflow:\s*([A-Za-z0-9_-]+)")
 _DISPATCH_ALIASES = ("_dispatch", "dispatch")
@@ -297,6 +297,20 @@ WINDOWS: Dict[str, Window] = {
                                 "32*hcap*steps*(ndev - 1)"),
         },
         syncs="1", budget="2"),
+    # One autotuner trial (tune/search.py, DESIGN.md section 21): ONE
+    # solve_general call under the candidate plan's knobs -- the trial's
+    # entire host boundary IS the mxu-brute window (the timer reads host-
+    # resident results, zero syncs of its own), and the searcher asserts
+    # the same bound at runtime per trial from the dispatch counters
+    # (sync_bound_ok on every row).
+    "tune-trial": Window(
+        entries=("tune.search._run_trial",),
+        includes=("mxu-brute",),
+        sites={},
+        syncs="1 + fb", budget="2",
+        notes="the search loop around trials is pure host bookkeeping "
+              "(perf_counter + dict rows); elementwise-baseline trials "
+              "run the same solve_general entry"),
 }
 
 # Which model window proves each runtime route's bound -- the route names
@@ -316,6 +330,7 @@ ROUTE_WINDOWS: Dict[str, str] = {
     "fleet-sidecar": "fleet-sidecar",
     "pod-solve": "pod-solve",
     "pod-query": "pod-query",
+    "tune-trial": "tune-trial",
 }
 
 # Sanctioned dispatch sites that live OUTSIDE every solve window: lazy

@@ -97,6 +97,27 @@ def _config_adaptive_eligible(cfg, per_chip: bool = False) -> bool:
             and (jax.devices()[0].platform == "tpu" or cfg.interpret))
 
 
+def _resolve_tuned_for(cfg, points) -> "KnnConfig":
+    """THE tuned-plan seam of every prepare (config.resolve_tuned over
+    this problem's shape signature): fills only still-default knobs, and
+    with no active store (KNTPU_TUNE_STORE unset, nothing registered) it
+    is an exact no-op -- the single-chip, sharded, and pod prepares all
+    pass through here so a plan tuned once applies everywhere.  Shape
+    probing is deliberately forgiving (a prepare on unvalidated input must
+    refuse through the io front door, not here)."""
+    from .config import resolve_tuned
+
+    shape = getattr(points, "shape", None)
+    if shape is None:
+        try:
+            shape = np.asarray(points).shape
+        except Exception:  # noqa: BLE001 -- malformed input: validate_or_raise owns the refusal
+            return cfg
+    if len(shape) != 2:
+        return cfg
+    return resolve_tuned(cfg, (int(shape[0]), int(shape[1])))
+
+
 def _pad_pow2(x: np.ndarray, fill: int, minimum: int = 8) -> np.ndarray:
     m = max(minimum, 1 << (int(x.size) - 1).bit_length()) if x.size else minimum
     out = np.full((m,), fill, x.dtype)
@@ -153,6 +174,7 @@ class KnnProblem:
         from .io import validate_or_raise
 
         config = config or KnnConfig()
+        config = _resolve_tuned_for(config, points)
         # fail-fast scorer resolution (DESIGN.md section 16): an illegal
         # scorer x recall_target combination refuses HERE, not at solve
         # time -- and the MXU scorer only has a grid-route implementation

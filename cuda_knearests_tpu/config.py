@@ -35,6 +35,13 @@ DEFAULT_K = 50
 # overrides it (DESIGN.md section 13).
 DEFAULT_EXEC_CACHE_ENTRIES = 64
 
+# Default entry cap of the tuned-plan store (tune/store.py), the
+# ExecutableCache's disk-persisted sibling: one entry per
+# (device kind, problem signature) the autotuner has searched.  LRU-bounded
+# for the same reason the exec cache is; KNTPU_TUNE_CACHE_CAP overrides
+# (DESIGN.md section 21).
+DEFAULT_TUNE_CACHE_ENTRIES = 64
+
 
 def grid_dim_for(n_points: int, density: float = DEFAULT_CELL_DENSITY) -> int:
     """Cells per axis for a cubic grid with ~`density` points per cell.
@@ -165,6 +172,21 @@ class KnnConfig:
         already dispatch back-to-back against one batched readback, so
         there is no monolithic upload to split.  Solvers read
         resolved_query_chunk(), not this field.
+      precision: MXU scoring precision tier (DESIGN.md section 21).  'f32'
+        = the pipeline today, byte-for-byte.  'bf16' = the norms and the
+        -2*QP^T matmul cast their inputs to bfloat16 while EVERY
+        accumulation stays f32 (preferred_element_type) -- the MXU's native
+        reduced-precision mode, the peak-FLOP/s tier of TPU-KNN (arXiv
+        2206.14286).  Certification stays SOUND at every tier: the
+        per-precision bound family (mxu.topk.dot_error_bound) widens the
+        certification band to cover the cast/product roundoff, so bf16
+        decertifies more rows into the existing exact fallback -- only the
+        certified fraction moves, never correctness of a certified row.
+        'auto' = 'f32' (reduced precision is an opt-in speed knob, never a
+        silent accuracy change) unless a tuned plan resolves it (see
+        resolve_tuned).  Only the MXU scorer honors it; 'bf16' with the
+        elementwise scorer is refused.  Solvers read resolved_precision(),
+        not this field.
     """
 
     k: int = DEFAULT_K
@@ -200,6 +222,7 @@ class KnnConfig:
     kernel: str = "kpass"  # solvers read effective_kernel(), not this field
     epilogue: str = "auto"  # solvers read resolved_epilogue(), not this field
     query_chunk: Optional[int] = None  # solvers read resolved_query_chunk()
+    precision: str = "auto"  # solvers read resolved_precision(), not this field
     # Voronoi plane feed (cluster/planes.py, DESIGN.md section 14): when
     # True, solve() emits the per-neighbor bisector-plane representation
     # (n, d) = (p - q, (|p|^2 - |q|^2)/2) as result.planes -- the clipping
@@ -242,7 +265,7 @@ class KnnConfig:
         """resolve_scorer() against this config -- every solver call site
         reads this, never the raw ``scorer`` field (same single-source rule
         as effective_kernel / resolved_epilogue)."""
-        return resolve_scorer(self.scorer, self.recall_target)
+        return resolve_scorer(self.scorer, self.recall_target, self.precision)
 
     def resolved_query_chunk(self) -> Optional[int]:
         """Chunk size of the external-query double-buffered pipeline
@@ -255,6 +278,12 @@ class KnnConfig:
         (tests/test_dispatch.py).  None or <= 0 means single-shot."""
         q = self.query_chunk
         return int(q) if q is not None and int(q) > 0 else None
+
+    def resolved_precision(self) -> str:
+        """resolve_precision() against this config -- every solver call site
+        reads this, never the raw ``precision`` field (same single-source
+        rule as resolved_scorer / resolved_epilogue)."""
+        return resolve_precision(self.precision, self.resolved_scorer())
 
 
 @dataclasses.dataclass(frozen=True)
@@ -462,14 +491,18 @@ def resolve_epilogue(epilogue: str, on_kernel_platform: bool) -> str:
     return epilogue
 
 
-def resolve_scorer(scorer: str, recall_target: float) -> str:
+def resolve_scorer(scorer: str, recall_target: float,
+                   precision: str = "auto") -> str:
     """'auto' -> 'mxu' below a 1.0 recall target (only the MXU engine has an
-    approximate mode), 'elementwise' at exactly 1.0 (the measured-fast exact
-    arithmetic on d=3 -- a 3-wide contraction leaves the MXU ~2% utilized,
-    see the dist_method docs).  Explicit scorers pass through; an
-    'elementwise' scorer with a sub-1.0 target is refused loudly -- the
-    exact path cannot honor an approximation budget, and silently ignoring
-    the knob would benchmark the wrong engine."""
+    approximate mode) or under a reduced scoring precision (only the MXU
+    engine has one of those, too), 'elementwise' at exactly 1.0/f32 (the
+    measured-fast exact arithmetic on d=3 -- a 3-wide contraction leaves
+    the MXU ~2% utilized, see the dist_method docs).  Explicit scorers pass
+    through; an 'elementwise' scorer with a sub-1.0 target is refused
+    loudly -- the exact path cannot honor an approximation budget, and
+    silently ignoring the knob would benchmark the wrong engine.  (The
+    elementwise-x-bf16 refusal lives in resolve_precision: scorer
+    resolution must stay total so the precision check can consult it.)"""
     if scorer not in ("auto", "mxu", "elementwise"):
         raise ValueError(
             f"unknown scorer {scorer!r}: expected 'auto', 'mxu' or "
@@ -484,8 +517,82 @@ def resolve_scorer(scorer: str, recall_target: float) -> str:
             f"scorer='elementwise' computes exact top-k only; "
             f"recall_target={r} needs scorer='mxu' (or 'auto')")
     if scorer == "auto":
-        return "mxu" if r < 1.0 else "elementwise"
+        return "mxu" if (r < 1.0 or precision == "bf16") else "elementwise"
     return scorer
+
+
+def resolve_precision(precision: str, scorer_resolved: str = "mxu") -> str:
+    """'auto' -> 'f32': reduced precision is an opt-in speed knob, never a
+    silent accuracy change (the tuned-plan seam is the one place that fills
+    'auto' differently, and only from a plan the tuner measured on this
+    hardware).  Explicit tiers pass through mxu.topk.PRECISIONS validation;
+    'bf16' with the elementwise scorer is refused loudly -- that path
+    scores in exact diff arithmetic with no reduced-precision mode, and
+    silently ignoring the knob would benchmark the wrong arithmetic."""
+    from .mxu import topk as _topk  # host-only numpy module; cheap import
+
+    if precision == "auto":
+        return "f32"
+    _topk.check_precision(precision)
+    if precision != "f32" and scorer_resolved == "elementwise":
+        raise ValueError(
+            f"precision={precision!r} needs the MXU scorer; the elementwise "
+            f"path has no reduced-precision mode (set scorer='mxu' or leave "
+            f"it 'auto')")  # a typo must not silently benchmark the wrong arithmetic
+    return precision
+
+
+def resolve_tuned(cfg: "KnnConfig", signature, device_kind=None) -> "KnnConfig":
+    """Fill a config's still-default knobs from the tuned-plan store.
+
+    The ONE resolution seam between the autotuner (tune/, DESIGN.md
+    section 21) and the solvers: api.prepare, the sharded and pod prepares,
+    and bench --frontier all pass their config through here before
+    planning.  Law of the seam:
+
+      * only knobs still at their 'auto'/None defaults are filled -- an
+        explicit user choice ALWAYS wins over a tuned plan;
+      * the store is consulted only when one is active (KNTPU_TUNE_STORE
+        set, or a process store registered via tune.store.set_default_store)
+        -- with no store this returns ``cfg`` unchanged without importing
+        the tuner, so untouched deployments keep byte-identical behavior;
+      * ``signature`` is the problem shape key (tune.store.plan_signature)
+        or an ``(n, d)`` tuple converted AFTER the activation check (so
+        callers never import the tuner just to build a key);
+        ``device_kind`` defaults to this process's accelerator
+        (utils.devinfo.current_device_kind).
+
+    Because plans only fill 'auto' slots and certification is sound at
+    every precision tier, a tuned resolve can change SPEED and the
+    certified fraction but never the correctness contract -- and at
+    recall_target=1.0 with epilogue/scorer defaults the tuned and untuned
+    answers are byte-identical by test (tests/test_tune.py).
+    """
+    import os
+
+    if "KNTPU_TUNE_STORE" not in os.environ:
+        import sys
+        tune_store = sys.modules.get(__package__ + ".tune.store")
+        if tune_store is None or tune_store.get_default_store() is None:
+            return cfg  # no store active: zero behavior (and import) change
+    from .tune import store as _store
+
+    if isinstance(signature, tuple):
+        n, d = signature
+        signature = _store.plan_signature(n, d, cfg.k, cfg.recall_target)
+    plan = _store.lookup_plan(signature, device_kind)
+    if not plan:
+        return cfg
+    updates = {}
+    if cfg.precision == "auto" and plan.get("precision"):
+        updates["precision"] = str(plan["precision"])
+    if cfg.scorer == "auto" and plan.get("scorer"):
+        updates["scorer"] = str(plan["scorer"])
+    if cfg.epilogue == "auto" and plan.get("epilogue"):
+        updates["epilogue"] = str(plan["epilogue"])
+    if cfg.query_chunk is None and plan.get("query_chunk"):
+        updates["query_chunk"] = int(plan["query_chunk"])
+    return dataclasses.replace(cfg, **updates) if updates else cfg
 
 
 def blocked_topm(k: int, ccap: int) -> int:

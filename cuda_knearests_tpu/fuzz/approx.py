@@ -40,15 +40,30 @@ recall bound rests on, and the one input guaranteed to overflow a
 per-block top-m.  Those rows must come back UNCERTIFIED (the campaign's
 live probe that the certificate notices real overflow).
 
-Failures are ddmin-minimized (kind-preserving, the case's k and
-recall_target fixed) and banked to ``tests/corpus/*-approx.npz``
-(replayed forever by tests/test_mxu.py).  Seeded faults
-(``KNTPU_MXU_FAULT=drop-block|skip-certify``, resolved inside
-mxu/solve.py) must each yield a banked failure -- ``skip-certify`` makes
-the planted case's overflowed rows claim certification (caught by check
-2), ``drop-block`` silently discards certified block-0 survivors (caught
-by checks 1 and 2) -- and faulted runs are diverted away from the real
-corpus like every other flavor.
+Precision tiers (ISSUE 16): cases carry the scoring tier they attack
+(``ApproxCaseSpec.precision``).  bf16 cases audit the SAME claims -- the
+certificate-soundness check stays band-free (a certified row is exact at
+the exact threshold NO MATTER what precision scored it; that is the whole
+point of the per-precision bound family), while the recall hit test
+widens to bf16's own declared band (measure.declared_band(precision=
+'bf16'), the tier's honestly-wider contract).  The planted block-aliased
+case runs at bf16, which makes it the live detector for the
+``narrow-bound`` seeded fault: a bf16 solve whose certificate reasons
+with the NARROW f32 band (the forgot-to-thread-precision bug) certifies
+rows bf16 scoring provably mis-ordered, and the band-free soundness
+check banks it.
+
+Failures are ddmin-minimized (kind-preserving, the case's k,
+recall_target, and precision fixed) and banked to
+``tests/corpus/*-approx.npz`` (replayed forever by tests/test_mxu.py).
+Seeded faults (``KNTPU_MXU_FAULT=drop-block|skip-certify|narrow-bound``,
+resolved inside mxu/solve.py) must each yield a banked failure --
+``skip-certify`` makes the planted case's overflowed rows claim
+certification (caught by check 2), ``drop-block`` silently discards
+certified block-0 survivors (caught by checks 1 and 2), ``narrow-bound``
+certifies bf16-scored rows against the f32 band (caught by check 2 on
+the planted bf16 case) -- and faulted runs are diverted away from the
+real corpus like every other flavor.
 """
 
 from __future__ import annotations
@@ -97,10 +112,13 @@ class ApproxCaseSpec:
     n: int
     k: int
     recall_target: float
+    #: scoring tier under attack; 'f32' keeps pre-tier case ids stable
+    precision: str = "f32"
 
     def case_id(self) -> str:
+        suffix = "" if self.precision == "f32" else f"-{self.precision}"
         return (f"approx-{self.generator}-s{self.seed}-n{self.n}"
-                f"-k{self.k}-r{self.recall_target:g}")
+                f"-k{self.k}-r{self.recall_target:g}{suffix}")
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -109,7 +127,9 @@ class ApproxCaseSpec:
     def from_json(cls, d: dict) -> "ApproxCaseSpec":
         return cls(generator=str(d["generator"]), seed=int(d["seed"]),
                    n=int(d["n"]), k=int(d["k"]),
-                   recall_target=float(d["recall_target"]))
+                   recall_target=float(d["recall_target"]),
+                   # pre-tier corpora carry no precision field: f32
+                   precision=str(d.get("precision", "f32")))
 
 
 @dataclasses.dataclass
@@ -203,18 +223,24 @@ def _structural(points: np.ndarray, ids: np.ndarray,
 
 
 def _approx_failure(points: np.ndarray, k: int, recall_target: float,
+                    precision: str = "f32",
                     res_out: Optional[list] = None
                     ) -> Optional[Tuple[str, str]]:
     """(kind, reason) when the MXU route violates a claim on ``points``,
     None when every claim holds.  Exceptions are contained and classified
     -- legal input must never raise.  ``res_out`` (when given) receives
-    the MxuResult so follow-on audits need not re-solve."""
+    the MxuResult so follow-on audits need not re-solve.
+
+    ``precision`` is the scoring tier under attack: the certificate
+    soundness check is band-free at EVERY tier (a certified row claims
+    exactness, full stop), only the recall hit test widens to the tier's
+    own declared band."""
     from ..mxu.solve import solve_general
 
     exact = recall_target >= 1.0
     try:
         res = solve_general(points, k=k, recall_target=recall_target,
-                            scorer="mxu",
+                            scorer="mxu", precision=precision,
                             refine="brute" if exact else "none")
     except InputContractError as e:
         return ("invalid-input",
@@ -258,8 +284,10 @@ def _approx_failure(points: np.ndarray, k: int, recall_target: float,
                 f"the refinement tier would trust a wrong answer")
     # recall vs the TPU-KNN binning bound, at the route's own scoring
     # precision: the hit threshold widens by the per-row dot-form error
-    # band 2B the certificate itself reasons with
-    hits = row_hits(points, ids, kth, band=declared_band(points))
+    # band 2B the certificate itself reasons with -- bf16's wider band
+    # is exactly the wider contract that tier declares
+    hits = row_hits(points, ids, kth,
+                    band=declared_band(points, precision=precision))
     total = int(avail.sum())
     recall = float(hits.sum()) / total if total else 1.0
     if recall < res.bound:
@@ -295,7 +323,8 @@ def _planted_overflow_failure(spec: ApproxCaseSpec, points: np.ndarray,
 
         res = solve_general(points, k=spec.k,
                             recall_target=spec.recall_target,
-                            scorer="mxu", refine="none")
+                            scorer="mxu", precision=spec.precision,
+                            refine="none")
     g = max(1, (-(-n // BLOCK) * BLOCK) // BLOCK)
     n_cluster = min(2 * spec.k, max(1, (n - 1) // g + 1))
     if n_cluster - 1 <= res.m:
@@ -371,7 +400,7 @@ def run_approx_case(spec: ApproxCaseSpec, bank_dir: Optional[str] = None,
     points = case_points(spec)
     res_box: list = []
     got = _approx_failure(points, spec.k, spec.recall_target,
-                          res_out=res_box)
+                          precision=spec.precision, res_out=res_box)
     if got is None and spec.generator == PLANTED:
         # the planted case's extra claim; never minimized (the aliasing
         # construction lives in the storage indices ddmin reshuffles)
@@ -389,7 +418,8 @@ def run_approx_case(spec: ApproxCaseSpec, bank_dir: Optional[str] = None,
     repro = points
     if minimize and points.shape[0] > 1:
         def _still_fails(sub):
-            sub_got = _approx_failure(sub, spec.k, spec.recall_target)
+            sub_got = _approx_failure(sub, spec.k, spec.recall_target,
+                                      precision=spec.precision)
             return sub_got is not None and sub_got[0] == kind
         repro, _probes = ddmin_points(points, _still_fails,
                                       max_probes=max_probes)
@@ -405,7 +435,13 @@ def draw_approx_cases(n_cases: int, seed: int) -> List[ApproxCaseSpec]:
     """The deterministic case list: the planted block-aliased generator
     leads (case 0 -- the seeded-fault self-tests need it within any small
     campaign), then the zoo cycles; every fourth case runs the exact tier
-    at recall_target = 1.0, the rest sweep the sub-1.0 palette."""
+    at recall_target = 1.0, the rest sweep the sub-1.0 palette.
+
+    Precision tiers: planted cases run at bf16 (case 0 is the
+    narrow-bound seeded fault's live detector -- the fault only bites
+    rows whose scoring tier is WIDER than the band the certificate
+    reasons with), and every third remaining case attacks bf16 too, so a
+    default campaign exercises both tiers against every zoo hazard."""
     rng = np.random.default_rng(seed)
     names = [PLANTED] + zoo_names()
     cases: List[ApproxCaseSpec] = []
@@ -422,9 +458,10 @@ def draw_approx_cases(n_cases: int, seed: int) -> List[ApproxCaseSpec]:
               else float(rng.choice(APPROX_RTS)))
         if name == PLANTED:
             rt = float(min(APPROX_RTS))  # the overflow probe needs approx mode
+        precision = "bf16" if name == PLANTED or i % 3 == 1 else "f32"
         cases.append(ApproxCaseSpec(
             generator=name, seed=seed * 100003 + i, n=n, k=k,
-            recall_target=rt))
+            recall_target=rt, precision=precision))
     return cases
 
 

@@ -58,22 +58,35 @@ def kernel_fits(c_pad: int, d_pad: int, k: int, m: int) -> bool:
 
 def _select_kernel(q_ref, qid_ref, p_ref, cid_ref, out_i_ref, out_v_ref,
                    cert_ref, pool_v_ref, pool_i_ref, rem_ref, *, k: int,
-                   m: int, d_real: int, exclude_self: bool):
+                   m: int, d_real: int, exclude_self: bool,
+                   precision: str = "f32"):
     """One 128-query block: stage-1 per-block top-m into the VMEM pool,
-    stage-2 k-pass selection + the (k+1)-th probe, certification."""
+    stage-2 k-pass selection + the (k+1)-th probe, certification.
+
+    Refs and BlockSpecs stay f32 at every precision tier -- bf16 casts
+    happen in-register AFTER the VMEM load (no (16, 128) bf16 tiling in
+    the layouts), with f32 accumulation on every reduction; only the
+    scoring inputs round, which the widened bf16 certification band
+    covers (topk.dot_error_bound)."""
     g_total = cid_ref.shape[0]
     q = q_ref[:, :]                                  # (128, d_pad)
-    qn = jnp.sum(q * q, axis=1)                      # (128,)
+    qn = jnp.sum(q * q, axis=1)                      # (128,) f32 band input
+    qs = q.astype(jnp.bfloat16) if precision == "bf16" else q
+    qn_s = (jnp.sum(qs * qs, axis=1, dtype=jnp.float32)
+            if precision == "bf16" else qn)          # scoring norms
     qid = qid_ref[0, :].reshape(-1, 1) if exclude_self else None
 
     def s1_body(g, pn_max):
         p_blk = p_ref[pl.ds(g * BLOCK, BLOCK), :]    # (128, d_pad)
         cid = cid_ref[pl.ds(g, 1), :]                # (1, 128)
-        pn = jnp.sum(p_blk * p_blk, axis=1)          # (128,)
+        pn = jnp.sum(p_blk * p_blk, axis=1)          # (128,) f32 band input
+        ps = p_blk.astype(jnp.bfloat16) if precision == "bf16" else p_blk
+        pn_s = (jnp.sum(ps * ps, axis=1, dtype=jnp.float32)
+                if precision == "bf16" else pn)
         # the MXU contraction: (128, d) x (d, 128) with f32 accumulation
-        qp = jax.lax.dot_general(q, p_blk, (((1,), (1,)), ((), ())),
+        qp = jax.lax.dot_general(qs, ps, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        s = qn[:, None] + pn[None, :] - 2.0 * qp     # (128q, 128c)
+        s = qn_s[:, None] + pn_s[None, :] - 2.0 * qp  # (128q, 128c)
         drop = cid < 0
         if exclude_self:
             drop = drop | (cid == qid)
@@ -126,12 +139,13 @@ def _select_kernel(q_ref, qid_ref, p_ref, cid_ref, out_i_ref, out_v_ref,
     # the ONE certification bound (topk.dot_error_bound, plain arithmetic,
     # traces fine in-kernel): re-deriving it here would let the two
     # engines certify with different bands the moment the bound changes
-    err_b = dot_error_bound(qn, pn_max, d_real)
+    err_b = dot_error_bound(qn, pn_max, d_real, precision)
     cert_ref[0, :] = (kplus >= t + 2.0 * err_b).astype(jnp.int32)
 
 
 def select_pallas(queries, q_ids, pts_il, cid_il, k: int, m: int,
-                  d_real: int, exclude_self: bool, interpret: bool):
+                  d_real: int, exclude_self: bool, interpret: bool,
+                  precision: str = "f32"):
     """Launch the selection kernel over 128-query blocks.
 
     queries (Mp, d_pad) with Mp a 128 multiple; q_ids (Mp,); pts_il
@@ -159,7 +173,7 @@ def select_pallas(queries, q_ids, pts_il, cid_il, k: int, m: int,
     ]
     out_i, out_v, cert = pl.pallas_call(
         functools.partial(_select_kernel, k=k, m=m, d_real=d_real,
-                          exclude_self=exclude_self),
+                          exclude_self=exclude_self, precision=precision),
         grid=(n_qblk,),
         in_specs=[q_spec, qid_spec, p_spec, cid_spec],
         out_specs=out_specs,

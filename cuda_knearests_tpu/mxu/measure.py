@@ -35,19 +35,23 @@ import numpy as np
 
 
 def declared_band(points: np.ndarray,
-                  queries: Optional[np.ndarray] = None) -> np.ndarray:
+                  queries: Optional[np.ndarray] = None,
+                  precision: str = "f32") -> np.ndarray:
     """Per-query scoring-precision band ``2B`` of the dot-form route
     (topk.dot_error_bound -- the same band the certificate reasons
-    with): the width within which f32 blocked-matmul scores provably
-    cannot order candidates.  Recall measured at the route's declared
-    precision widens the hit threshold by this band."""
+    with): the width within which blocked-matmul scores at the declared
+    ``precision`` tier provably cannot order candidates.  Recall measured
+    at the route's declared precision widens the hit threshold by this
+    band -- bf16 rows measure against the bf16 band, so the measure and
+    the certificate always reason with the SAME family (certificate
+    soundness itself stays band-free: certified_recall never widens)."""
     from .topk import dot_error_bound
 
     p64 = points.astype(np.float64)  # kntpu-ok: wide-dtype -- oracle math: the band is a bound on f32 error, computed exactly
     q64 = p64 if queries is None else queries.astype(np.float64)  # kntpu-ok: wide-dtype -- oracle math
     qn = (q64 * q64).sum(axis=1)
     pn_max = float((p64 * p64).sum(axis=1).max()) if p64.size else 0.0
-    return 2.0 * dot_error_bound(qn, pn_max, points.shape[1])
+    return 2.0 * dot_error_bound(qn, pn_max, points.shape[1], precision)
 
 
 def f64_kth(points: np.ndarray, k: int,

@@ -34,12 +34,24 @@ the math and the soundness argument):
   on TPU by itself; the hand-blocked Pallas twin (kernel.py) exists for
   the brute route where the fold can stay in registers.
 
+Precision tiers (DESIGN.md section 21): ``precision='bf16'`` casts the
+matmul inputs and the norm squares to bfloat16 while every accumulation
+stays f32 (``preferred_element_type`` / explicit f32 sum dtype) -- the
+MXU's native reduced-precision mode.  Certification stays sound because
+the error band comes from the per-precision family
+(topk.dot_error_bound(..., precision)): the wider bf16 band decertifies
+boundary rows into the existing exact fallback instead of mis-certifying
+them.  The f32 tier is byte-identical to the pre-tier pipeline.
+
 Seeded faults (``KNTPU_MXU_FAULT``, resolved by the solve wrapper and
 passed as a static): ``drop-block`` silently discards block 0's pool
 contribution AFTER certification (a certified-yet-incomplete row -- the
 shape of a broken fold), ``skip-certify`` forces every row certified (a
-dead refinement tier).  Each must yield a banked failure in the
-``fuzz --approx`` self-test (scripts/check.sh).
+dead refinement tier), ``narrow-bound`` certifies bf16-scored rows with
+the f32-width band (the realistic forgot-to-thread-the-precision bug:
+bf16 noise dwarfs the narrow band, so boundary rows wrongly certify).
+Each must yield a banked failure in the ``fuzz --approx`` self-test
+(scripts/check.sh).
 """
 
 from __future__ import annotations
@@ -59,7 +71,24 @@ from .topk import BLOCK, dot_error_bound, interleave_slots, per_block_m
 #: blocked matmul materializes per step on the XLA path.
 _MXU_TILE_BYTES = 64 << 20
 
-FAULTS = ("drop-block", "skip-certify")
+FAULTS = ("drop-block", "skip-certify", "narrow-bound")
+
+
+def cert_band_precision(precision: str, fault: Optional[str] = None) -> str:
+    """The precision whose error band certifies rows: the SCORING precision
+    -- except under the ``narrow-bound`` seeded fault, which drops the
+    precision term and certifies with the f32-width band regardless of the
+    arithmetic that actually scored.  Under bf16 scoring that band is
+    ~465x too narrow, so boundary rows wrongly certify: the exact unsound
+    shape ``fuzz --approx`` exists to bank."""
+    return "f32" if fault == "narrow-bound" else precision
+
+
+def _cast_for(q: jax.Array, precision: str) -> jax.Array:
+    """Cast a matmul/norm input to the scoring precision ('f32' is the
+    identity -- same array object, so the f32 tier's program is untouched,
+    not merely equivalent)."""
+    return q.astype(jnp.bfloat16) if precision == "bf16" else q
 
 
 def _sort_pairs(vals: jax.Array, ids: jax.Array):
@@ -157,22 +186,31 @@ def rescore_sorted(points: jax.Array, q: jax.Array, sel_i: jax.Array,
     return ids_s, d2s
 
 
-def score_tile(q: jax.Array, p: jax.Array) -> jax.Array:
+def score_tile(q: jax.Array, p: jax.Array,
+               precision: str = "f32") -> jax.Array:
     """One (Q, C) dot-form score tile: |q|^2 + |p|^2 - 2 q.p with f32
     accumulation -- the MXU contraction (XLA lowers the matmul onto the
-    MXU on TPU; the Pallas twin issues the same jnp.dot in-kernel)."""
-    qn = jnp.sum(q * q, axis=-1)
-    pn = jnp.sum(p * p, axis=-1)
-    qp = jnp.dot(q, p.T, preferred_element_type=jnp.float32)
+    MXU on TPU; the Pallas twin issues the same jnp.dot in-kernel).
+
+    Under ``precision='bf16'`` the matmul inputs and the per-coordinate
+    norm squares round to bfloat16; both reductions still accumulate in
+    f32 (explicit sum dtype / preferred_element_type), so the only new
+    error is the per-lane cast+product roundoff the widened certification
+    band (topk.dot_error_bound's _CAST_SITES term) covers."""
+    qs, ps = _cast_for(q, precision), _cast_for(p, precision)
+    qn = jnp.sum(qs * qs, axis=-1, dtype=jnp.float32)
+    pn = jnp.sum(ps * ps, axis=-1, dtype=jnp.float32)
+    qp = jnp.dot(qs, ps.T, preferred_element_type=jnp.float32)
     return qn[:, None] + pn[None, :] - 2.0 * qp
 
 
 @functools.partial(jax.jit, static_argnames=("k", "m", "exclude_self",
-                                             "qc", "fault"))
+                                             "qc", "fault", "precision"))
 def solve_blocks_xla(pts_il: jax.Array, cid_il: jax.Array,
                      queries: jax.Array, q_ids: jax.Array, k: int, m: int,
                      exclude_self: bool, qc: int,
-                     fault: Optional[str] = None):
+                     fault: Optional[str] = None,
+                     precision: str = "f32"):
     """The brute MXU core (any d): every query scored against every stored
     point in BLOCK-wide bins, approximate top-k + certification, chunked
     over the query axis to bound the score tile.
@@ -186,7 +224,9 @@ def solve_blocks_xla(pts_il: jax.Array, cid_il: jax.Array,
     cert (M,) bool).  The exact diff-arithmetic distances and the final
     (d2, id) ordering are the caller's host epilogue
     (solve._host_rescore) -- see rescore_sorted's docstring for why the
-    byte-identity contract forces them off-device.
+    byte-identity contract forces them off-device.  ``precision`` picks
+    the scoring tier (score_tile) and, through cert_band_precision, the
+    certification band that keeps it sound.
     """
     d = pts_il.shape[1]
     pn = jnp.sum(pts_il * pts_il, axis=1)
@@ -194,13 +234,16 @@ def solve_blocks_xla(pts_il: jax.Array, cid_il: jax.Array,
 
     def chunk(args):
         q_c, qid_c = args
-        s = score_tile(q_c, pts_il)
+        s = score_tile(q_c, pts_il, precision)
         drop = cid_il[None, :] < 0
         if exclude_self:
             drop = drop | (cid_il[None, :] == qid_c[:, None])
         s = jnp.where(drop, jnp.inf, s)
+        # f32 norms for the BAND even when scoring casts down: the band's
+        # (qn + pn_max) is an analytic envelope, not a scored quantity
         qn = jnp.sum(q_c * q_c, axis=1)
-        err_b = dot_error_bound(qn, pn_max, d)
+        err_b = dot_error_bound(qn, pn_max, d,
+                                cert_band_precision(precision, fault))
         ids_b = jnp.broadcast_to(cid_il[None, :], s.shape)
         sel_i, sel_s, cert = block_fold(s, ids_b, k, m, err_b, fault)
         # a dropped/pad candidate can ride out of the fold carrying a REAL
@@ -233,7 +276,8 @@ def class_eligible(qcap: int, ccap: int) -> bool:
 def grid_class_topk(points: jax.Array, starts: jax.Array,
                     counts: jax.Array, own_cells: jax.Array,
                     cand_cells: jax.Array, qcap: int, k: int, ccap: int,
-                    exclude_self: bool, recall_target: float):
+                    exclude_self: bool, recall_target: float,
+                    precision: str = "f32"):
     """One adaptive class's self-solve through the MXU scorer: CSR-packed
     queries x candidate boxes scored as blocked matmuls, the TPU-KNN fold,
     diff-arithmetic rescoring, and NaN-decertification.
@@ -246,6 +290,10 @@ def grid_class_topk(points: jax.Array, starts: jax.Array,
     exact fallback.  At recall_target=1.0 the fold is exhaustive and the
     NaN only fires on dot-arithmetic boundary ambiguity (topk.py), keeping
     the finalized result byte-identical to the elementwise path.
+
+    ``precision`` picks the scoring tier: bf16 casts the matmul/norm
+    inputs (f32 accumulation throughout) and certifies against the wider
+    bf16 band, so uncertified rows still resolve exactly downstream.
     """
     n_sc = own_cells.shape[0]
     g = ccap // BLOCK
@@ -274,18 +322,22 @@ def grid_class_topk(points: jax.Array, starts: jax.Array,
         co_c = jnp.take(co_c, il, axis=1)
         q = jnp.take(points, qi_c, axis=0)           # (rows, qcap, d)
         c = jnp.take(points, ci_c, axis=0)           # (rows, ccap, d)
-        qn = jnp.sum(q * q, axis=-1)
-        cn = jnp.sum(c * c, axis=-1)
-        qp = jnp.einsum("rqd,rcd->rqc", q, c,
+        qs, cs = _cast_for(q, precision), _cast_for(c, precision)
+        qn = jnp.sum(qs * qs, axis=-1, dtype=jnp.float32)
+        cn = jnp.sum(cs * cs, axis=-1, dtype=jnp.float32)
+        qp = jnp.einsum("rqd,rcd->rqc", qs, cs,
                         preferred_element_type=jnp.float32)
         s = qn[:, :, None] + cn[:, None, :] - 2.0 * qp
         drop = ~co_c[:, None, :]
         if exclude_self:
             drop = drop | (ci_c[:, None, :] == qi_c[:, :, None])
         s = jnp.where(drop, jnp.inf, s)
-        pn_max = jnp.max(jnp.where(co_c, cn, -jnp.inf), initial=0.0,
+        # band inputs in f32 regardless of scoring tier (analytic envelope)
+        qn_f = jnp.sum(q * q, axis=-1)
+        cn_f = jnp.sum(c * c, axis=-1)
+        pn_max = jnp.max(jnp.where(co_c, cn_f, -jnp.inf), initial=0.0,
                          axis=(1,), keepdims=True)  # (rows, 1) per-class-row
-        err_b = dot_error_bound(qn, pn_max, d)
+        err_b = dot_error_bound(qn_f, pn_max, d, precision)
         ids_b = jnp.broadcast_to(ci_c[:, None, :], s.shape)
         sel_i, sel_s, cert = block_fold(s, ids_b, k, m, err_b)
         ids_o, d2_o = rescore_sorted(points, q, sel_i, sel_s)

@@ -40,9 +40,10 @@ _FAULT_ENV = "KNTPU_MXU_FAULT"
 
 
 def parse_fault(spec: Optional[str] = None) -> Optional[str]:
-    """The seeded-fault knob (``KNTPU_MXU_FAULT=drop-block|skip-certify``);
-    unknown values refuse loudly -- a typo'd fault must never silently run
-    a clean campaign that 'proves' the detectors fire."""
+    """The seeded-fault knob
+    (``KNTPU_MXU_FAULT=drop-block|skip-certify|narrow-bound``); unknown
+    values refuse loudly -- a typo'd fault must never silently run a clean
+    campaign that 'proves' the detectors fire."""
     spec = os.environ.get(_FAULT_ENV, "") if spec is None else spec
     spec = (spec or "").strip()
     if not spec:
@@ -76,6 +77,10 @@ class MxuResult:
     m: int
     n_blocks: int
     backend: str  # 'pallas' | 'xla' | 'elementwise'
+    # scoring tier the selection ran at ('f32' | 'bf16'); certified rows
+    # are exact at EVERY tier (the per-precision band, topk.py), the tier
+    # only moves speed and the certified fraction.  Bench rows stamp it.
+    precision: str = "f32"
 
 
 def _pick_qc(c_pad: int) -> int:
@@ -129,7 +134,8 @@ def _use_kernel(c_pad: int, d_pad: int, k: int, m: int,
 def solve_general(points, k: int = 10, recall_target: float = 1.0,
                   exclude_self: bool = True, refine: str = "brute",
                   queries=None, interpret: bool = False,
-                  scorer: str = "mxu") -> MxuResult:
+                  scorer: str = "mxu", precision: str = "auto",
+                  query_chunk: Optional[int] = None) -> MxuResult:
     """All-points (or external-``queries``) kNN through the brute/MXU route.
 
     ``scorer`` picks the selection engine: ``'mxu'`` (default -- the
@@ -149,14 +155,27 @@ def solve_general(points, k: int = 10, recall_target: float = 1.0,
     approximation with its certification bits -- what the fuzz --approx
     campaign measures recall bounds against and what ``bench.py
     --frontier`` times as the approximate serving mode.
+
+    ``precision`` picks the MXU scoring tier (``'f32'`` | ``'bf16'`` |
+    ``'auto'`` -> f32, config.resolve_precision): bf16 casts the matmul
+    inputs with f32 accumulation and certifies against the wider
+    per-precision band (topk.dot_error_bound), so certified rows stay
+    exact and boundary rows decertify into the same fallback.
+    ``query_chunk`` overrides the XLA core's auto-sized query chunk (the
+    tuner's knob; 8-aligned, clamped to the tile budget); None keeps
+    ``_pick_qc``'s sizing.
     """
-    from ..config import resolve_scorer
+    from ..config import resolve_precision, resolve_scorer
     from ..io import validate_or_raise
 
     if refine not in ("brute", "none"):
         raise InvalidConfigError(
             f"unknown refine {refine!r}: 'brute' or 'none'")
-    scorer = resolve_scorer(scorer, recall_target)
+    scorer = resolve_scorer(scorer, recall_target, precision)
+    try:
+        precision = resolve_precision(precision, scorer)
+    except ValueError as e:
+        raise InvalidConfigError(str(e)) from e
     points = validate_or_raise(points, k=k, dims=None)
     n, d = points.shape
     self_solve = queries is None
@@ -178,7 +197,7 @@ def solve_general(points, k: int = 10, recall_target: float = 1.0,
             neighbors=np.full((m_q, k), -1, np.int32),
             dists_sq=np.full((m_q, k), np.inf, np.float32),
             certified=np.ones((m_q,), bool), uncert_count=0, bound=1.0,
-            m=0, n_blocks=0, backend="xla")
+            m=0, n_blocks=0, backend="xla", precision=precision)
 
     if scorer == "elementwise":
         # the exact elementwise selection (THE baseline the MXU engine's
@@ -200,7 +219,8 @@ def solve_general(points, k: int = 10, recall_target: float = 1.0,
         ids, d2 = _host_rescore(points, queries_v, b_i)
         return MxuResult(neighbors=ids, dists_sq=d2,
                          certified=np.ones((m_q,), bool), uncert_count=0,
-                         bound=1.0, m=0, n_blocks=0, backend="elementwise")
+                         bound=1.0, m=0, n_blocks=0, backend="elementwise",
+                         precision="f32")  # exact diff arithmetic: f32 tier
 
     fault = parse_fault()
     c_pad = -(-n // BLOCK) * BLOCK
@@ -244,11 +264,17 @@ def solve_general(points, k: int = 10, recall_target: float = 1.0,
             sel_i, sel_s, cert_d = select_pallas(
                 _dispatch.stage(qp), _dispatch.stage(qid),  # syncflow: mxu-stage
                 _dispatch.stage(pil), _dispatch.stage(cid_il),  # syncflow: mxu-stage
-                k, m, d, exclude_self, interpret)
+                k, m, d, exclude_self, interpret, precision)
         sel_i, cert_d = sel_i[:m_q], cert_d[:m_q]
         backend = "pallas"
     else:
-        qc = _pick_qc(c_pad)
+        if query_chunk is not None and int(query_chunk) > 0:
+            # tuner override: 8-aligned (sublane floor), capped at the
+            # auto-sizer's tile-budget chunk so a stale plan can't blow
+            # the score-tile budget on a larger problem
+            qc = max(8, min((int(query_chunk) // 8) * 8, _pick_qc(c_pad)))
+        else:
+            qc = _pick_qc(c_pad)
         mq_pad = -(-m_q // qc) * qc
         qpad = np.zeros((mq_pad, d), np.float32)
         qpad[:m_q] = queries_v
@@ -260,7 +286,7 @@ def solve_general(points, k: int = 10, recall_target: float = 1.0,
             sel_i, _sel_s, cert_d = solve_blocks_xla(
                 _dispatch.stage(pts_il), _dispatch.stage(cid_il),  # syncflow: mxu-stage
                 _dispatch.stage(qpad), _dispatch.stage(qid),  # syncflow: mxu-stage
-                k, m, exclude_self, qc, fault)
+                k, m, exclude_self, qc, fault, precision)
         sel_i, cert_d = sel_i[:m_q], cert_d[:m_q]
         backend = "xla"
 
@@ -302,7 +328,7 @@ def solve_general(points, k: int = 10, recall_target: float = 1.0,
             cert[bad] = True
     return MxuResult(neighbors=ids, dists_sq=d2, certified=cert,
                      uncert_count=n_unc, bound=bound, m=m, n_blocks=g,
-                     backend=backend)
+                     backend=backend, precision=precision)
 
 
 def knn(points, k: int = 10, recall_target: float = 1.0) -> np.ndarray:

@@ -59,6 +59,36 @@ _EPS32 = float(np.finfo(np.float32).eps)
 #: certify rows whose selection a rounding swap corrupted.
 _ERR_SAFETY = 4.0
 
+#: Scoring precisions the engines accept, in the order the docs list them.
+#: "auto" is a config-layer alias (resolved before any engine sees it).
+PRECISIONS = ("f32", "bf16")
+
+#: Extra per-coordinate roundoff the SCORING precision adds on top of the
+#: f32 pipeline.  f32 scoring adds nothing (the (d + 8) * eps32 term below
+#: already covers it -- keeping the f32 bound bit-identical to its pre-tier
+#: value, which the byte-identity pins rely on).  bf16 scoring rounds each
+#: matmul input and each norm square to 8 mantissa bits: eps_bf16 = 2^-7.
+#: Hardcoded (not np.finfo(bfloat16)) so this module stays numpy-only.
+_SCORE_EPS = {"f32": 0.0, "bf16": 2.0 ** -7}
+
+#: Rounding-site count for the reduced-precision terms: two input casts and
+#: one product rounding per side of the matmul, plus the two norm squares
+#: -- 6 sites, padded to 8 for slack before _ERR_SAFETY even applies.
+_CAST_SITES = 8.0
+
+
+def check_precision(precision: str) -> str:
+    """Refuse unknown scoring precisions with a typed error.
+
+    A typo must not silently score (or certify) at the wrong precision --
+    the bound family below would pick a KeyError deep in jit tracing
+    otherwise, far from the config that caused it.
+    """
+    if precision not in PRECISIONS:
+        raise ValueError(  # kntpu-ok: bare-valueerror -- host-only module; config layer wraps with InvalidConfigError
+            f"unknown precision {precision!r}; expected one of {PRECISIONS}")
+    return precision
+
 
 def bins_for(recall_target: float, k: int) -> int:
     """Kept-slot count L whose TPU-KNN bound meets ``recall_target``:
@@ -98,7 +128,7 @@ def recall_bound(k: int, n_blocks: int, m: int) -> float:
     return max(0.0, 1.0 - loss)
 
 
-def dot_error_bound(qn, pn_max, d: int):
+def dot_error_bound(qn, pn_max, d: int, precision: str = "f32"):
     """Per-row upper bound B on |dot-form score - true squared distance|.
 
     The dot identity subtracts two O(|q|^2 + |p|^2) quantities to produce an
@@ -107,8 +137,23 @@ def dot_error_bound(qn, pn_max, d: int):
     distance.  (d + 8) counts the reduction depth (d-term dot product plus
     the norm sums and the final combine); _ERR_SAFETY covers reassociation.
     Works elementwise on arrays (qn per row, pn_max a scalar or row-shaped).
+
+    Per-precision family: reduced-precision scoring keeps f32 ACCUMULATION
+    (``preferred_element_type=f32`` on every MXU op), so the reduction-depth
+    term stays at eps32 -- only the input casts and per-lane products round
+    at the scoring precision.  Each such site errs by at most
+    ``eps_prec * |q_i * p_i|`` and Cauchy-Schwarz folds the coordinate sums
+    back into the same ``(qn + pn_max)`` envelope (``sum |q_i p_i| <=
+    |q||p| <= (qn + pn_max) / 2``), giving the additive ``_CAST_SITES *
+    eps_prec`` term.  For f32 the term is exactly 0.0, keeping this bound
+    BIT-IDENTICAL to the pre-family value (the byte-identity pins depend on
+    it); for bf16 the band widens ~465x at d=3, decertifying rows into the
+    existing exact-fallback sync -- soundness is free, only the certified
+    fraction moves.
     """
-    return _ERR_SAFETY * (d + 8) * _EPS32 * (qn + pn_max)
+    check_precision(precision)
+    return (_ERR_SAFETY * ((d + 8) * _EPS32 + _CAST_SITES * _SCORE_EPS[precision])
+            * (qn + pn_max))
 
 
 def interleave_slots(n_slots: int) -> np.ndarray:

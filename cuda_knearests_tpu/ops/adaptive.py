@@ -639,7 +639,7 @@ def _dense_query_topk(points: jax.Array, starts: jax.Array, counts: jax.Array,
 def _class_flat(points: jax.Array, starts: jax.Array, counts: jax.Array,
                 cp: ClassPlan, k: int, exclude_self: bool, tile: int,
                 interpret: bool, kernel: str = "kpass",
-                recall_target: float = 1.0):
+                recall_target: float = 1.0, precision: str = "f32"):
     """Route one class's self-solve to its solver.  Returns the solver's
     RAW output flattened 1-D (Sc * qcap_pad * k elements): pallas emits
     (Sc, k, qcap) order, dense/streamed/mxu emit (Sc*qcap, k) order -- the
@@ -653,7 +653,7 @@ def _class_flat(points: jax.Array, starts: jax.Array, counts: jax.Array,
 
         fd, fi = grid_class_topk(points, starts, counts, cp.own, cp.cand,
                                  cp.qcap_pad, k, cp.ccap, exclude_self,
-                                 recall_target)
+                                 recall_target, precision)
         return fd.reshape(-1), fi.reshape(-1)
     if cp.route == "dense":
         fd, fi = _dense_self(points, starts, counts, cp.own, cp.cand,
@@ -713,7 +713,7 @@ def _pallas_class(points: jax.Array, starts: jax.Array, counts: jax.Array,
 def _class_rows(points: jax.Array, starts: jax.Array, counts: jax.Array,
                 cp: ClassPlan, k: int, exclude_self: bool, tile: int,
                 interpret: bool, kernel: str = "kpass",
-                recall_target: float = 1.0):
+                recall_target: float = 1.0, precision: str = "f32"):
     """One class's self-solve as ROW-MAJOR (Sc * qcap_pad, k) dists/ids --
     the scatter-epilogue twin of _class_flat.  pallas classes go through
     pallas_solve._topk_rows_or_transpose (the shared eligibility gate:
@@ -732,14 +732,15 @@ def _class_rows(points: jax.Array, starts: jax.Array, counts: jax.Array,
             qx, qy, qz, cx, cy, cz, qid3, cid3, cp.qcap_pad, cp.ccap, k,
             exclude_self, interpret, q_ok, resolve_kernel(kernel, k, cp.ccap))
     fd, fi = _class_flat(points, starts, counts, cp, k, exclude_self, tile,
-                         interpret, kernel, recall_target)
+                         interpret, kernel, recall_target, precision)
     return fd.reshape(-1, k), fi.reshape(-1, k)
 
 
 def _scatter_classes(points: jax.Array, starts: jax.Array, counts: jax.Array,
                      classes: Tuple[ClassPlan, ...], n_rows: int, k: int,
                      exclude_self: bool, tile: int, interpret: bool,
-                     kernel: str = "kpass", recall_target: float = 1.0):
+                     kernel: str = "kpass", recall_target: float = 1.0,
+                     precision: str = "f32"):
     """Scatter epilogue: every class's row-major rows land in the final
     (n_rows, k) buffers through its prepare-time forward map (ClassPlan.tgt,
     pad slots -> dropped sentinel).  Replaces the gather epilogue's
@@ -758,7 +759,7 @@ def _scatter_classes(points: jax.Array, starts: jax.Array, counts: jax.Array,
                 "None); rebuild it or use epilogue='gather'")
         rows_d, rows_i = _class_rows(points, starts, counts, cp, k,
                                      exclude_self, tile, interpret, kernel,
-                                     recall_target)
+                                     recall_target, precision)
         out_d = out_d.at[cp.tgt].set(rows_d, mode="drop")
         out_i = out_i.at[cp.tgt].set(rows_i, mode="drop")
     return out_d, out_i
@@ -767,13 +768,13 @@ def _scatter_classes(points: jax.Array, starts: jax.Array, counts: jax.Array,
 @functools.partial(jax.jit, static_argnames=("n", "k", "exclude_self",
                                              "domain", "interpret", "tile",
                                              "kernel", "epilogue",
-                                             "recall_target"))
+                                             "recall_target", "precision"))
 def _solve_adaptive(points: jax.Array, starts: jax.Array, counts: jax.Array,
                     classes: Tuple[ClassPlan, ...], inv_row: jax.Array,
                     inv_box: jax.Array, n: int, k: int, exclude_self: bool,
                     domain: float, interpret: bool, tile: int,
                     kernel: str = "kpass", epilogue: str = "gather",
-                    recall_target: float = 1.0):
+                    recall_target: float = 1.0, precision: str = "f32"):
     """One program = the whole class-partitioned solve: every class launch,
     the device-resident (n, k) assembly, and the certificate -- the solve
     dispatches as ONE async call and syncs nowhere (api._finalize does the
@@ -786,12 +787,13 @@ def _solve_adaptive(points: jax.Array, starts: jax.Array, counts: jax.Array,
     if epilogue == "scatter":
         row_d, row_i = _scatter_classes(
             points, starts, counts, classes, n, k,
-            exclude_self, tile, interpret, kernel, recall_target)
+            exclude_self, tile, interpret, kernel, recall_target, precision)
     else:
         flats_d, flats_i = [], []
         for cp in classes:
             fd, fi = _class_flat(points, starts, counts, cp, k, exclude_self,
-                                 tile, interpret, kernel, recall_target)
+                                 tile, interpret, kernel, recall_target,
+                                 precision)
             flats_d.append(fd)
             flats_i.append(fi)
         all_d, all_i = _rows2d(flats_d, flats_i, classes, k)
@@ -829,7 +831,7 @@ def solve_adaptive(grid: GridHash, cfg: KnnConfig,
             plan.inv_row, plan.inv_box, plan.n_points, cfg.k,
             cfg.exclude_self, grid.domain, cfg.interpret, cfg.stream_tile,
             cfg.effective_kernel(), cfg.resolved_epilogue(),
-            float(cfg.recall_target))
+            float(cfg.recall_target), cfg.resolved_precision())
     return KnnResult(neighbors=nbr, dists_sq=d2, certified=cert,
                      uncert_count=n_unc)
 

@@ -587,10 +587,11 @@ class ShardedKnnProblem:
                 config: Optional[KnnConfig] = None,
                 mesh: Optional[Mesh] = None,
                 dim: Optional[int] = None) -> "ShardedKnnProblem":
+        from ..api import _resolve_tuned_for
         from ..config import grid_dim_for
         from ..io import validate_or_raise
 
-        config = config or KnnConfig()
+        config = _resolve_tuned_for(config or KnnConfig(), points)
         if config.backend == "oracle":
             raise InvalidConfigError(
                 "backend='oracle' is a single-chip host engine; the sharded "

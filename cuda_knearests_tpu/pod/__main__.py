@@ -228,6 +228,7 @@ def _bench(points_per_chip: int, k: int) -> int:
         "points_per_chip": points_per_chip, "n_points": n,
         "solve_s": round(s, 4),
         "recall": round(recall, 6),
+        "precision": pp.config.resolved_precision(),
         "backend": pp.config.backend,
         "ring_depth": pp.meta.steps,
         "halo_bytes": pp.meta.halo_bytes(),

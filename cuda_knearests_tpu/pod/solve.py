@@ -129,12 +129,12 @@ class PodKnnProblem:
                 config: Optional[KnnConfig] = None,
                 mesh: Optional[Mesh] = None,
                 dim: Optional[int] = None) -> "PodKnnProblem":
-        from ..api import _config_adaptive_eligible
+        from ..api import _config_adaptive_eligible, _resolve_tuned_for
         from ..config import grid_dim_for
         from ..io import validate_or_raise
         from .stream import auto_devices
 
-        config = config or KnnConfig()
+        config = _resolve_tuned_for(config or KnnConfig(), points)
         if config.backend == "oracle":
             raise InvalidConfigError(
                 "backend='oracle' is a single-chip host engine; the pod "

@@ -434,8 +434,27 @@ def _env_cache_cap() -> int:
 # Process-wide executable cache (the external-query chunk pipeline's and the
 # serving executor's compiled launches live here; see ops/query.py and
 # serve/).  Entry cap: KNTPU_EXEC_CACHE_CAP, default
-# config.DEFAULT_EXEC_CACHE_ENTRIES.
+# config.DEFAULT_EXEC_CACHE_ENTRIES.  Its disk-persisted sibling is the
+# tuned-plan store (tune/store.py): compiled executables cache per process,
+# tuned launch PLANS persist per device kind -- tuned_plan_stats() below
+# surfaces its counters next to these.
 EXEC_CACHE = ExecutableCache(maxsize=_env_cache_cap())
+
+
+def tuned_plan_stats() -> dict:
+    """Counters of the active tuned-plan store (tune/store.py), or {} when
+    the tuner was never activated.  Resolved through sys.modules so
+    importing dispatch never drags the tune package in -- the store is the
+    ExecutableCache's sibling on the stats surface, not a dependency."""
+    import sys
+
+    mod = sys.modules.get("cuda_knearests_tpu.tune.store")
+    if mod is None:
+        return {}
+    try:
+        return mod.stats_dict()
+    except Exception:  # noqa: BLE001 -- stats are observability; their failure must never fail a caller
+        return {}
 
 
 # -- CPU sync-budget smoke (scripts/check.sh) ---------------------------------

@@ -1,0 +1,31 @@
+"""Measured-cost autotuner: search the launch-plan space per device kind,
+persist winners, resolve them back into configs (DESIGN.md section 21).
+
+Two halves:
+
+* :mod:`~cuda_knearests_tpu.tune.store` -- the schema-versioned tuned-plan
+  store: winners keyed by (device kind, problem signature), LRU-bounded
+  (``KNTPU_TUNE_CACHE_CAP``), persisted as one JSON file
+  (``KNTPU_TUNE_STORE``) that REFUSES stale schemas instead of silently
+  diffing them.  The ExecutableCache's disk-persisted sibling
+  (runtime/dispatch.py).
+* :mod:`~cuda_knearests_tpu.tune.search` -- the searcher: candidate plans
+  (scorer x precision x query_chunk; the fold's G/m ride ``recall_target``)
+  measured against DEVICE time under a profiler capture
+  (obs/device.profile_window) and wall time otherwise, provenance stamped
+  (``objective_source``), with the one-sync contract asserted per trial
+  window (``sync_bound_ok``).
+
+Resolution happens through exactly one seam -- ``config.resolve_tuned`` --
+used by api.prepare, the sharded/pod prepares, and ``bench.py
+--frontier``; a second search of the same signature hits the store and
+re-searches nothing.
+
+CLI: ``python -m cuda_knearests_tpu.tune --n 20000 --k 10 --rt 0.9
+--store /tmp/plans.json`` (scripts/sweep.py forwards here).
+"""
+
+from .search import candidate_plans, measure_plan, search  # noqa: F401
+from .store import (STORE_ENV, StaleTuneStoreError, TunedPlanStore,  # noqa: F401
+                    get_default_store, lookup_plan, plan_signature,
+                    set_default_store)

@@ -1,0 +1,179 @@
+"""The measured-cost plan searcher (DESIGN.md section 21).
+
+Objective law: a candidate plan's cost is what the hardware actually
+spends on one solve of the problem -- DEVICE time
+(obs.device.profile_window's attributed ``device_total_ms``) when a
+profiler capture is requested and available, WALL time otherwise; which
+one measured is stamped on every row (``objective_source``), never
+guessed at read time.  Wall time is taken as the min over ``repeats``
+post-compile iterations (the bench harness's discipline); solve calls
+return host-resident results, so the timer needs no extra sync of its
+own.
+
+Search space (v1): ``scorer`` x ``precision`` x ``query_chunk``.  The
+fold's block count G and per-block m ride ``recall_target`` (they are
+derived, not free -- topk.per_block_m), and grid-route knobs (epilogue,
+class capacities) are carried by the plan schema but left to their
+resolved defaults until a grid-route driver exists; the store schema and
+the resolve_tuned seam already speak them (store.RESOLVABLE_KEYS).
+
+Sync discipline: each trial iteration (:func:`_run_trial`, the syncflow
+window 'tune-trial' entry) is ONE ``mxu.solve.solve_general`` call whose
+host-boundary traffic is the mxu-brute window's -- ``1 + fb <= 2`` syncs,
+statically proven (analysis/syncflow.py) and re-asserted at runtime per
+trial from the dispatch counters (``sync_bound_ok`` on every row): the
+search loop itself leaks zero mid-search host syncs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime import dispatch as _dispatch
+from . import store as _store
+
+#: query_chunk candidates (None = the auto-sizer); 8-aligned by contract.
+_QUERY_CHUNKS = (None, 128, 512)
+
+
+def candidate_plans(recall_target: float,
+                    budget: Optional[int] = None) -> List[dict]:
+    """The v1 plan space, cheapest-to-compile first: the MXU engine at
+    every precision tier across query-chunk candidates, plus the
+    elementwise engine as the exact baseline where it is admissible
+    (recall_target 1.0 -- it cannot honor an approximation budget).
+    ``budget`` truncates (>= 1 kept): a tiny-budget smoke still races at
+    least one plan, it just races fewer."""
+    plans: List[dict] = []
+    for precision in ("f32", "bf16"):
+        for qc in _QUERY_CHUNKS:
+            plan = {"scorer": "mxu", "precision": precision}
+            if qc:
+                plan["query_chunk"] = qc
+            plans.append(plan)
+    if float(recall_target) >= 1.0:
+        plans.append({"scorer": "elementwise", "precision": "f32"})
+    if budget is not None:
+        plans = plans[: max(1, int(budget))]
+    return plans
+
+
+def _run_trial(points: np.ndarray, k: int, recall_target: float,
+               plan: dict, interpret: bool = False) -> Tuple[object, float, int]:
+    """One measured trial iteration: ONE brute/MXU solve of the problem
+    under ``plan``'s knobs, timed end-to-end, with the dispatch sync
+    counters read back for the per-trial budget assertion.
+
+    This is the syncflow window 'tune-trial' entry: everything the trial
+    touches on the host boundary is solve_general's own mxu-brute window
+    (1 + fb syncs); the timer itself adds nothing (results return as host
+    numpy).  Resetting the process counters makes the window a
+    measurement -- same single-threaded caveat as dispatch.reset_stats.
+
+    Exact problems (recall_target >= 1.0) time the full refine-included
+    answer; approximate problems time ``refine='none'`` -- the serving
+    mode bench --frontier stamps, whose recall the declared-band measure
+    gates."""
+    from ..mxu.solve import solve_general
+
+    refine = "brute" if float(recall_target) >= 1.0 else "none"
+    _dispatch.reset_stats()
+    t0 = time.perf_counter()
+    res = solve_general(points, k=int(k),
+                        recall_target=float(recall_target), refine=refine,
+                        interpret=interpret,
+                        scorer=plan.get("scorer", "mxu"),
+                        precision=plan.get("precision", "auto"),
+                        query_chunk=plan.get("query_chunk"))
+    wall = time.perf_counter() - t0
+    return res, wall, _dispatch.stats().host_syncs
+
+
+def measure_plan(points: np.ndarray, k: int, recall_target: float,
+                 plan: dict, repeats: int = 3, interpret: bool = False,
+                 capture: bool = False) -> dict:
+    """Measure one candidate plan: a warmup iteration (compile, untimed),
+    ``repeats`` timed iterations (min wall), and -- when ``capture`` is
+    requested and the device capture is available -- one captured
+    iteration whose attributed device time REPLACES the objective
+    (``objective_source='device'``).  Capture refusal (another session
+    active, no parseable trace, BENCH_DEVICE_CAPTURE=0) degrades to the
+    wall objective with the skip reason stamped, never a crash."""
+    res, _, _ = _run_trial(points, k, recall_target, plan, interpret)
+    walls: List[float] = []
+    syncs_max = 0
+    for _ in range(max(1, int(repeats))):
+        res, wall, syncs = _run_trial(points, k, recall_target, plan,
+                                      interpret)
+        walls.append(wall)
+        syncs_max = max(syncs_max, syncs)
+    row = dict(plan)
+    row.update(
+        wall_s=min(walls), objective_s=min(walls),
+        objective_source="wall", syncs_per_trial_max=syncs_max,
+        sync_bound_ok=syncs_max <= _dispatch.SYNC_BUDGET,
+        backend=res.backend, bound=res.bound,
+        uncert_count=int(res.uncert_count),
+        precision=res.precision)  # the tier that RAN (resolved, not asked)
+    if capture:
+        from ..obs import device as _device
+
+        if not _device.bench_capture_enabled():
+            row["device_capture_skipped"] = "BENCH_DEVICE_CAPTURE=0"
+        else:
+            try:
+                rep = _device.profile_window(
+                    lambda: _run_trial(points, k, recall_target, plan,
+                                       interpret)[0])
+                dev_ms = rep.decomposition.get("device_total_ms")
+                if dev_ms:
+                    row.update(objective_s=float(dev_ms) / 1e3,
+                               objective_source="device",
+                               device_total_ms=float(dev_ms))
+            except _device.CaptureError as e:
+                row["device_capture_skipped"] = str(e)[:200]
+    return row
+
+
+def search(points: np.ndarray, k: int = 10, recall_target: float = 1.0,
+           device_kind: Optional[str] = None,
+           budget: Optional[int] = None, repeats: int = 3,
+           interpret: bool = False, capture: bool = False,
+           store: Optional[_store.TunedPlanStore] = None,
+           force: bool = False) -> Tuple[dict, List[dict], dict]:
+    """Race the plan space for one problem signature and persist the
+    winner.  Returns ``(winner, rows, meta)``: the winning plan (with
+    objective provenance), every measured trial row, and the search
+    metadata (``searched`` = plans actually raced -- 0 on a store hit,
+    the number the zero-re-search acceptance gate asserts).
+
+    A stored plan for this (device kind, signature) short-circuits the
+    whole race unless ``force``: the second run re-searches NOTHING."""
+    points = np.ascontiguousarray(points, dtype=np.float32)
+    n, d = points.shape
+    sig = _store.plan_signature(n, d, k, recall_target)
+    dev = _store.device_key(device_kind)
+    st = store if store is not None else _store.active_store()
+    if st is not None and not force:
+        cached = st.lookup(sig, dev)
+        if cached is not None:
+            meta = {"signature": sig, "device_kind": dev, "searched": 0,
+                    "store_hit": True}
+            return dict(cached), [], meta
+    rows = [measure_plan(points, k, recall_target, plan, repeats=repeats,
+                         interpret=interpret, capture=capture)
+            for plan in candidate_plans(recall_target, budget)]
+    best = min(rows, key=lambda r: r["objective_s"])
+    winner = {kk: best[kk] for kk in _store.RESOLVABLE_KEYS if kk in best}
+    winner.update(objective_s=best["objective_s"],
+                  objective_source=best["objective_source"],
+                  sync_bound_ok=best["sync_bound_ok"],
+                  signature=sig, device_kind=dev, schema=_store.SCHEMA)
+    if st is not None:
+        st.record(sig, dev, winner)
+    meta = {"signature": sig, "device_kind": dev, "searched": len(rows),
+            "store_hit": False}
+    return winner, rows, meta

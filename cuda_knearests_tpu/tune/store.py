@@ -1,0 +1,267 @@
+"""The schema-versioned tuned-plan store (DESIGN.md section 21).
+
+One entry per (device kind, problem signature): the winning launch plan
+the searcher measured on that hardware, plus its objective provenance.
+Design mirrors the process-wide ExecutableCache (runtime/dispatch.py) --
+LRU entry bound with a junk-tolerant env cap knob, hit/miss/eviction
+counters on a prefixed ``stats_dict`` -- with one addition: entries
+persist as a single JSON file so the NEXT process re-searches nothing.
+
+Refusal discipline (same rule as the analysis baseline): a persisted
+store whose ``schema`` tag is not this writer's, or whose body does not
+parse, raises :class:`StaleTuneStoreError` instead of being silently
+diffed, merged, or dropped -- a stale plan silently applied would
+benchmark (or serve) the wrong launch shape with no trace.
+
+Keying:
+
+* ``plan_signature(n, d, k, recall_target)`` -- the problem-shape key;
+  ``n`` is bucketed to the next power of two so one tuned plan covers a
+  capacity bucket, not one exact cardinality (the same bucketing law as
+  the serving ladder, DESIGN.md section 13).
+* ``device_key()`` -- the hardware key: the accelerator's reported device
+  kind (utils.devinfo.current_device_kind), falling back to the platform
+  name.  Plans NEVER cross device kinds (tests/test_tune.py pins the
+  isolation).
+
+Activation: ``config.resolve_tuned`` consults :func:`active_store` --
+a process store registered via :func:`set_default_store`, else the
+``KNTPU_TUNE_STORE`` env path, else nothing.  With no active store every
+resolve is an exact no-op, so untouched deployments keep byte-identical
+behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+from ..config import DEFAULT_TUNE_CACHE_ENTRIES
+
+#: Schema tag every persisted store carries; bump on ANY layout change.
+SCHEMA = "kntpu-tuned-plans-v1"
+
+#: Env knobs: the persisted-store path and the LRU entry cap.
+STORE_ENV = "KNTPU_TUNE_STORE"
+_CAP_ENV = "KNTPU_TUNE_CACHE_CAP"
+
+#: Plan keys ``config.resolve_tuned`` may fill into a KnnConfig.  The
+#: store accepts extra provenance keys (objective_s, objective_source,
+#: device_kind, ...) but resolution is a closed set -- a future plan key
+#: must be wired through the seam deliberately, never applied by accident.
+RESOLVABLE_KEYS = ("precision", "scorer", "epilogue", "query_chunk")
+
+
+class StaleTuneStoreError(RuntimeError):
+    """A persisted tuned-plan store this writer refuses to read: wrong
+    (or missing) schema tag, or an unparseable body.  Never silently
+    diffed -- delete the file or re-search to migrate."""
+
+
+def env_cache_cap() -> int:
+    """KNTPU_TUNE_CACHE_CAP override for the store's entry cap (>= 1
+    enforced; junk falls back to the default so a typo'd export can never
+    unbound a long-lived process's store) -- the exact contract of
+    dispatch._env_cache_cap."""
+    raw = os.environ.get(_CAP_ENV, "")
+    try:
+        return max(1, int(raw)) if raw else DEFAULT_TUNE_CACHE_ENTRIES
+    except ValueError:
+        return DEFAULT_TUNE_CACHE_ENTRIES
+
+
+def plan_signature(n: int, d: int, k: int, recall_target: float) -> str:
+    """The problem-shape key: n bucketed to the next power of two (one
+    plan per capacity bucket), exact d/k, recall target at repr
+    precision.  Precision is NOT part of the key -- it is part of the
+    ANSWER (the plan decides the tier)."""
+    n = int(n)
+    bucket = 1 << max(0, n - 1).bit_length() if n > 1 else n
+    return f"n{bucket}-d{int(d)}-k{int(k)}-rt{float(recall_target):g}"
+
+
+def device_key(device_kind: Optional[str] = None) -> str:
+    """The hardware half of a store key: the caller's explicit kind, else
+    this process's accelerator (device kind, falling back to platform)."""
+    if device_kind:
+        return str(device_kind)
+    from ..utils.devinfo import current_device_kind
+
+    kind, platform = current_device_kind()
+    return str(kind or platform or "unknown")
+
+
+class TunedPlanStore:
+    """LRU-bounded (device kind, signature) -> plan mapping with optional
+    single-file JSON persistence.  Thread-safe like the ExecutableCache;
+    all counters live on the instance and surface via stats_dict()."""
+
+    def __init__(self, path: Optional[str] = None,
+                 cap: Optional[int] = None):
+        self.path = path
+        self.cap = max(1, int(cap)) if cap else env_cache_cap()
+        self._lock = threading.Lock()
+        self._plans: "OrderedDict[str, dict]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.stores = 0
+        if path and os.path.exists(path):
+            self._load(path)
+
+    @staticmethod
+    def _key(signature: str, device_kind: Optional[str]) -> str:
+        return f"{device_key(device_kind)}|{signature}"
+
+    def _load(self, path: str) -> None:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            raise StaleTuneStoreError(
+                f"tuned-plan store {path!r} is unreadable ({e}); delete it "
+                f"or point {STORE_ENV} elsewhere -- a garbled store is "
+                f"never silently dropped") from e
+        schema = doc.get("schema") if isinstance(doc, dict) else None
+        if schema != SCHEMA:
+            raise StaleTuneStoreError(
+                f"tuned-plan store {path!r} has schema {schema!r}, this "
+                f"writer speaks {SCHEMA!r}; re-search to migrate (stale "
+                f"plans are never silently diffed)")
+        plans = doc.get("plans", {})
+        if not isinstance(plans, dict) or not all(
+                isinstance(v, dict) for v in plans.values()):
+            raise StaleTuneStoreError(
+                f"tuned-plan store {path!r} carries a malformed plans "
+                f"table; re-search to migrate")
+        with self._lock:
+            self._plans = OrderedDict(plans)  # JSON order IS the LRU order
+            while len(self._plans) > self.cap:
+                self._plans.popitem(last=False)
+                self.evictions += 1
+
+    def _save_locked(self) -> None:
+        """Atomic tmp+rename write (a crashed writer must never leave a
+        half-store that the next reader refuses as garbled)."""
+        if not self.path:
+            return
+        doc = {"schema": SCHEMA, "plans": dict(self._plans)}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=False)
+        os.replace(tmp, self.path)
+
+    def lookup(self, signature: str,
+               device_kind: Optional[str] = None) -> Optional[dict]:
+        """The stored plan for this (device, signature), or None.  A hit
+        refreshes LRU recency; counters make the zero-re-search claim
+        assertable (tests/test_tune.py, the check.sh tune smoke)."""
+        key = self._key(signature, device_kind)
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is None:
+                self.misses += 1
+                return None
+            self._plans.move_to_end(key)
+            self.hits += 1
+            return dict(plan)
+
+    def record(self, signature: str, device_kind: Optional[str],
+               plan: dict) -> None:
+        """Insert/refresh a winner and persist.  Evicts LRU past the cap
+        (the knob a long-lived multi-tenant tuner is bounded by)."""
+        if not isinstance(plan, dict):
+            raise TypeError(
+                f"a tuned plan is a dict of knobs, got {type(plan).__name__}")
+        key = self._key(signature, device_kind)
+        with self._lock:
+            self._plans[key] = dict(plan)
+            self._plans.move_to_end(key)
+            self.stores += 1
+            while len(self._plans) > self.cap:
+                self._plans.popitem(last=False)
+                self.evictions += 1
+            self._save_locked()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._plans.clear()
+            self.hits = 0
+            self.misses = 0
+            self.evictions = 0
+            self.stores = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._plans)
+
+    def stats_dict(self) -> dict:
+        with self._lock:
+            out = {"tune_store_hits": self.hits,
+                   "tune_store_misses": self.misses,
+                   "tune_store_evictions": self.evictions,
+                   "tune_store_stores": self.stores,
+                   "tune_store_size": len(self._plans),
+                   "tune_store_cap": self.cap}
+            if self.path:
+                out["tune_store_path"] = self.path
+            return out
+
+
+# -- process-wide activation (the resolve_tuned seam's source) ----------------
+
+_DEFAULT_STORE: Optional[TunedPlanStore] = None
+_PATH_STORES: "dict[str, TunedPlanStore]" = {}
+_REG_LOCK = threading.Lock()
+
+
+def set_default_store(store: Optional[TunedPlanStore]) -> None:
+    """Register (or, with None, clear) the process store resolve_tuned
+    consults ahead of the KNTPU_TUNE_STORE env path."""
+    global _DEFAULT_STORE
+    with _REG_LOCK:
+        _DEFAULT_STORE = store
+
+
+def get_default_store() -> Optional[TunedPlanStore]:
+    return _DEFAULT_STORE
+
+
+def active_store() -> Optional[TunedPlanStore]:
+    """The store resolution consults: the registered process store, else
+    a (cached, per-path) store at the KNTPU_TUNE_STORE env path, else
+    None.  The per-path cache keeps counters meaningful across repeated
+    resolves in one process; a store created for a path is reused even
+    if the file changes underneath (single-writer-per-process law)."""
+    if _DEFAULT_STORE is not None:
+        return _DEFAULT_STORE
+    path = os.environ.get(STORE_ENV, "")
+    if not path:
+        return None
+    ap = os.path.abspath(path)
+    with _REG_LOCK:
+        st = _PATH_STORES.get(ap)
+        if st is None:
+            st = TunedPlanStore(path=ap)
+            _PATH_STORES[ap] = st
+        return st
+
+
+def lookup_plan(signature: str,
+                device_kind: Optional[str] = None) -> dict:
+    """config.resolve_tuned's entry: the active store's plan for this
+    (device, signature), or {} when no store is active / nothing stored."""
+    st = active_store()
+    if st is None:
+        return {}
+    return st.lookup(signature, device_kind) or {}
+
+
+def stats_dict() -> dict:
+    """The active store's counters ({} when none) -- surfaced next to the
+    ExecutableCache's through dispatch.tuned_plan_stats."""
+    st = active_store()
+    return st.stats_dict() if st is not None else {}

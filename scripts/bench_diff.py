@@ -18,6 +18,15 @@ trajectory from a pile of files into a gate:
   ``containment_ok``, ``sync_bound_ok``, ``recall_ok``,
   ``hbm_model_ok``) must never flip true -> false; a current row
   carrying ``error`` gates.
+* **Precision tiers** (ISSUE 16): a matched row whose ``precision``
+  stamp CHANGED gates -- a bf16 throughput diffed against an f32
+  baseline is not a like-for-like comparison, it is a different engine
+  wearing the same row key.  The ``tuned`` stamp is surfaced on the
+  verdict (informational: a tuned plan changing the speed is the
+  autotuner working, not a regression).  ``certified_fraction`` may
+  breathe, but a COLLAPSE (absolute drop > 0.25) gates: that is the
+  shape of a certification-band regression (every row silently falling
+  to the fallback tier), not host noise.
 * **Observability fields** (kntpu-scope): ``hbm_measured_peak``, the
   decomposition's ``device_total_ms``, and the roofline fractions each
   carry their own wide worse-direction band (AUX_FIELD_TOLERANCE) --
@@ -64,6 +73,12 @@ STRICT_BOOLS = ("slo_ok_all", "steady_ok", "failover_ok",
                 "hbm_model_ok")
 
 RECALL_EPS = 1e-3
+
+#: certified_fraction may breathe across hosts, but an absolute drop
+#: beyond this is a COLLAPSE -- the certification-band-regression shape
+#: (a wrongly widened band decertifies everything and the fallback eats
+#: the speedup silently), which must gate.
+CERT_COLLAPSE_DROP = 0.25
 
 #: kntpu-scope observability fields: field -> (tolerated fractional move
 #: in the WORSE direction, which direction is worse).  Device time and
@@ -206,6 +221,29 @@ def compare_row(key: str, base: dict, cur: dict,
         else:
             passed("recall")
 
+    # like-for-like precision discipline: a changed tier under the same
+    # row key is a different engine, not a comparable measurement
+    bp, cp = base.get("precision"), cur.get("precision")
+    if bp and cp:
+        if str(bp) != str(cp):
+            gate("precision", f"scoring tier changed {bp!r} -> {cp!r}: "
+                              f"not a like-for-like comparison")
+        else:
+            passed("precision")
+    if "tuned" in base or "tuned" in cur:
+        # informational: the autotuner applying a plan is not a regression
+        verdict["baseline_tuned"] = base.get("tuned")
+        verdict["current_tuned"] = cur.get("tuned")
+
+    bc, cc = base.get("certified_fraction"), cur.get("certified_fraction")
+    if isinstance(bc, (int, float)) and isinstance(cc, (int, float)):
+        if cc < bc - CERT_COLLAPSE_DROP:
+            gate("certified_fraction",
+                 f"{cc:g} < {bc:g} - {CERT_COLLAPSE_DROP:g}: "
+                 f"certification collapse (band regression shape)")
+        else:
+            passed("certified_fraction")
+
     for flag in STRICT_BOOLS:
         if base.get(flag) is True:
             if cur.get(flag) is not True:
@@ -254,7 +292,7 @@ def diff(baseline: Dict[str, dict], current: Dict[str, dict],
 def seed_regression(rows: Dict[str, dict]) -> Dict[str, dict]:
     """A synthetically regressed copy of ``rows`` (the self-test's
     seeded fault): throughput halved, recall dropped, structural
-    booleans flipped."""
+    booleans flipped, certification collapsed, precision tier swapped."""
     out: Dict[str, dict] = {}
     for key, row in rows.items():
         bad = dict(row)
@@ -265,6 +303,11 @@ def seed_regression(rows: Dict[str, dict]) -> Dict[str, dict]:
         for flag in STRICT_BOOLS:
             if bad.get(flag) is True:
                 bad[flag] = False
+        if isinstance(bad.get("certified_fraction"), (int, float)):
+            bad["certified_fraction"] = 0.0
+        if bad.get("precision"):
+            bad["precision"] = ("bf16" if bad["precision"] == "f32"
+                                else "f32")
         out[key] = bad
     return out
 

@@ -163,10 +163,13 @@ JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
 
 # MXU seeded-fault self-tests (DESIGN.md section 16): each detector must
 # FIRE when its fault is seeded -- drop-block plants a certified-yet-
-# incomplete fold, skip-certify a dead refinement tier; both must yield a
-# banked failure (rc != 0), diverted away from the real corpus.
-echo "== MXU seeded-fault self-tests (drop-block / skip-certify) =="
-for fault in drop-block skip-certify; do
+# incomplete fold, skip-certify a dead refinement tier, narrow-bound
+# certifies bf16-scored rows against the narrow f32 error band (the
+# forgot-to-thread-precision bug; the planted case runs at bf16, ISSUE
+# 16); each must yield a banked failure (rc != 0), diverted away from
+# the real corpus.
+echo "== MXU seeded-fault self-tests (drop-block / skip-certify / narrow-bound) =="
+for fault in drop-block skip-certify narrow-bound; do
     if KNTPU_MXU_FAULT=$fault JAX_PLATFORMS=cpu \
         python -m cuda_knearests_tpu.fuzz --approx --cases 1 --seed 0 \
         >/dev/null 2>&1; then
@@ -176,6 +179,26 @@ for fault in drop-block skip-certify; do
         echo "   ok: '$fault' detected"
     fi
 done
+
+# Autotuner smoke (DESIGN.md section 21): race a tiny plan budget on a
+# small CPU problem into a fresh store, then re-run the SAME signature --
+# the second run must hit the persisted plan and re-search NOTHING
+# ("searched": 0 on the tune-meta line, the zero-re-search acceptance
+# gate; tests/test_tune.py pins the same counter in-process).
+echo "== tune smoke (measured-cost search + zero re-search on store hit, CPU-only) =="
+tune_store="$(mktemp -d)/plans.json"
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.tune \
+    --n 600 --k 5 --budget 2 --repeats 1 --store "$tune_store" \
+    >/dev/null || rc=1
+tune_meta=$(JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.tune \
+    --n 600 --k 5 --budget 2 --repeats 1 --store "$tune_store" \
+    | grep '"kind": "tune-meta"')
+if echo "$tune_meta" | grep -q '"searched": 0'; then
+    echo "   ok: second run re-searched nothing (store hit)"
+else
+    echo "   FAIL: second tune run re-searched (want \"searched\": 0): $tune_meta"
+    rc=1
+fi
 
 # Pod smoke (DESIGN.md section 18): the cell-partitioned index on 4 forced
 # host devices -- partitioned == single-chip tie-aware pin on the 20k

@@ -1,50 +1,40 @@
-"""Tuning sweep on the real chip: solve time vs config knobs (dev tool)."""
-import itertools
+"""DEPRECATED shim: tuning sweeps are owned by the autotuner now.
+
+This script predates the tuned-plan store: it hand-swept three legacy
+grid knobs (dist_method x supercell x sc_batch) with ad-hoc wall clocks,
+printed unparseable rows, and persisted nothing -- every session
+re-swept from scratch.  There is exactly ONE way to tune now (DESIGN.md
+section 21):
+
+    python -m cuda_knearests_tpu.tune --n 20000 --k 10 --rt 1.0 \
+        --store /path/to/plans.json
+
+which races the plan space (scorer x precision x query_chunk) against a
+MEASURED objective (attributed device time under capture, min-wall
+otherwise, provenance stamped per row), persists the winner in the
+schema-versioned tuned-plan store, and re-searches nothing on the next
+run -- the config.resolve_tuned seam then applies the stored plan in
+api.prepare, the sharded/pod prepares, and bench --frontier.  This shim
+forwards there so old muscle memory still lands on the one tune path.
+
+Old positional args (dataset name, k) do not translate: pass --n/--d/--k
+explicitly (the tuner's argparse usage message names them).  All args
+forward verbatim.
+"""
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
 
-from cuda_knearests_tpu.utils.platform import enable_compile_cache
+def main() -> int:
+    print("[sweep] DEPRECATED: consolidated onto the measured-cost "
+          "autotuner -- running `python -m cuda_knearests_tpu.tune`",
+          flush=True)
+    from cuda_knearests_tpu.tune.__main__ import main as tune_main
 
-enable_compile_cache()  # remote-tunnel compiles persist across runs
-import numpy as np
+    return tune_main(sys.argv[1:])
 
-from cuda_knearests_tpu import KnnConfig, KnnProblem
-from cuda_knearests_tpu.io import get_dataset
-from cuda_knearests_tpu.utils.stopwatch import block
 
-name = sys.argv[1] if len(sys.argv) > 1 else "900k_blue_cube.xyz"
-k = int(sys.argv[2]) if len(sys.argv) > 2 else 10
-points = get_dataset(name)
-n = points.shape[0]
-print(f"{name}: n={n} k={k} devices={jax.devices()}")
-
-for method, sc, batch in itertools.product(["diff", "dot"], [4, 6, 8], [64, 256]):
-    cfg = KnnConfig(k=k, dist_method=method, supercell=sc, sc_batch=batch)
-    try:
-        t0 = time.perf_counter()
-        problem = KnnProblem.prepare(points, cfg)
-        prep_s = time.perf_counter() - t0
-        res = problem.solve()
-        block((res.neighbors, res.dists_sq))  # compile+run
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            res = problem.solve()
-            block((res.neighbors, res.dists_sq))
-            times.append(time.perf_counter() - t0)
-        s = min(times)
-        caps = (f"qcap={problem.plan.qcap} ccap={problem.plan.ccap} "
-                f"chunks={problem.plan.n_chunks}" if problem.plan else
-                "classes=" + ",".join(
-                    f"{c.route}:{c.qcap_pad}x{c.ccap}"
-                    for c in problem.aplan.classes))
-        print(f"method={method} sc={sc} batch={batch}: solve={s*1e3:8.1f} ms "
-              f"qps={n/s:10.0f} prep={prep_s*1e3:6.0f} ms {caps} "
-              f"cert={float(np.asarray(res.certified).mean()):.4f}")
-    except Exception as e:  # noqa: BLE001 -- sweep rows report failures inline and keep sweeping
-        print(f"method={method} sc={sc} batch={batch}: FAILED {type(e).__name__}: {e}")
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,10 @@
-"""Microbench: exact top-k strategies over a (B, Q, C) distance tile (dev tool)."""
+"""Microbench: exact top-k strategies over a (B, Q, C) distance tile (dev tool).
+
+Provenance discipline (ISSUE 16 satellite): the header line stamps the
+platform, device kind, and scoring precision the numbers were measured
+on -- a top-k timing with no hardware provenance has burned more than
+one session diffing CPU-fallback ms against TPU records.
+"""
 import functools
 import time
 
@@ -7,6 +13,9 @@ import jax.numpy as jnp
 import numpy as np
 
 B, Q, C, K = 64, 232, 1664, 10
+_dev = jax.devices()[0]
+print(f"topk_bench: B={B} Q={Q} C={C} K={K} platform={_dev.platform} "
+      f"device_kind={_dev.device_kind} precision=f32", flush=True)
 rng = np.random.default_rng(0)
 d2 = jnp.asarray(rng.random((B, Q, C), dtype=np.float32))
 ids = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32)[None, None, :], (B, Q, C))

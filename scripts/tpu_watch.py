@@ -150,6 +150,14 @@ def _artifact_good(path: str, allow_partial: bool = False) -> bool:
         if (str(ln.get("unit", "")).startswith("queries/sec")
                 and not isinstance(ln.get("recall"), (int, float))):
             return False
+    # ... and its precision stamp (ISSUE 16 satellite): bf16 rows trade
+    # scoring precision for QPS exactly like frontier rows trade recall,
+    # so a throughput number that does not say which tier scored it is
+    # not comparable like-for-like and must never be banked as a record.
+    for ln in lines:
+        if (str(ln.get("unit", "")).startswith("queries/sec")
+                and not ln.get("precision")):
+            return False
     return all("error" not in ln for ln in lines)
 
 

@@ -294,20 +294,24 @@ def test_parse_fault_refuses_typos(monkeypatch):
 
 # -- seeded faults: each detector must fire -----------------------------------
 
-@pytest.mark.parametrize("fault", ["drop-block", "skip-certify"])
+@pytest.mark.parametrize("fault", ["drop-block", "skip-certify",
+                                   "narrow-bound"])
 def test_seeded_fault_yields_banked_failure(fault, tmp_path, monkeypatch):
     """Detector liveness (the check.sh self-test's in-process twin): the
     planted block-aliased case must fail, minimize, and bank under each
     fault -- and the banked repro must replay CLEAN without the fault
-    (the corpus pins fixes, not failures)."""
+    (the corpus pins fixes, not failures).  narrow-bound runs at bf16:
+    the fault certifies bf16-scored rows against the narrow f32 band, so
+    it only bites when the scoring tier is wider than the band tier."""
     from cuda_knearests_tpu.fuzz.approx import (ApproxCaseSpec,
                                                 _approx_failure,
                                                 load_approx_case,
                                                 run_approx_case)
 
     monkeypatch.setenv("KNTPU_MXU_FAULT", fault)
+    precision = "bf16" if fault == "narrow-bound" else "f32"
     spec = ApproxCaseSpec(generator="block-aliased", seed=3, n=2048, k=10,
-                          recall_target=0.6)
+                          recall_target=0.6, precision=precision)
     f = run_approx_case(spec, bank_dir=str(tmp_path), max_probes=8)
     assert f is not None and f.banked and os.path.exists(f.banked)
     assert f.minimized_n <= f.original_n
@@ -315,7 +319,8 @@ def test_seeded_fault_yields_banked_failure(fault, tmp_path, monkeypatch):
     assert banked["spec"] == spec
     monkeypatch.delenv("KNTPU_MXU_FAULT")
     assert _approx_failure(banked["points"], banked["k"],
-                           banked["recall_target"]) is None
+                           banked["recall_target"],
+                           precision=banked["spec"].precision) is None
 
 
 def test_faulted_run_never_banks_into_real_corpus(monkeypatch):
@@ -371,6 +376,7 @@ def test_approx_corpus_replays_clean(path):
                                                 load_approx_case)
 
     b = load_approx_case(path)
-    got = _approx_failure(b["points"], b["k"], b["recall_target"])
+    got = _approx_failure(b["points"], b["k"], b["recall_target"],
+                          precision=b["spec"].precision)
     assert got is None, (f"{os.path.basename(path)} regressed: "
                          f"{got[0]}: {got[1]}")

@@ -1,0 +1,253 @@
+"""The tuned-plan store + the config.resolve_tuned seam (ISSUE 16,
+DESIGN.md section 21).
+
+Pins the store's refusal and bounding disciplines (schema-version
+refusal, LRU entry cap with the KNTPU_TUNE_CACHE_CAP env knob,
+cross-device-kind isolation), the resolution seam's laws (fills only
+still-default knobs, explicit user choices win, exact no-op with no
+active store -- WITHOUT importing the tuner), the zero-re-search
+acceptance gate (second search of the same signature hits the store and
+races nothing, counter-asserted), and the headline correctness claim: a
+tuned prepare at recall_target=1.0 answers byte-identically to the
+untuned one.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu.config import KnnConfig, resolve_tuned
+from cuda_knearests_tpu.io import generate_blue_noise
+from cuda_knearests_tpu.tune.store import (SCHEMA, StaleTuneStoreError,
+                                           TunedPlanStore, device_key,
+                                           plan_signature,
+                                           set_default_store)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_store(monkeypatch):
+    """Every test starts with NO active store (the env knob and the
+    process registration both cleared) and leaves none behind."""
+    monkeypatch.delenv("KNTPU_TUNE_STORE", raising=False)
+    set_default_store(None)
+    yield
+    set_default_store(None)
+
+
+# -- plan_signature ------------------------------------------------------------
+
+def test_plan_signature_buckets_n_pow2():
+    assert plan_signature(20_000, 3, 10, 1.0) == "n32768-d3-k10-rt1"
+    assert plan_signature(32_768, 3, 10, 1.0) == "n32768-d3-k10-rt1"
+    assert plan_signature(32_769, 3, 10, 1.0) == "n65536-d3-k10-rt1"
+    assert plan_signature(500, 3, 5, 0.8) == "n512-d3-k5-rt0.8"
+    # precision is NOT part of the key: it is part of the answer
+    assert "bf16" not in plan_signature(500, 3, 5, 0.8)
+
+
+# -- schema-version refusal -----------------------------------------------------
+
+def test_store_refuses_stale_schema(tmp_path):
+    p = tmp_path / "plans.json"
+    p.write_text(json.dumps({"schema": "kntpu-tuned-plans-v0",
+                             "plans": {}}))
+    with pytest.raises(StaleTuneStoreError, match="schema"):
+        TunedPlanStore(path=str(p))
+
+
+def test_store_refuses_missing_schema_and_garbage(tmp_path):
+    p = tmp_path / "plans.json"
+    p.write_text(json.dumps({"plans": {}}))
+    with pytest.raises(StaleTuneStoreError):
+        TunedPlanStore(path=str(p))
+    p.write_text("{not json")
+    with pytest.raises(StaleTuneStoreError, match="unreadable"):
+        TunedPlanStore(path=str(p))
+    p.write_text(json.dumps({"schema": SCHEMA, "plans": {"k": "not-a-dict"}}))
+    with pytest.raises(StaleTuneStoreError, match="malformed"):
+        TunedPlanStore(path=str(p))
+
+
+def test_store_round_trips_with_current_schema(tmp_path):
+    p = tmp_path / "plans.json"
+    st = TunedPlanStore(path=str(p))
+    st.record("n512-d3-k5-rt1", "testkind", {"precision": "bf16"})
+    doc = json.loads(p.read_text())
+    assert doc["schema"] == SCHEMA
+    st2 = TunedPlanStore(path=str(p))
+    assert st2.lookup("n512-d3-k5-rt1", "testkind") == {"precision": "bf16"}
+
+
+# -- LRU bound + env cap knob ---------------------------------------------------
+
+def test_store_lru_eviction_order():
+    st = TunedPlanStore(cap=2)
+    st.record("sig-a", "kind", {"scorer": "mxu"})
+    st.record("sig-b", "kind", {"scorer": "mxu"})
+    assert st.lookup("sig-a", "kind") is not None  # refreshes a's recency
+    st.record("sig-c", "kind", {"scorer": "mxu"})  # evicts b (LRU), not a
+    assert st.lookup("sig-b", "kind") is None
+    assert st.lookup("sig-a", "kind") is not None
+    assert st.lookup("sig-c", "kind") is not None
+    assert st.evictions == 1 and len(st) == 2
+
+
+def test_store_cap_env_knob(monkeypatch):
+    monkeypatch.setenv("KNTPU_TUNE_CACHE_CAP", "1")
+    st = TunedPlanStore()
+    st.record("sig-a", "kind", {"scorer": "mxu"})
+    st.record("sig-b", "kind", {"scorer": "mxu"})
+    assert len(st) == 1 and st.evictions == 1
+    # junk falls back to the default instead of unbounding the store
+    monkeypatch.setenv("KNTPU_TUNE_CACHE_CAP", "banana")
+    from cuda_knearests_tpu.config import DEFAULT_TUNE_CACHE_ENTRIES
+    assert TunedPlanStore().cap == DEFAULT_TUNE_CACHE_ENTRIES
+
+
+# -- cross-device-kind isolation ------------------------------------------------
+
+def test_plans_never_cross_device_kinds():
+    st = TunedPlanStore()
+    sig = "n512-d3-k5-rt1"
+    st.record(sig, "TPU v4", {"precision": "bf16", "query_chunk": 512})
+    assert st.lookup(sig, "TPU v5e") is None
+    assert st.lookup(sig, "TPU v4") == {"precision": "bf16",
+                                        "query_chunk": 512}
+    assert device_key("TPU v4") == "TPU v4"  # explicit kind passes through
+
+
+# -- the resolve_tuned seam -----------------------------------------------------
+
+def test_resolve_tuned_noop_without_active_store():
+    cfg = KnnConfig(k=5)
+    out = resolve_tuned(cfg, "n512-d3-k5-rt1")
+    assert out is cfg  # identity, not just equality
+
+
+def test_resolve_tuned_inactive_never_imports_tune(tmp_path):
+    """The activation check must answer 'no store' WITHOUT importing the
+    tuner -- untouched deployments pay zero import cost.  Run in a fresh
+    interpreter: this suite itself imports tune.store."""
+    import subprocess
+
+    code = (
+        "import sys\n"
+        "from cuda_knearests_tpu.config import KnnConfig, resolve_tuned\n"
+        "cfg = KnnConfig(k=5)\n"
+        "assert resolve_tuned(cfg, (500, 3)) is cfg\n"
+        "assert 'cuda_knearests_tpu.tune.store' not in sys.modules\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("KNTPU_TUNE_STORE", None)
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+
+
+def test_resolve_tuned_fills_only_auto_fields():
+    st = TunedPlanStore()
+    sig = plan_signature(500, 3, 5, 1.0)
+    st.record(sig, device_key(), {"precision": "bf16", "scorer": "mxu",
+                                  "query_chunk": 128})
+    set_default_store(st)
+    out = resolve_tuned(KnnConfig(k=5), sig)
+    assert (out.precision, out.scorer, out.query_chunk) == \
+        ("bf16", "mxu", 128)
+    # an explicit user choice ALWAYS wins over the tuned plan
+    out2 = resolve_tuned(KnnConfig(k=5, precision="f32", query_chunk=64),
+                         sig)
+    assert (out2.precision, out2.query_chunk) == ("f32", 64)
+    assert out2.scorer == "mxu"  # still-auto knob: filled
+    # tuple signatures convert through plan_signature (k/rt off the cfg)
+    out3 = resolve_tuned(KnnConfig(k=5), (500, 3))
+    assert out3.precision == "bf16"
+
+
+def test_resolve_tuned_env_path_store(tmp_path, monkeypatch):
+    p = tmp_path / "plans.json"
+    st = TunedPlanStore(path=str(p))
+    sig = plan_signature(500, 3, 5, 1.0)
+    st.record(sig, device_key(), {"query_chunk": 512})
+    monkeypatch.setenv("KNTPU_TUNE_STORE", str(p))
+    out = resolve_tuned(KnnConfig(k=5), sig)
+    assert out.query_chunk == 512
+
+
+def test_dispatch_surfaces_tune_store_stats():
+    from cuda_knearests_tpu.runtime.dispatch import tuned_plan_stats
+
+    st = TunedPlanStore()
+    st.record("sig", "kind", {"scorer": "mxu"})
+    set_default_store(st)
+    stats = tuned_plan_stats()
+    assert stats.get("tune_store_stores") == 1
+    assert stats.get("tune_store_size") == 1
+
+
+# -- zero re-search (the store-hit acceptance gate) -----------------------------
+
+@pytest.mark.slow
+def test_second_search_hits_store_and_races_nothing():
+    from cuda_knearests_tpu.tune.search import search
+
+    pts = generate_blue_noise(600, seed=11)
+    st = TunedPlanStore()
+    w1, rows1, meta1 = search(pts, k=5, recall_target=1.0, budget=2,
+                              repeats=1, store=st)
+    assert meta1["searched"] == len(rows1) == 2
+    assert meta1["store_hit"] is False
+    assert w1["schema"] == SCHEMA and st.stores == 1
+    w2, rows2, meta2 = search(pts, k=5, recall_target=1.0, budget=2,
+                              repeats=1, store=st)
+    assert meta2["searched"] == 0 and meta2["store_hit"] is True
+    assert rows2 == [] and st.hits == 1
+    # the cached winner IS the recorded winner (resolvable knobs intact)
+    assert {k: w2.get(k) for k in ("scorer", "precision")} == \
+        {k: w1.get(k) for k in ("scorer", "precision")}
+    # every trial row carried its provenance stamps
+    for row in rows1:
+        assert row["objective_source"] in ("wall", "device")
+        assert row["sync_bound_ok"] is True
+        assert row["precision"] in ("f32", "bf16")
+
+
+def test_candidate_plans_space():
+    from cuda_knearests_tpu.tune.search import candidate_plans
+
+    exact = candidate_plans(1.0)
+    approx = candidate_plans(0.8)
+    # mxu x {f32, bf16} x {auto, 128, 512} + the exact elementwise baseline
+    assert len(exact) == 7 and len(approx) == 6
+    assert {p["precision"] for p in exact} == {"f32", "bf16"}
+    assert all(p["scorer"] == "mxu" for p in approx)
+    assert candidate_plans(1.0, budget=0)  # budget floor: >= 1 plan races
+
+
+# -- byte-identical tuned-vs-untuned at the exact tier --------------------------
+
+@pytest.mark.slow
+def test_tuned_prepare_byte_identical_at_exact_tier():
+    """The headline law: at recall_target=1.0 a tuned resolve may change
+    SPEED (tier + chunking) but never the answer -- certification is
+    sound at every precision tier and the exact tier refines to the same
+    canonical (d2, id) ordering."""
+    from cuda_knearests_tpu import KnnProblem
+
+    pts = generate_blue_noise(2000, seed=7)
+    base = KnnProblem.prepare(pts, KnnConfig(k=10))
+    base.solve()
+    want_ids = base.get_knearests_original()
+    want_d2 = base.get_dists_sq()
+
+    st = TunedPlanStore()
+    st.record(plan_signature(2000, 3, 10, 1.0), device_key(),
+              {"precision": "bf16", "query_chunk": 128})
+    set_default_store(st)
+    tuned = KnnProblem.prepare(pts, KnnConfig(k=10))
+    assert tuned.config.precision == "bf16"  # the plan actually applied
+    tuned.solve()
+    assert np.array_equal(tuned.get_knearests_original(), want_ids)
+    assert np.array_equal(tuned.get_dists_sq(), want_d2)

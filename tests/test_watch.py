@@ -157,10 +157,34 @@ def test_artifact_good_requires_recall_stamp(tmp_path):
     assert not tpu_watch._artifact_good(str(p))
     p.write_text(json.dumps({"rc": 0, "lines": [
         {"platform": "tpu", "unit": "queries/sec", "value": 1,
-         "recall": 1.0}]}))
+         "recall": 1.0, "precision": "f32"}]}))
     assert tpu_watch._artifact_good(str(p))
     # non-throughput rows (kernel micro-benches, GB/s) stay exempt, as do
     # partial experiment-matrix artifacts with no result rows to measure
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        {"platform": "tpu", "unit": "GB/s", "value": 1}]}))
+    assert tpu_watch._artifact_good(str(p))
+    p.write_text(json.dumps(unstamped))
+    assert tpu_watch._artifact_good(str(p), True)
+
+
+def test_artifact_good_requires_precision_stamp(tmp_path):
+    """ISSUE 16 satellite: a queries/sec row without its precision stamp
+    cannot be compared like-for-like against bf16 rows that trade scoring
+    precision for QPS, so a full artifact missing it is never banked."""
+    p = tmp_path / "prec.json"
+    unstamped = {"rc": 0, "lines": [
+        {"platform": "tpu", "unit": "queries/sec", "value": 1,
+         "recall": 1.0}]}
+    p.write_text(json.dumps(unstamped))
+    assert not tpu_watch._artifact_good(str(p))
+    for tier in ("f32", "bf16", "f64"):
+        p.write_text(json.dumps({"rc": 0, "lines": [
+            {"platform": "tpu", "unit": "queries/sec", "value": 1,
+             "recall": 1.0, "precision": tier}]}))
+        assert tpu_watch._artifact_good(str(p)), tier
+    # non-throughput rows (kernel micro-benches, GB/s) stay exempt, and
+    # partial experiment-matrix artifacts keep their exemption too
     p.write_text(json.dumps({"rc": 0, "lines": [
         {"platform": "tpu", "unit": "GB/s", "value": 1}]}))
     assert tpu_watch._artifact_good(str(p))
@@ -177,8 +201,8 @@ def test_artifact_good_pod_row_kind(tmp_path):
     record."""
     p = tmp_path / "pod.json"
     good_row = {"platform": "tpu", "unit": "queries/sec/chip", "value": 1,
-                "recall": 1.0, "pod_scaling": True, "halo_bytes": 4096,
-                "ring_depth": 2, "sync_bound_ok": True}
+                "recall": 1.0, "precision": "f32", "pod_scaling": True,
+                "halo_bytes": 4096, "ring_depth": 2, "sync_bound_ok": True}
     p.write_text(json.dumps({"rc": 0, "lines": [good_row]}))
     assert tpu_watch._artifact_good(str(p))
     # halo accounting missing -> refused
@@ -203,7 +227,7 @@ def test_artifact_good_pod_row_kind(tmp_path):
 
 def _capture_row(platform="tpu", **over):
     row = {"platform": platform, "unit": "queries/sec", "value": 1.0,
-           "recall": 1.0,
+           "recall": 1.0, "precision": "f32",
            "device_time_decomposition": {"device_total_ms": 5.0,
                                          "events": 3, "unattributed": 0},
            "hbm_measured_peak": 1000, "hbm_model_ok": True}
@@ -344,7 +368,7 @@ def test_capture_bank_refuses_all_skipped_artifacts(monkeypatch,
     (device_capture_skipped) passes the per-row discipline but must NOT
     bank: a CAPTURE record with zero actual captures is not one."""
     skipped = {"platform": "tpu", "unit": "queries/sec", "value": 1.0,
-               "recall": 1.0,
+               "recall": 1.0, "precision": "f32",
                "device_capture_skipped": "BENCH_DEVICE_CAPTURE=0"}
     argv = _capture_env(monkeypatch, tmp_path, "tpu", rows=[skipped])
     assert tpu_watch.main(argv) == 1
