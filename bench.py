@@ -797,7 +797,11 @@ def bench_frontier() -> list:
 
 _SERVE_SCENARIOS = ("serve_20k_steady", "serve_20k_mutating",
                     "serve_20k_contained_fault", "fleet_4tenant_mix",
-                    "fleet_failover")
+                    "fleet_failover", "rebalance_under_load")
+
+# names routed to _fleet_scenario (everything else is a single-daemon row)
+_FLEET_SCENARIO_NAMES = ("fleet_4tenant_mix", "fleet_failover",
+                         "rebalance_under_load")
 
 
 def _serve_scenario_names() -> list:
@@ -831,13 +835,28 @@ def _fleet_scenario(name: str) -> dict:
     a replica as real child processes on the framed transport, a genuine
     SIGKILL mid-stream, and a machine-checkable ``failover_ok`` (>= 1
     failover, zero lost committed mutations, post-failover answers
-    byte-identical to the rebuild oracle)."""
+    byte-identical to the rebuild oracle).
+
+    ``rebalance_under_load``: the elastic-tier row (DESIGN.md section
+    22) -- one pod-placed tenant behind the same front door, hotspot
+    mutation traffic, and a FORCED live Morton rebalance that rides the
+    measured session.  The row stamps three strict booleans:
+    ``migration_ok`` (>= 1 migration completed AND zero unattributed
+    steady-state recompiles fleet-wide -- index maintenance is carved
+    out into ``elastic_recompiles``), ``p999_ok`` (the pod tenant's
+    p999 stays under BENCH_REBALANCE_P999_BUDGET_MS through the
+    migration, decomposed via latency_decomposition), and
+    ``failover_ok`` (the cross-mesh mid-migration SIGKILL drill:
+    snapshot + committed-log replay, zero lost committed mutations,
+    post-failover answers byte-identical to the rebuild oracle)."""
     from cuda_knearests_tpu.serve.fleet import (TenantLoad,
                                                 default_fleet_builds,
                                                 failover_drill)
     from cuda_knearests_tpu.serve.fleet.frontdoor import FleetDaemon
     from cuda_knearests_tpu.serve.fleet.loadgen import run_fleet_session
 
+    if name == "rebalance_under_load":
+        return _rebalance_scenario()
     if name == "fleet_failover":
         drill = failover_drill(
             n=int(os.environ.get("BENCH_FLEET_FAILOVER_N", "1500")),
@@ -899,6 +918,111 @@ def _fleet_scenario(name: str) -> dict:
     }
 
 
+def _rebalance_scenario() -> dict:
+    """The ``rebalance_under_load`` row: a pod tenant behind the fleet
+    front door, hotspot mutation traffic, a forced live Morton rebalance
+    riding the measured session, and the cross-mesh mid-migration
+    SIGKILL failover drill -- each verdict a strict machine-checked
+    boolean (scripts/bench_diff.py refuses a row where any flips off)."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    from cuda_knearests_tpu.config import ServeFleetConfig
+    from cuda_knearests_tpu.io import generate_uniform
+    from cuda_knearests_tpu.serve.fleet import TenantLoad, \
+        default_fleet_builds
+    from cuda_knearests_tpu.serve.fleet.elastic import mesh_failover_drill
+    from cuda_knearests_tpu.serve.fleet.frontdoor import FleetDaemon
+    from cuda_knearests_tpu.serve.fleet.loadgen import run_fleet_session
+    from cuda_knearests_tpu.serve.fleet.tenants import TenantSpec
+
+    n = int(os.environ.get("BENCH_REBALANCE_N", "2500"))
+    k = 8
+    _dispatch.EXEC_CACHE.clear()
+    builds = default_fleet_builds(n_tenants=3, base_n=n, k=k, seed=13)
+    # the threshold sits above every dense tenant's cloud, so only the
+    # extra tenant lands on the pod rung (same recipe as the
+    # serve.fleet --pod-tenant CLI mode)
+    pod_threshold = n + 1024 * 3
+    cfg = _dc.replace(ServeFleetConfig(),
+                      pod_threshold=pod_threshold, pod_shards=2)
+    builds.append((TenantSpec(name="pod0", k=k),
+                   generate_uniform(pod_threshold + 512, seed=13 + 997)))
+    _watchdog.heartbeat()
+    fleet = FleetDaemon(builds, cfg)
+    _watchdog.heartbeat()
+    reqs = int(os.environ.get("BENCH_REBALANCE_REQUESTS", "60"))
+    # the measured window is QUERY traffic with the migration riding it:
+    # the steady-state recompile law is defined for mutation-free
+    # sessions (loadgen.py), which is what lets migration_ok demand a
+    # strict zero -- the mutation fire arrives as the pre-session
+    # hotspot skew below (and the chaos campaign covers the
+    # mutations-DURING-migration interleavings against the oracle)
+    loads = [TenantLoad(tenant=spec.name, rate=350.0, requests=reqs,
+                        seed=50 + i)
+             for i, (spec, _pts) in enumerate(builds)]
+    # seed hotspot skew (one bulk insert past the compaction threshold,
+    # so the pending delta folds before the measured window), warm the
+    # batch mix's shapes, then start the live migration the measured
+    # session rides
+    el = fleet.tenants["pod0"].elastic
+    rng = np.random.default_rng(29)
+    el.insert((rng.random((cfg.compact_threshold + 64, 3)) * 110.0
+               + 5.0).astype(np.float32))
+    for m in (1, 4, 16, 64):
+        el.query(np.zeros((m, 3), np.float32), k)
+    rebalance_started = bool(el.force_rebalance())
+    summary = run_fleet_session(fleet, loads)
+    _watchdog.heartbeat()
+    drill = mesh_failover_drill(n=900, k=6, ops=26, seed=0, log=None)
+    pod_row = summary["per_tenant"]["pod0"]
+    p999 = pod_row.get("p999_ms")
+    p999_budget = float(os.environ.get(
+        "BENCH_REBALANCE_P999_BUDGET_MS", "2500"))
+    migration_ok = bool(rebalance_started
+                        and summary["migrations_done"] >= 1
+                        and summary["recompiles"] == 0
+                        and summary["exec_cache_enabled"]
+                        and summary["failed_requests"] == 0
+                        and pod_row["served_rows"] > 0)
+    p999_ok = bool(p999 is not None and p999 <= p999_budget)
+    failover_ok = bool(drill["mesh_failover_ok"])
+    return {
+        "config": f"serving fleet [rebalance_under_load]: pod tenant on "
+                  f"uniform:{pod_threshold + 512} (k={k}) behind the "
+                  f"front door, forced live Morton rebalance under "
+                  f"hotspot mutations + mid-migration SIGKILL mesh "
+                  f"failover drill",
+        "value": float(p999) if p999 is not None else -1.0,
+        "unit": "p999_ms",
+        "backend": "fleet",
+        "recall": 1.0,  # exact serving path (certificates + fallback)
+        "precision": "f32",
+        "n_points": pod_threshold + 512,
+        "migration_ok": migration_ok,
+        "p999_ok": p999_ok,
+        "failover_ok": failover_ok,
+        "p999_budget_ms": p999_budget,
+        "rebalance_started": rebalance_started,
+        **{key: summary[key] for key in (
+            "requests", "completed_queries", "failed_requests",
+            "refused_requests", "elapsed_s", "recompiles",
+            "elastic_recompiles", "migrations_done", "fleet_batches",
+            "occupancy_mean", "jain_fairness", "n_tenants",
+            "host_syncs", "exec_cache_hits", "exec_cache_misses",
+            "latency_decomposition")},
+        "pod_tenant": {key: pod_row[key] for key in (
+            "served_rows", "completion", "refused", "sustained_qps",
+            "p50_ms", "p99_ms", "p999_ms", "decomposition")},
+        "mesh_failover": {key: drill[key] for key in (
+            "killed_mid_migration", "mesh_failovers",
+            "committed_mutations", "snapshot_seq", "replay_tail",
+            "zero_lost_committed", "post_failover_byte_identical",
+            "mesh_failover_ok")},
+    }
+
+
 def serve_scenario(name: str) -> dict:
     """One open-loop serving session (serve/, DESIGN.md section 13) as a
     bench row: sustained QPS under Poisson arrivals, p50/p99/p999 latency,
@@ -920,7 +1044,7 @@ def serve_scenario(name: str) -> dict:
 
     if name not in _SERVE_SCENARIOS:
         raise ValueError(f"unknown serve scenario {name!r}")
-    if name.startswith("fleet_"):
+    if name in _FLEET_SCENARIO_NAMES:
         return _fleet_scenario(name)
     points = get_dataset("pts20K.xyz")
     k = 10
@@ -1274,7 +1398,7 @@ def main(argv=None) -> int:
         a_fields = _analysis_fields()
         a_fields.update(_fuzz_fields())
         for name in _serve_scenario_names():
-            job_kind = ("fleet_scenario" if name.startswith("fleet_")
+            job_kind = ("fleet_scenario" if name in _FLEET_SCENARIO_NAMES
                         else "serve_scenario")
             row, failure = sup.run_job(name, {"job": job_kind,
                                               "name": name})

@@ -125,8 +125,10 @@ class Window:
 #   hcap     pod export-block capacity (points per halo block)
 #   ndev     chips in the pod mesh
 #   xchg     1 on the solve that runs the (cached) pod halo exchange
+#   shards   Morton-range shards in an elastic pod index (serve tier)
 PARAMS = ("n", "q", "k", "chunks", "classes", "kern", "fb", "u_pad", "u_q",
-          "rounds", "tomb", "delta", "steps", "hcap", "ndev", "xchg")
+          "rounds", "tomb", "delta", "steps", "hcap", "ndev", "xchg",
+          "shards")
 
 WINDOWS: Dict[str, Window] = {
     # KnnProblem.solve() -- shared by the adaptive and legacy-pack routes:
@@ -297,6 +299,67 @@ WINDOWS: Dict[str, Window] = {
                                 "32*hcap*steps*(ndev - 1)"),
         },
         syncs="1", budget="2"),
+    # Halo RE-exchange (pod/reshard.py, DESIGN.md section 22): a delete
+    # of device-resident pod points restages ONLY the dirty chips' slabs
+    # (bounded by 2*ndev: points + ids per chip) and re-runs the cached
+    # ppermute program IFF a dirty cell sits in its owner's export block.
+    # ZERO host syncs -- staging and ICI never block the host; the
+    # re-exchanged halo is consumed by the NEXT solve/query, whose own
+    # window pays that fetch.
+    "pod-reexchange": Window(
+        entries=("pod.reshard.PodOverlay.delete",),
+        sites={
+            "pod-reexchange-stage": SiteSpec("stage", "2*ndev", "0"),
+            "pod-reexchange-ici": SiteSpec("ici", "xchg",
+                                           "32*hcap*steps*(ndev - 1)"),
+        },
+        syncs="0", budget="0",
+        notes="the dirty-cell overlay invalidates export blocks without "
+              "reading anything back: mutation-side work is pure "
+              "stage + ICI (tests/test_pod.py reconciles per site)"),
+    # Mutating pod query: the base pod query window, plus one fetch iff
+    # the dirty-cell bound could not prune the insert-delta launch.
+    "pod-overlay-query": Window(
+        entries=("pod.reshard.PodOverlay.query",),
+        includes=("pod-query",),
+        sites={
+            "reshard-delta-stage": SiteSpec("stage", "2*delta", "0"),
+            "reshard-delta-query-stage": SiteSpec("stage", "delta",
+                                                  "12*q"),
+            "reshard-delta-final": SiteSpec("fetch", "delta", "8*q*k"),
+        },
+        syncs="1 + delta", budget="2",
+        notes="self.pp.query is attribute dispatch; declared via "
+              "includes and pinned by the reshard oracle tests"),
+    # Mutating pod solve: the base pod solve window plus the same pruned
+    # delta merge over the alive rows (sites shared with the query
+    # window, same claim discipline as query-class-stage).
+    "pod-overlay-solve": Window(
+        entries=("pod.reshard.PodOverlay.solve",),
+        includes=("pod-solve",),
+        sites={
+            "reshard-delta-stage": SiteSpec("stage", "2*delta", "0"),
+            "reshard-delta-query-stage": SiteSpec("stage", "delta",
+                                                  "12*q"),
+            "reshard-delta-final": SiteSpec("fetch", "delta", "8*q*k"),
+        },
+        syncs="1 + delta", budget="2",
+        notes="self.pp.solve is attribute dispatch; declared via "
+              "includes"),
+    # Elastic scatter-gather query (pod/reshard.py ElasticIndex): every
+    # Morton-range shard answers through its OWN serve-overlay window;
+    # the merge is pure host comparisons (zero syncs of its own).  The
+    # bound is therefore the per-shard overlay bound times the shard
+    # count -- the price of exactness under scatter-gather.
+    "elastic-query": Window(
+        entries=("pod.reshard.ElasticIndex.query",),
+        includes=("serve-overlay-query",),
+        sites={},
+        syncs="shards * ((1 + fb) + tomb + delta)",
+        budget="4 * shards",
+        notes="shard.query -> overlay.query is attribute dispatch per "
+              "shard; declared via includes and pinned by the elastic "
+              "byte-identity tests (tests/test_fleet.py)"),
     # One autotuner trial (tune/search.py, DESIGN.md section 21): ONE
     # solve_general call under the candidate plan's knobs -- the trial's
     # entire host boundary IS the mxu-brute window (the timer reads host-
@@ -330,6 +393,10 @@ ROUTE_WINDOWS: Dict[str, str] = {
     "fleet-sidecar": "fleet-sidecar",
     "pod-solve": "pod-solve",
     "pod-query": "pod-query",
+    "pod-reexchange": "pod-reexchange",
+    "pod-overlay-query": "pod-overlay-query",
+    "pod-overlay-solve": "pod-overlay-solve",
+    "elastic-query": "elastic-query",
     "tune-trial": "tune-trial",
 }
 
@@ -401,7 +468,7 @@ def worst_case_env(rounds: int = 64) -> Dict[str, int]:
     """Indicator variables at their maxima -- what the budget proof binds."""
     return dict(fb=1, tomb=1, delta=1, kern=1, rounds=rounds,
                 chunks=8, classes=8, n=1, q=1, k=1, u_pad=1, u_q=1,
-                steps=8, hcap=1, ndev=8, xchg=1)
+                steps=8, hcap=1, ndev=8, xchg=1, shards=4)
 
 
 # -- discovery ----------------------------------------------------------------

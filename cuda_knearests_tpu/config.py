@@ -430,6 +430,14 @@ class ServeFleetConfig:
         over any window in which tenants stay backlogged, served rows per
         tenant differ by at most one quantum plus one batch -- a hot
         tenant provably cannot starve the rest (DESIGN.md section 17).
+      pod_threshold: tenants whose cloud is at least this large serve
+        from an ELASTIC pod-partitioned index (pod/reshard.ElasticIndex:
+        Morton-range shards with live boundary migration, DESIGN.md
+        section 22) instead of one dense daemon.  None (default) disables
+        the pod rung of the placement ladder sidecar -> dense -> pod.
+      pod_shards: initial Morton-range shard count for pod tenants.
+      pod_skew_threshold: population skew (max shard / mean) past which a
+        pod tenant's mutation stream triggers a live rebalance.
     """
 
     min_bucket: int = 8
@@ -440,6 +448,9 @@ class ServeFleetConfig:
     quota_qps: Optional[float] = None
     quota_burst: float = 4096.0
     drr_quantum: int = 64
+    pod_threshold: Optional[int] = None
+    pod_shards: int = 2
+    pod_skew_threshold: float = 3.0
 
     def __post_init__(self):
         if self.min_bucket < 1 or self.max_batch < self.min_bucket:
@@ -455,6 +466,19 @@ class ServeFleetConfig:
         if self.quota_qps is not None and self.quota_qps <= 0:
             raise ValueError(f"quota_qps must be > 0 (or None for "
                              f"unmetered), got {self.quota_qps}")
+        if self.pod_threshold is not None \
+                and self.pod_threshold <= self.sidecar_threshold:
+            raise ValueError(
+                f"pod_threshold must exceed sidecar_threshold (the "
+                f"placement ladder is sidecar -> dense -> pod), got "
+                f"pod_threshold={self.pod_threshold} <= "
+                f"sidecar_threshold={self.sidecar_threshold}")
+        if self.pod_shards < 1:
+            raise ValueError(f"pod_shards must be >= 1, got "
+                             f"{self.pod_shards}")
+        if self.pod_skew_threshold <= 1.0:
+            raise ValueError(f"pod_skew_threshold must be > 1.0, got "
+                             f"{self.pod_skew_threshold}")
 
     def serve_config_for(self, slo: SloClass,
                          k: Optional[int] = None) -> ServeConfig:

@@ -85,6 +85,16 @@ def main(argv=None) -> int:
                          "vs the kd-tree oracle AND the single-chip "
                          "adaptive route, tie-aware; failures minimized "
                          "and banked as *-pod.npz -- see fuzz/pod.py")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the CHAOS campaign instead: --cases seeded "
+                         "op/fault schedules (hotspot skew, forced live "
+                         "rebalance, migration pumps, chip loss, wedged "
+                         "migration, delayed handover) through a pod-"
+                         "tenant fleet front door vs per-tenant rebuild "
+                         "oracles, plus one cross-mesh mid-migration "
+                         "SIGKILL drill; failures ddmin over the op/fault "
+                         "schedule and bank as *-chaos.npz -- see "
+                         "fuzz/chaos.py")
     ap.add_argument("--fof", action="store_true",
                     help="run the FoF campaign instead: --cases clustering "
                          "cases (the same adversarial zoo + seeded linking "
@@ -142,18 +152,20 @@ def main(argv=None) -> int:
                                ("--approx", args.approx),
                                ("--fleet", args.fleet),
                                ("--pod", args.pod),
+                               ("--chaos", args.chaos),
                                ("--mutations", args.mutations is not None))
                if on]
     if len(flavors) > 1:
         ap.error(f"{' and '.join(flavors)} are mutually exclusive campaigns")
-    single_route = args.fof or args.approx or args.fleet or args.pod
+    single_route = (args.fof or args.approx or args.fleet or args.pod
+                    or args.chaos)
     if single_route and args.routes:
         ap.error("--routes applies to the point-case campaign only; the "
-                 "FoF, approx, fleet and pod campaigns each have a single "
-                 "route")
+                 "FoF, approx, fleet, pod and chaos campaigns each have a "
+                 "single route")
     if single_route and args.isolation != "auto":
         ap.error("--isolation applies to the point-case campaign only; "
-                 "FoF, approx, fleet and pod cases run in-process")
+                 "FoF, approx, fleet, pod and chaos cases run in-process")
 
     if args.pod:
         from .pod import run_pod_campaign
@@ -163,6 +175,15 @@ def main(argv=None) -> int:
             n_cases=args.cases, seed=args.seed, budget_s=budget,
             minimize=not args.no_minimize, ndev=n_dev, **kwargs)
         return _finish_campaign(manifest, args, "POD FUZZ FAILED")
+
+    if args.chaos:
+        from .chaos import run_chaos_campaign
+
+        kwargs = {} if args.bank_dir is None else {"bank_dir": args.bank_dir}
+        manifest = run_chaos_campaign(
+            n_cases=args.cases, seed=args.seed, budget_s=budget,
+            minimize=not args.no_minimize, **kwargs)
+        return _finish_campaign(manifest, args, "CHAOS FUZZ FAILED")
 
     if args.fleet:
         from .fleet import run_fleet_campaign

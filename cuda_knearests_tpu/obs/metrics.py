@@ -240,9 +240,14 @@ def metrics_snapshot() -> dict:
 
         out["dispatch"] = _dispatch.stats_dict()
         out["exec_cache"] = _dispatch.EXEC_CACHE.stats_dict()
+        # tuned-plan store counters (PR 14): hit rate of the persisted
+        # measured-cost plans, so --metrics-jsonl and fleet summaries
+        # report it without a dispatch.tuned_plan_stats() side channel
+        out["tuned_plans"] = _dispatch.tuned_plan_stats()
     except Exception as e:  # noqa: BLE001 -- the snapshot must land even if the dispatch layer is mid-teardown
         out["dispatch"] = {"error": f"{type(e).__name__}: {e}"}
         out["exec_cache"] = {}
+        out["tuned_plans"] = {}
     return out
 
 

@@ -28,6 +28,12 @@ Layout:
 * :mod:`.solve`     -- :class:`PodKnnProblem`: prepare / solve / query,
   composing with the PR 9 MXU scorer (``KnnConfig.scorer='mxu'`` with
   per-chip ``recall_target`` pools).
+* :mod:`.reshard`   -- mutation under partitioning (DESIGN.md s22):
+  :class:`PodOverlay` (solve-time halo re-exchange for mutating clouds --
+  dirty-cell deltas restage only the affected chips and re-run the cached
+  ppermute program only when an export block changed) and
+  :class:`ElasticIndex` (the serving-tier Morton-range shards with live
+  boundary migration, behind the fleet front door).
 
 ``python -m cuda_knearests_tpu.pod`` runs the CPU smoke (forced host
 devices): partitioned == single-chip pin, the streamed-prepare budget
@@ -35,6 +41,7 @@ case, and the sync/ICI counter reconciliation -- wired into
 ``scripts/check.sh``.
 """
 
+from .reshard import ElasticIndex, PodOverlay
 from .solve import PodKnnProblem
 
-__all__ = ["PodKnnProblem"]
+__all__ = ["PodKnnProblem", "PodOverlay", "ElasticIndex"]

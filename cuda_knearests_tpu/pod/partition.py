@@ -135,6 +135,7 @@ class PodChipPlan:
     ext_starts: np.ndarray  # (n_ext_cells,) i32 ext-row start per ext cell
     ext_counts: np.ndarray  # (n_ext_cells,) i32 points per ext cell
     export_idx: np.ndarray  # (hcap,) i32 own-region rows to export, -1 pad
+    export_cells: np.ndarray  # sorted global cell ids behind export_idx
     n_local: int            # real points on this chip
     remote_cells: int       # halo cells this chip's boxes reach
     max_owner_dist: int     # ring distance to the farthest needed owner
@@ -430,6 +431,7 @@ def build_pod_plan(points: np.ndarray, ndev: int, cfg: KnnConfig, dim: int,
             classes=tuple(classes), class_of=class_of, row_of=row_of,
             sc_ids=info["sc_ids"], ext_starts=ext_starts,
             ext_counts=ext_counts, export_idx=export_idx,
+            export_cells=exports[d],
             n_local=int(pop[d]), remote_cells=int(remote_cells.size),
             max_owner_dist=max_dist))
 

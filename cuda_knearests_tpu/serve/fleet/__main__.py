@@ -63,6 +63,15 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--replicas", type=int, default=0,
                     help="in-process replicas per dense tenant")
+    ap.add_argument("--pod-tenant", action="store_true",
+                    help="add one pod-placed tenant (cloud above "
+                         "pod_threshold, hotspot mutation mix) and FORCE a "
+                         "live Morton rebalance before the session starts: "
+                         "the migration rides the measured traffic, and "
+                         "--assert-steady must still hold (elastic index "
+                         "maintenance is carved out of the recompile gate; "
+                         "the session additionally requires >= 1 completed "
+                         "migration)")
     ap.add_argument("--assert-steady", action="store_true",
                     help="exit 1 unless >= 2 tenants flushed batches with "
                          "zero fleet-wide steady-state recompiles and a "
@@ -97,15 +106,50 @@ def main(argv=None) -> int:
         builds = default_fleet_builds(
             n_tenants=max(1, args.tenants), base_n=args.points, k=args.k,
             seed=args.seed, replicas=args.replicas)
-        fleet = FleetDaemon(builds)
-        loads = [TenantLoad(tenant=spec.name, rate=args.rate,
-                            requests=args.requests,
-                            mutation_ratio=(args.mutation_ratio
-                                            if not fleet.tenants[
-                                                spec.name].is_sidecar
-                                            else 0.0),
-                            seed=args.seed + 31 * i)
-                 for i, (spec, _) in enumerate(builds)]
+        cfg = None
+        if args.pod_tenant:
+            import dataclasses as _dc
+
+            import numpy as np
+
+            from ...config import ServeFleetConfig
+            from ...io import generate_uniform
+            from .tenants import TenantSpec
+
+            # the threshold sits above every dense tenant's cloud, so
+            # ONLY the extra tenant lands on the pod rung
+            pod_threshold = args.points + 1024 * max(1, args.tenants)
+            cfg = _dc.replace(ServeFleetConfig(),
+                              pod_threshold=pod_threshold, pod_shards=2)
+            builds.append((TenantSpec(name="pod0", k=args.k),
+                           generate_uniform(pod_threshold + 512,
+                                            seed=args.seed + 997)))
+        fleet = FleetDaemon(builds) if cfg is None \
+            else FleetDaemon(builds, cfg)
+        loads = []
+        for i, (spec, _) in enumerate(builds):
+            t = fleet.tenants[spec.name]
+            mr = args.mutation_ratio if not t.is_sidecar else 0.0
+            hotspot = (0.0, 0.12) if t.is_pod and mr > 0 else None
+            loads.append(TenantLoad(tenant=spec.name, rate=args.rate,
+                                    requests=args.requests,
+                                    mutation_ratio=mr, hotspot=hotspot,
+                                    seed=args.seed + 31 * i))
+        if args.pod_tenant:
+            el = fleet.tenants["pod0"].elastic
+            # seed a hotspot skew (one bulk insert past the compaction
+            # threshold, so the delta folds into the base before the
+            # measured window), warm the scatter-gather path at the batch
+            # mix's shapes, then start the live migration the measured
+            # session must ride (queries pump it; the session epilogue
+            # pumps it dry)
+            rng = np.random.default_rng(args.seed + 5)
+            n_hot = cfg.compact_threshold + 64
+            el.insert((rng.random((n_hot, 3)) * 110.0
+                       + 5.0).astype(np.float32))
+            for m in (1, 4, 16, 64):
+                el.query(np.zeros((m, 3), np.float32), args.k)
+            el.force_rebalance()
         from ...obs import spans as _spans
         from ...obs.metrics import JsonlEmitter
 
@@ -138,18 +182,26 @@ def main(argv=None) -> int:
     if args.assert_steady:
         dense_served = [name for name, pt in summary["per_tenant"].items()
                         if not pt["sidecar"] and pt["served_rows"] > 0]
+        pod_ok = True
+        if args.pod_tenant:
+            pt = summary["per_tenant"].get("pod0", {})
+            pod_ok = (bool(pt.get("pod"))
+                      and pt.get("served_rows", 0) > 0
+                      and summary["migrations_done"] >= 1)
         ok = (len(dense_served) >= 2
               and summary["recompiles"] == 0
               and summary["exec_cache_enabled"]
               and summary["failed_requests"] == 0
-              and summary["jain_fairness"] is not None)
+              and summary["jain_fairness"] is not None
+              and pod_ok)
         if not ok:
             print(f"FLEET STEADY-STATE ASSERTION FAILED: "
                   f"dense_served={dense_served} "
                   f"recompiles={summary['recompiles']} "
                   f"cache_enabled={summary['exec_cache_enabled']} "
                   f"failed={summary['failed_requests']} "
-                  f"jain={summary['jain_fairness']}",
+                  f"jain={summary['jain_fairness']} "
+                  f"pod_ok={pod_ok}",
                   file=sys.stderr, flush=True)
             return 1
     return 0

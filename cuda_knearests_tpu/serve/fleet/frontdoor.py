@@ -21,11 +21,14 @@ request surface (DESIGN.md section 17).  The flow per request:
    (serve/fleet/tenants.py); ``failover()`` promotes a caught-up replica.
 
 Fault injection (CPU-testable, same convention as KNTPU_SERVE_FAULT):
-``KNTPU_FLEET_FAULT=cross-tenant|drop-delta|stale-replica`` seeds the
-three fleet-specific corruptions the fuzz campaign must detect
-(fuzz/fleet.py): answering one tenant's query against another tenant's
-cloud, dropping a committed delta from the replication log, and promoting
-a stale replica without the re-ship.
+``KNTPU_FLEET_FAULT=cross-tenant|drop-delta|stale-replica|
+torn-migration|lost-range`` seeds the fleet-specific corruptions the
+fuzz campaigns must detect (fuzz/fleet.py, fuzz/chaos.py): answering one
+tenant's query against another tenant's cloud, dropping a committed
+delta from the replication log, promoting a stale replica without the
+re-ship, tearing the last committed record out of a pod tenant's
+migration handover, and flipping a migration's range cut while the
+receiver applies nothing (pod/reshard.Migration.handover).
 """
 
 from __future__ import annotations
@@ -41,13 +44,15 @@ from ...config import DOMAIN_SIZE, ServeFleetConfig
 from ...io import validate_request
 from ...obs import metrics as _metrics
 from ...obs import spans as _spans
-from ...utils.memory import InputContractError, InvalidConfigError
+from ...utils.memory import (InputContractError, InvalidConfigError,
+                             InvalidRequestError)
 from ..batching import Batch, Request
 from ..daemon import Response
 from .admission import DrrScheduler, TokenBucket
 from .tenants import Tenant, TenantSpec
 
-FLEET_FAULTS = ("cross-tenant", "drop-delta", "stale-replica")
+FLEET_FAULTS = ("cross-tenant", "drop-delta", "stale-replica",
+                "torn-migration", "lost-range")
 
 
 def _parse_fleet_fault() -> Optional[str]:
@@ -102,8 +107,11 @@ class FleetDaemon:
                 raise InvalidConfigError(
                     f"duplicate tenant name {spec.name!r} in the fleet "
                     f"build list")
-            self.tenants[spec.name] = Tenant(spec, points, self.config,
-                                             self.clock)
+            t = Tenant(spec, points, self.config, self.clock)
+            if t.is_pod and self._fault in ("torn-migration",
+                                            "lost-range"):
+                t.elastic.fault = self._fault
+            self.tenants[spec.name] = t
             self.quota[spec.name] = TokenBucket(
                 spec.quota_qps if spec.quota_qps is not None
                 else self.config.quota_qps,
@@ -155,6 +163,9 @@ class FleetDaemon:
         if t.is_sidecar:
             return self._submit_sidecar(req_id, t, kind, payload, k, now,
                                         trace_id)
+        if t.is_pod:
+            return self._submit_pod(req_id, t, kind, payload, k, now,
+                                    trace_id)
         return self._submit_dense(req_id, t, kind, payload, k, now,
                                   trace_id)
 
@@ -183,6 +194,8 @@ class FleetDaemon:
                  other.spec.k)
         if other.is_sidecar:
             ids, d2 = other.sidecar.query(payload, kq)
+        elif other.is_pod:
+            ids, d2 = other.elastic.query(payload, kq)
         else:
             ids, d2 = other.daemon.overlay.query(payload, kq)
         want_k = int(k) if k else self.tenants[tenant].spec.k
@@ -229,6 +242,50 @@ class FleetDaemon:
                          arrived_at=now, completed_at=self.clock(),
                          tenant=name)]
 
+    def _submit_pod(self, req_id, t: Tenant, kind, payload, k,
+                    now, trace_id=None) -> List[Response]:
+        """Pod-placement request path: synchronous like the sidecar (the
+        elastic index is its own scatter-gather scheduler), with the PR 12
+        device-span stamp so the latency decomposition keeps working.
+        Mutations commit to the tenant's log (the mesh-durability record)
+        and then give the mutation-driven rebalance trigger one look."""
+        name = t.spec.name
+        if kind == "query":
+            kq = int(k) if k else t.spec.k
+            with _spans.span("serve.pod", force=True, tenant=name,
+                             trace_id=trace_id) as dev_sp:
+                ids, d2 = t.elastic.query(payload, kq)
+            self.served_rows[name] += payload.shape[0]
+            # one migration step rides every query: resharding progresses
+            # UNDER traffic, never as a stop-the-world drain
+            t.elastic.pump()
+            return [Response(req_id=req_id, ok=True, ids=ids, d2=d2,
+                             arrived_at=now, completed_at=self.clock(),
+                             tenant=name, trace_id=trace_id,
+                             queue_ms=0.0, dispatch_ms=0.0,
+                             device_ms=round(dev_sp.dur_ms, 4))]
+        if kind == "fof":
+            return self._refusal(
+                req_id, name,
+                InvalidRequestError(
+                    f"tenant {name!r}: fof is not served from the pod "
+                    f"placement (scatter-gather kNN only; run fof "
+                    f"against a dense tenant)"),
+                now, trace_id)
+        with _spans.span("serve.pod.mutate", force=True, tenant=name,
+                         kind=kind):
+            if kind == "insert":
+                t.elastic.insert(payload)
+            else:
+                t.elastic.delete(payload)
+        t.commit_mutation(kind, payload,
+                          drop_from_log=self._fault == "drop-delta")
+        t.elastic.maybe_rebalance()
+        t.elastic.pump()
+        return [Response(req_id=req_id, ok=True, n_points=t.n_points,
+                         arrived_at=now, completed_at=self.clock(),
+                         tenant=name, trace_id=trace_id)]
+
     def _submit_dense(self, req_id, t: Tenant, kind, payload, k,
                       now, trace_id=None) -> List[Response]:
         name = t.spec.name
@@ -260,6 +317,9 @@ class FleetDaemon:
                 and responses[-1].ok:
             t.commit_mutation(kind, payload,
                               drop_from_log=self._fault == "drop-delta")
+            # the barrier above drained this tenant's queues, so a dense
+            # tenant that grew past pod_threshold can promote here
+            t.maybe_promote_to_pod()
         return out
 
     # -- scheduling -----------------------------------------------------------
@@ -294,20 +354,23 @@ class FleetDaemon:
         dispatch's fairness accounting (deficit after, backlog snapshot)
         is stamped into the per-batch stats."""
         ready = {name: t.ready for name, t in self.tenants.items()
-                 if not t.is_sidecar}
+                 if t.daemon is not None}
         out: List[Response] = []
         for name, batch, disp in self.drr.select(ready):
             out.extend(self._run_batch(
                 self.tenants[name], batch,
                 {"deficit_after": disp.deficit_after,
                  "backlog": list(disp.backlog)}))
+        for t in self.tenants.values():
+            if t.is_pod:
+                t.elastic.pump()
         return out
 
     def poll(self, now: Optional[float] = None) -> List[Response]:
         """Deadline-trigger check across every dense tenant, then pump."""
         now = self.clock() if now is None else now
         for t in self.tenants.values():
-            if t.is_sidecar:
+            if t.daemon is None:
                 continue
             batch = t.daemon.batcher.poll(now)
             if batch is not None:
@@ -317,7 +380,7 @@ class FleetDaemon:
     def drain(self, now: Optional[float] = None) -> List[Response]:
         now = self.clock() if now is None else now
         for t in self.tenants.values():
-            if t.is_sidecar:
+            if t.daemon is None:
                 continue
             batch = t.daemon.batcher.flush("drain", now)
             if batch is not None:
@@ -326,7 +389,8 @@ class FleetDaemon:
 
     def next_deadline(self) -> Optional[float]:
         deadlines = [t.daemon.next_deadline()
-                     for t in self.tenants.values() if not t.is_sidecar]
+                     for t in self.tenants.values()
+                     if t.daemon is not None]
         deadlines = [d for d in deadlines if d is not None]
         return min(deadlines) if deadlines else None
 

@@ -29,7 +29,14 @@ from .tenants import TenantSpec
 
 @dataclasses.dataclass(frozen=True)
 class TenantLoad:
-    """One tenant's offered load (regenerable from the seed)."""
+    """One tenant's offered load (regenerable from the seed).
+
+    ``hotspot`` concentrates this tenant's scheduled INSERTS into the
+    axis-aligned sub-cube ``[lo*domain, hi*domain]^3`` -- a contiguous
+    low-Morton range when ``lo`` is near 0 -- so a pod tenant's
+    population skews deterministically and the live-rebalance trigger
+    (pod/reshard.ElasticIndex.maybe_rebalance) fires reproducibly in
+    tier-1 and bench.  Queries and deletes are unaffected."""
 
     tenant: str
     rate: float = 200.0
@@ -40,6 +47,7 @@ class TenantLoad:
     mutation_size: int = 8
     k: Optional[int] = None
     seed: int = 0
+    hotspot: Optional[Tuple[float, float]] = None
 
 
 def build_fleet_schedule(loads: List[TenantLoad],
@@ -62,9 +70,15 @@ def build_fleet_schedule(loads: List[TenantLoad],
             if load.mutation_ratio > 0 \
                     and rng.random() < load.mutation_ratio:
                 if rng.random() < 0.5 or n <= load.mutation_size:
-                    pts = (rng.random((load.mutation_size, 3))
-                           * (domain * 0.98)
-                           + domain * 0.01).astype(np.float32)
+                    if load.hotspot is not None:
+                        lo, hi = load.hotspot
+                        span = max(hi - lo, 1e-6) * domain
+                        pts = (rng.random((load.mutation_size, 3)) * span
+                               + lo * domain).astype(np.float32)
+                    else:
+                        pts = (rng.random((load.mutation_size, 3))
+                               * (domain * 0.98)
+                               + domain * 0.01).astype(np.float32)
                     out.append({"t": float(t), "tenant": load.tenant,
                                 "kind": "insert", "payload": pts})
                     n += load.mutation_size
@@ -98,6 +112,8 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
         loads, {name: t.n_points for name, t in fleet.tenants.items()},
         domain=DOMAIN_SIZE)
     cache0 = dict(_dispatch.EXEC_CACHE.stats_dict())
+    elastic0 = sum(t.elastic.elastic_recompiles
+                   for t in fleet.tenants.values() if t.is_pod)
     _dispatch.reset_stats()
     # streaming per-tenant aggregation (ISSUE 13 satellite): every
     # response is absorbed -- counted + binned into BOUNDED histograms
@@ -116,7 +132,7 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
 
     t0 = clock()
     i = 0
-    pending = (lambda: any(t.ready or (not t.is_sidecar
+    pending = (lambda: any(t.ready or (t.daemon is not None
                                        and t.daemon.batcher.pending_queries)
                            for t in fleet.tenants.values()))
     while i < len(schedule) or pending():
@@ -143,8 +159,24 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
         if wait > 0:
             sleep(min(wait, 0.005))
     absorb(fleet.drain(clock()))
+    # a pod tenant may still hold an in-flight migration: pump it dry so
+    # the session's summary reflects the post-handover state (bounded:
+    # each pump ships one chunk)
+    for t in fleet.tenants.values():
+        guard = 0
+        while t.is_pod and t.elastic.migration is not None \
+                and guard < 10_000:
+            t.elastic.pump()
+            guard += 1
     elapsed = max(clock() - t0, 1e-9)
     cache1 = _dispatch.EXEC_CACHE.stats_dict()
+    # exec-cache misses attributed to elastic index maintenance
+    # (migration handovers, shard rebuilds, mutation-side compaction) are
+    # carved out of the steady-state recompile gate: a live rebalance is
+    # index work, not a serving-path recompile (DESIGN.md section 22)
+    elastic1 = sum(t.elastic.elastic_recompiles
+                   for t in fleet.tenants.values() if t.is_pod)
+    elastic_recompiles = int(elastic1 - elastic0)
 
     per_tenant: Dict[str, dict] = {}
     offered: Dict[str, int] = {load.tenant: 0 for load in loads}
@@ -171,6 +203,7 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
             "failed": agg.failed,
             "sustained_qps": round(served / elapsed, 1),
             "sidecar": fleet.tenants[name].is_sidecar,
+            "pod": fleet.tenants[name].is_pod,
             **pct,
             "decomposition": agg.decomposition(),
             "slo_p99_budget_ms": slo.p99_budget_ms,
@@ -188,7 +221,12 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
         "elapsed_s": round(elapsed, 4),
         "sustained_qps": round(total_served / elapsed, 1),
         "recompiles": int(cache1["exec_cache_misses"]
-                          - cache0["exec_cache_misses"]),
+                          - cache0["exec_cache_misses"]
+                          - elastic_recompiles),
+        "elastic_recompiles": elastic_recompiles,
+        "migrations_done": sum(t.elastic.migrations_done
+                               for t in fleet.tenants.values()
+                               if t.is_pod),
         "exec_cache_enabled": _dispatch.EXEC_CACHE.enabled,
         "occupancy_mean": (round(float(np.mean(occ)), 4) if occ else None),
         # fleet-wide per-request latency decomposition (span-sourced:

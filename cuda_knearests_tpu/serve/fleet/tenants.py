@@ -18,6 +18,16 @@ shared DRR scheduler).  Two placements:
   executables, no batching, synchronous answers.  A sidecar tenant whose
   cloud GROWS past the threshold promotes to a dense placement at the
   mutation that crossed it (one prepare, the same cloud).
+* **Pod** (``ServeFleetConfig.pod_threshold``): clouds at or above the
+  threshold serve from an elastic pod-partitioned index
+  (pod/reshard.ElasticIndex, DESIGN.md section 22): Morton-range shards,
+  scatter-gather queries, live boundary migration when the mutation
+  stream skews the range populations.  Same canonical-id mutation
+  contract as the dense overlay, so the front door's admission and
+  commit paths are shared; the committed log (always present for pod
+  tenants) is the mesh-failover durability story
+  (serve/fleet/elastic.py).  A dense tenant that grows past the
+  threshold promotes at the mutation that crossed it.
 
 Replication (dense tenants with ``replicas > 0``): committed mutations
 append to the tenant's :class:`~.replica.ReplicationLog` and ship to
@@ -40,6 +50,7 @@ import numpy as np
 
 from ...api import KnnProblem
 from ...config import (SLO_CLASSES, KnnConfig, ServeFleetConfig, SloClass)
+from ...pod.reshard import ElasticIndex
 from ...utils.memory import InvalidConfigError, TransportError
 from ..daemon import ServeDaemon
 from .replica import Replica, ReplicationLog
@@ -106,6 +117,7 @@ class Tenant:
         self.ready: "Deque" = deque()    # flushed batches awaiting DRR
         self.daemon: Optional[ServeDaemon] = None
         self.sidecar: Optional[CpuSidecar] = None
+        self.elastic: Optional[ElasticIndex] = None
         self.log: Optional[ReplicationLog] = None
         self.replica_pool: List[Replica] = []
         self.promotions = 0
@@ -113,6 +125,8 @@ class Tenant:
         points = np.ascontiguousarray(points, np.float32).reshape(-1, 3)
         if self._wants_sidecar(points.shape[0]):
             self.sidecar = CpuSidecar(points, spec.k)
+        elif self._wants_pod(points.shape[0]):
+            self._build_elastic(points)
         else:
             self._build_dense(points)
 
@@ -120,6 +134,10 @@ class Tenant:
 
     def _wants_sidecar(self, n: int) -> bool:
         return n < self.fleet.sidecar_threshold or n < self.spec.k
+
+    def _wants_pod(self, n: int) -> bool:
+        return (self.fleet.pod_threshold is not None
+                and n >= self.fleet.pod_threshold)
 
     def _build_dense(self, points: np.ndarray) -> None:
         problem = KnnProblem.prepare(
@@ -134,6 +152,17 @@ class Tenant:
                         compact_threshold=self.fleet.compact_threshold)
                 for _ in range(self.spec.replicas)]
 
+    def _build_elastic(self, points: np.ndarray) -> None:
+        """The pod rung: Morton-range shards behind the shared front
+        door.  Pod tenants ALWAYS keep a replication log -- the committed
+        seq is what a mesh snapshot stamps and what a standby mesh
+        replays past it (serve/fleet/elastic.py)."""
+        self.elastic = ElasticIndex(
+            points, k=self.spec.k, nshards=self.fleet.pod_shards,
+            compact_threshold=self.fleet.compact_threshold,
+            skew_threshold=self.fleet.pod_skew_threshold)
+        self.log = ReplicationLog()
+
     def maybe_promote_from_sidecar(self) -> bool:
         """Promote a grown sidecar tenant to a dense placement (one
         prepare of the same cloud; canonical ids are preserved because
@@ -144,7 +173,28 @@ class Tenant:
             return False
         points = self.sidecar.mutated_points()
         self.sidecar = None
-        self._build_dense(points)
+        if self._wants_pod(points.shape[0]):
+            self._build_elastic(points)
+        else:
+            self._build_dense(points)
+        self.promotions += 1
+        return True
+
+    def maybe_promote_to_pod(self) -> bool:
+        """Promote a dense tenant whose cloud grew past ``pod_threshold``
+        to the elastic placement (same canonical cloud, same canonical
+        ids -- both placements use np.delete/concatenate indexing).
+        The replication log carries over: committed seq is placement-
+        independent."""
+        if self.daemon is None or not self._wants_pod(self.n_points):
+            return False
+        points = self.daemon.overlay.mutated_points()
+        log = self.log
+        self.daemon = None
+        self.replica_pool = []
+        self._build_elastic(points)
+        if log is not None:
+            self.log = log
         self.promotions += 1
         return True
 
@@ -155,9 +205,15 @@ class Tenant:
         return self.sidecar is not None
 
     @property
+    def is_pod(self) -> bool:
+        return self.elastic is not None
+
+    @property
     def n_points(self) -> int:
         if self.sidecar is not None:
             return self.sidecar.n_points
+        if self.elastic is not None:
+            return self.elastic.n_points
         return self.daemon.overlay.n_points
 
     def mutated_points(self) -> np.ndarray:
@@ -165,6 +221,8 @@ class Tenant:
         rebuild oracle's input)."""
         if self.sidecar is not None:
             return self.sidecar.mutated_points()
+        if self.elastic is not None:
+            return self.elastic.mutated_points()
         return self.daemon.overlay.mutated_points()
 
     # -- replication ----------------------------------------------------------
@@ -223,6 +281,10 @@ class Tenant:
                 "promotions": self.promotions}
         if self.sidecar is not None:
             base.update(self.sidecar.stats_dict())
+        elif self.elastic is not None:
+            base["sidecar"] = False
+            base["pod"] = True
+            base.update(self.elastic.stats_dict())
         else:
             base["sidecar"] = False
             base["batches"] = self.daemon.batches_executed

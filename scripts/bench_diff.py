@@ -15,7 +15,8 @@ trajectory from a pile of files into a gate:
   any kind with ``--tol kind=frac``.
 * **Strict fields**: ``recall`` must not drop by more than 1e-3;
   structural booleans (``slo_ok_all``, ``steady_ok``, ``failover_ok``,
-  ``containment_ok``, ``sync_bound_ok``, ``recall_ok``,
+  ``containment_ok``, ``migration_ok``, ``p999_ok``,
+  ``sync_bound_ok``, ``recall_ok``,
   ``hbm_model_ok``) must never flip true -> false; a current row
   carrying ``error`` gates.
 * **Precision tiers** (ISSUE 16): a matched row whose ``precision``
@@ -70,7 +71,7 @@ KIND_TOLERANCE = {
 #: -- the exact failure that blesses a would-OOM launch.
 STRICT_BOOLS = ("slo_ok_all", "steady_ok", "failover_ok",
                 "containment_ok", "sync_bound_ok", "recall_ok",
-                "hbm_model_ok")
+                "hbm_model_ok", "migration_ok", "p999_ok")
 
 RECALL_EPS = 1e-3
 

@@ -118,6 +118,17 @@ echo "== fleet failover smoke (SIGKILL the primary, CPU-only) =="
 JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.serve.fleet \
     --failover-smoke --failover-points 800 --failover-ops 16 --seed 0 || rc=1
 
+# Elastic fleet smoke (DESIGN.md section 22): one pod-placed tenant
+# behind the same front door, hotspot skew seeded, then a FORCED live
+# Morton rebalance riding the measured session.  --assert-steady must
+# STILL hold -- zero unattributed recompiles fleet-wide (migration
+# handover/rebuild compiles are carved out as elastic_recompiles) --
+# and the session must complete >= 1 migration.
+echo "== elastic fleet smoke (pod tenant + live rebalance under --assert-steady, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.serve.fleet --loadgen \
+    --tenants 3 --points 1500 --requests 30 --rate 400 --seed 0 \
+    --pod-tenant --assert-steady || rc=1
+
 # Fleet fuzz smoke (DESIGN.md section 17): seeded multi-tenant op streams
 # (queries + mutations + mid-stream replica failover, duplicate/cluster
 # hazards per tenant) through the fleet front door vs per-tenant rebuild
@@ -137,6 +148,32 @@ for fault in cross-tenant drop-delta stale-replica; do
         python -m cuda_knearests_tpu.fuzz --fleet --cases 4 --seed 0 \
         --no-minimize >/dev/null 2>&1; then
         echo "   FAIL: seeded fleet fault '$fault' was not detected (rc 0)"
+        rc=1
+    else
+        echo "   ok: '$fault' detected"
+    fi
+done
+
+# Chaos fuzz smoke (DESIGN.md section 22): seeded op/fault schedules
+# (hotspot skew, forced live rebalance, migration pumps, chip loss,
+# wedged migration, delayed handover) through a pod-tenant fleet front
+# door vs per-tenant rebuild oracles, plus one cross-mesh mid-migration
+# SIGKILL drill.  KNTPU_CHAOS_CASES deepens it for nightly runs.
+echo "== chaos fuzz smoke (elastic pod fleet under fire, ${KNTPU_CHAOS_CASES:-6} cases + mesh drill, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.fuzz \
+    --chaos --cases "${KNTPU_CHAOS_CASES:-6}" --seed 0 --budget 120s || rc=1
+
+# Chaos seeded-fault self-tests (DESIGN.md section 22): a torn migration
+# (slab shipped but a committed delta record dropped) and a lost Morton
+# range (handover detaches the donor slab without attaching it to the
+# receiver) must each yield a banked failure (rc != 0), diverted away
+# from the real corpus.
+echo "== chaos seeded-fault self-tests (torn-migration / lost-range) =="
+for fault in torn-migration lost-range; do
+    if KNTPU_FLEET_FAULT=$fault JAX_PLATFORMS=cpu \
+        python -m cuda_knearests_tpu.fuzz --chaos --cases 2 --seed 0 \
+        --no-minimize >/dev/null 2>&1; then
+        echo "   FAIL: seeded chaos fault '$fault' was not detected (rc 0)"
         rc=1
     else
         echo "   ok: '$fault' detected"

@@ -126,6 +126,16 @@ def _artifact_good(path: str, allow_partial: bool = False) -> bool:
         if str(ln.get("unit", "")) == "failover_ok" \
                 and not ln.get("failover_ok"):
             return False
+    # rebalance-under-load rows (ISSUE 17 tentpole) are accepted as their
+    # own row kind: a live Morton migration riding measured traffic.  The
+    # row must carry BOTH machine-checked verdicts and both must hold --
+    # a p999 banked over a stalled migration (migration_ok missing or
+    # false) or an unbounded tail (p999_ok false) is not a record.
+    for ln in lines:
+        if "rebalance_under_load" in str(ln.get("config", "")) and not (
+                ln.get("migration_ok") is True
+                and ln.get("p999_ok") is True):
+            return False
     # pod weak-scaling rows (ISSUE 12 satellite) are accepted as their own
     # row kind: unit 'queries/sec/chip' with pod_scaling=true.  A pod row
     # must carry its halo accounting (halo_bytes + ring_depth) and the

@@ -708,3 +708,248 @@ def test_fleet_campaign_manifest_shape():
         assert key in manifest
     assert manifest["flavor"] == "fleet-stream"
     assert manifest["fault"] is None
+
+
+# =============================================================================
+# ISSUE 17: elastic pod tenants behind the front door, mesh snapshots +
+# SIGKILL failover, and the chaos campaign's detectors
+# =============================================================================
+
+# pod placement sits above the dense rung: 48 <= dense < 200 <= pod
+POD_CFG = ServeFleetConfig(min_bucket=8, max_batch=64, compact_threshold=32,
+                           warmup=False, sidecar_threshold=48,
+                           pod_threshold=200, pod_shards=2,
+                           pod_skew_threshold=3.0)
+
+
+def test_pod_tenant_behind_front_door_byte_identity():
+    """A tenant above pod_threshold serves from the pod-partitioned
+    elastic index behind the SAME front door: mutations commit through
+    the replication log, and answers stay byte-identical to the
+    rebuild-from-scratch oracle over the mutated cloud (and tie-aware
+    correct vs an independent dense rebuild)."""
+    tracked = np.array(generate_uniform(260, seed=11))
+    fleet = FleetDaemon([(TenantSpec(name="p0", k=6), tracked)], POD_CFG)
+    t = fleet.tenants["p0"]
+    assert t.is_pod and t.elastic is not None and t.log is not None
+    rng = np.random.default_rng(3)
+    now = 0.0
+    for i in range(9):
+        now += 1e-3
+        if i % 3 == 2:
+            ids = np.sort(rng.choice(t.n_points, size=4,
+                                     replace=False)).astype(np.int64)  # kntpu-ok: wide-dtype -- host id payload
+            [r] = fleet.submit(i, "p0", "delete", ids, now=now)
+            assert r.ok, r.error
+            tracked = np.delete(tracked, ids, axis=0)
+        else:
+            pts = (rng.random((6, 3)) * 110.0 + 5.0).astype(np.float32)
+            [r] = fleet.submit(i, "p0", "insert", pts, now=now)
+            assert r.ok, r.error
+            tracked = np.concatenate([tracked, pts])
+    assert t.log.committed_seq == 9
+    q = (np.random.default_rng(5).random((24, 3)) * 980.0
+         + 10.0).astype(np.float32)
+    [r] = fleet.submit(99, "p0", "query", q, now=now + 1e-3)
+    assert r.ok and r.tenant == "p0"
+    o_i, o_d = t.elastic.rebuild_oracle_query(q, 6)
+    np.testing.assert_array_equal(np.asarray(r.ids), o_i)
+    np.testing.assert_array_equal(np.asarray(r.d2), o_d)
+    ref = KnnProblem.prepare(tracked, KnnConfig(k=6, adaptive=False),
+                             validate=False)
+    _ri, ref_d = ref.query(q, 6)
+    assert check_route_result(tracked, q, np.asarray(r.ids),
+                              np.asarray(r.d2), np.asarray(ref_d),
+                              6) is None
+
+
+def test_dense_tenant_promotes_to_pod_and_log_carries_over():
+    """A dense tenant that grows past pod_threshold promotes to the
+    elastic placement through the front door; the replication log (the
+    mesh-durability record) carries over -- committed seq is placement-
+    independent -- and post-promotion answers match the oracle."""
+    pts = generate_uniform(190, seed=2)          # dense: 48 <= 190 < 200
+    fleet = FleetDaemon([(TenantSpec(name="g", k=4, replicas=1), pts)],
+                        POD_CFG)
+    t = fleet.tenants["g"]
+    assert not t.is_pod and t.daemon is not None
+    out = fleet.submit(1, "g", "insert", generate_uniform(16, seed=3),
+                       now=0.001)
+    assert out[-1].ok
+    assert t.is_pod and t.promotions == 1
+    assert t.log is not None and t.log.committed_seq == 1
+    assert t.n_points == 206
+    q = (np.random.default_rng(8).random((12, 3)) * 980.0
+         + 10.0).astype(np.float32)
+    [r] = fleet.submit(2, "g", "query", q, now=0.002)
+    assert r.ok
+    o_i, o_d = t.elastic.rebuild_oracle_query(q, 4)
+    np.testing.assert_array_equal(np.asarray(r.ids), o_i)
+    np.testing.assert_array_equal(np.asarray(r.d2), o_d)
+
+
+def test_pod_tenant_refuses_fof_typed():
+    """FoF against a pod tenant refuses typed (invalid-input): the pod
+    placement serves scatter-gather kNN only."""
+    fleet = FleetDaemon(
+        [(TenantSpec(name="p0", k=4), generate_uniform(220, seed=7))],
+        POD_CFG)
+    assert fleet.tenants["p0"].is_pod
+    [r] = fleet.submit(1, "p0", "fof", 10.0, now=0.001)
+    assert not r.ok
+    assert r.failure_kind == "invalid-input"
+    assert "pod" in r.error
+    assert classify_fault_text(f"InvalidRequestError: {r.error}") \
+        == "invalid-input"
+
+
+# -- mesh snapshots + cross-mesh SIGKILL failover -----------------------------
+
+def test_mesh_snapshot_roundtrip_and_typed_refusals(tmp_path):
+    """snapshot_tenant round-trips the canonical cloud + committed seq;
+    load_snapshot refuses torn/corrupt/stale files typed (a standby mesh
+    NEVER promotes from a refused snapshot)."""
+    from cuda_knearests_tpu.serve.fleet.elastic import (SNAPSHOT_SCHEMA,
+                                                        load_snapshot,
+                                                        snapshot_tenant)
+    from cuda_knearests_tpu.utils.memory import CorruptInputError
+
+    fleet = FleetDaemon(
+        [(TenantSpec(name="p0", k=5), generate_uniform(230, seed=4))],
+        POD_CFG)
+    t = fleet.tenants["p0"]
+    [r] = fleet.submit(1, "p0", "insert", generate_uniform(8, seed=5),
+                       now=0.001)
+    assert r.ok
+    info = snapshot_tenant(t, str(tmp_path / "mesh"))
+    assert info["committed_seq"] == 1 and info["n_points"] == 238
+    snap = load_snapshot(info["path"])
+    np.testing.assert_array_equal(snap["points"], t.mutated_points())
+    assert snap["committed_seq"] == 1 and snap["k"] == 5
+    assert snap["nshards"] == 2 and snap["sha256"] == info["sha256"]
+
+    # refusal 1: unreadable garbage
+    bad = tmp_path / "garbage.npz"
+    bad.write_bytes(b"definitely not a zip archive")
+    with pytest.raises(CorruptInputError, match="unreadable"):
+        load_snapshot(str(bad))
+    # refusal 2: missing envelope (sha256 stripped)
+    fields = dict(np.load(info["path"]))
+    stripped = {k: v for k, v in fields.items() if k != "sha256"}
+    np.savez_compressed(tmp_path / "stripped.npz", **stripped)
+    with pytest.raises(CorruptInputError, match="envelope"):
+        load_snapshot(str(tmp_path / "stripped.npz"))
+    # refusal 3: stale schema tag (digest recomputed, so ONLY the schema
+    # check can fire)
+    from cuda_knearests_tpu.serve.fleet import elastic as _elastic
+    stale = dict(fields)
+    stale["schema"] = np.bytes_(b"kntpu-mesh-snapshot-v0")
+    del stale["sha256"]
+    stale["sha256"] = np.bytes_(
+        _elastic._snapshot_digest(stale).encode())
+    np.savez_compressed(tmp_path / "stale.npz", **stale)
+    with pytest.raises(CorruptInputError, match="stale or unknown schema"):
+        load_snapshot(str(tmp_path / "stale.npz"))
+    # refusal 4: flipped payload bit -> checksum mismatch
+    torn = dict(fields)
+    pts = np.array(torn["points"])
+    pts[0, 0] += 1.0
+    torn["points"] = pts
+    np.savez_compressed(tmp_path / "torn.npz", **torn)
+    with pytest.raises(CorruptInputError, match="checksum mismatch"):
+        load_snapshot(str(tmp_path / "torn.npz"))
+    assert SNAPSHOT_SCHEMA.startswith("kntpu-mesh-snapshot-")
+
+
+def test_mesh_failover_drill_sigkill_mid_migration():
+    """The cross-mesh drill: standby promotes from snapshot + committed-
+    log replay after a genuine mid-migration SIGKILL of the primary;
+    zero committed mutations lost, post-failover answers byte-identical
+    to the parent-side rebuild oracle."""
+    from cuda_knearests_tpu.serve.fleet.elastic import mesh_failover_drill
+
+    drill = mesh_failover_drill(n=900, k=6, ops=26, seed=0, log=None)
+    assert drill["killed_mid_migration"] is True
+    assert drill["mesh_failovers"] >= 1
+    assert drill["zero_lost_committed"] is True
+    assert drill["post_failover_byte_identical"] is True
+    assert drill["mesh_failover_ok"] is True
+    assert drill["replay_tail"] >= drill["snapshot_seq"]
+    assert set(drill["latency_decomposition"]) == {
+        "total_ms", "queue_ms", "dispatch_ms", "device_ms"}
+
+
+# -- chaos fuzz: seeded faults + corpus replay --------------------------------
+
+@pytest.mark.parametrize("fault", ["lost-range", "torn-migration"])
+def test_chaos_fault_provably_detected(fault, tmp_path, monkeypatch):
+    """Each migration-corrupting KNTPU_FLEET_FAULT yields a detected,
+    banked chaos failure: the guaranteed hotspot -> rebalance -> pump
+    tail reaches a handover, and the shard-population conservation
+    invariant catches the torn/lost range even when no probe lands near
+    the lost rows."""
+    from cuda_knearests_tpu.fuzz.chaos import ChaosSpec, run_chaos_case
+
+    monkeypatch.setenv("KNTPU_FLEET_FAULT", fault)
+    spec = ChaosSpec(seed=5, n0=200, dense_n0=90, k=4, nshards=2, n_ops=6)
+    failure = run_chaos_case(spec, bank_dir=str(tmp_path), minimize=False)
+    assert failure is not None, f"fault {fault} went undetected"
+    assert failure.banked and os.path.exists(failure.banked)
+    assert failure.banked.endswith("-chaos.npz")
+    assert "conservation" in failure.reason \
+        or "lost or duplicated" in failure.reason \
+        or "diverged" in failure.reason
+
+
+def test_chaos_case_bank_roundtrip(tmp_path):
+    from cuda_knearests_tpu.fuzz.chaos import (ChaosSpec, bank_chaos_case,
+                                               generate_ops,
+                                               load_chaos_case)
+
+    spec = ChaosSpec(seed=9, n0=200, dense_n0=90, k=4, nshards=2, n_ops=8)
+    ops = generate_ops(spec)
+    assert any(o["op"] == "rebalance" for o in ops)   # the guaranteed tail
+    path = bank_chaos_case(str(tmp_path), spec, "mismatch", "why", ops)
+    b = load_chaos_case(path)
+    assert b["spec"] == spec and b["kind"] == "mismatch"
+    assert [o["op"] for o in b["ops"]] == [o["op"] for o in ops]
+    for got, want in zip(b["ops"], ops):
+        for key in ("points", "ids", "queries", "n", "shard", "pumps"):
+            if key in want:
+                np.testing.assert_array_equal(got[key], want[key])
+
+
+def _chaos_corpus_entries():
+    return sorted(glob.glob(os.path.join(CORPUS, "*-chaos.npz")))
+
+
+@pytest.mark.parametrize("path", _chaos_corpus_entries() or ["<empty>"],
+                         ids=[os.path.basename(p)
+                              for p in _chaos_corpus_entries()] or ["none"])
+def test_chaos_corpus_replays_clean(path):
+    """Every banked chaos repro must stay fixed (regression pin; the
+    corpus is currently allowed to be empty -- the campaign's dev runs
+    found no real divergence under the fault schedules)."""
+    if path == "<empty>":
+        pytest.skip("no banked chaos repros (campaign clean)")
+    from cuda_knearests_tpu.fuzz.chaos import load_chaos_case, replay_ops
+
+    b = load_chaos_case(path)
+    got = replay_ops(b["spec"], b["ops"])
+    assert got is None, (f"{os.path.basename(path)} regressed: {got} "
+                        f"(originally: {b['reason']})")
+
+
+def test_chaos_campaign_manifest_shape():
+    """A tiny clean campaign (no cross-mesh drill: tier-1 keeps that in
+    its own test): manifest fields the smoke and bench stamps rely on."""
+    from cuda_knearests_tpu.fuzz.chaos import run_chaos_campaign
+
+    manifest = run_chaos_campaign(n_cases=2, seed=3, bank_dir=None,
+                                  minimize=False, drill=False, log=None)
+    assert manifest["ok"] is True and manifest["failures"] == []
+    for key in ("flavor", "requested_cases", "completed_cases", "seed",
+                "fault", "elapsed_s", "corpus_size", "mesh_failover"):
+        assert key in manifest
+    assert manifest["flavor"] == "chaos-stream"
+    assert manifest["fault"] is None and manifest["mesh_failover"] is None

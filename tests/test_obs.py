@@ -459,3 +459,28 @@ def test_metrics_wire_command_over_stdio():
     assert "host_syncs" in m["metrics"]["dispatch"]
     assert "serve" in m["metrics"]
     assert "latency_decomposition" in m["metrics"]["serve"]
+
+
+def test_snapshot_surfaces_tuned_plan_store_counters(tmp_path):
+    """ISSUE 17 satellite: the unified snapshot carries the tuned-plan
+    store counters (tune_store_*) next to the ExecutableCache compile
+    stats, so one scrape answers both "did autotuning hit the persisted
+    plans" and "what did compilation cost"."""
+    from cuda_knearests_tpu.tune import store as tstore
+
+    snap = obs_metrics.metrics_snapshot()
+    assert "tuned_plans" in snap
+    for key in ("exec_cache_hits", "exec_cache_misses",
+                "exec_cache_compiled", "exec_cache_compile_s"):
+        assert key in snap["exec_cache"], key
+    prev = tstore.get_default_store()
+    try:
+        tstore.set_default_store(tstore.TunedPlanStore(
+            path=str(tmp_path / "plans.json")))
+        snap2 = obs_metrics.metrics_snapshot()
+        for key in ("tune_store_hits", "tune_store_misses",
+                    "tune_store_stores", "tune_store_cap"):
+            assert key in snap2["tuned_plans"], key
+        json.dumps(snap2)  # still one JSON-serializable document
+    finally:
+        tstore.set_default_store(prev)

@@ -413,3 +413,121 @@ def test_pod_plan_invariants(uniform_4k):
             for row in cand:
                 slots = row[row >= 0]
                 assert np.unique(slots).size == slots.size
+
+
+# -- ISSUE 17: halo re-exchange, elastic windows, live-reshard identity -------
+
+def _reexchange_site_lines():
+    out = {}
+    for s in syncflow.discover_sites():
+        if s.site_id in ("pod-reexchange-stage", "pod-reexchange-ici"):
+            for ln in range(s.line - 1, s.line + 6):
+                out[(s.kind, s.path, ln)] = s.site_id
+    return out
+
+
+def test_pod_reexchange_sync_budget_and_ici(uniform_4k):
+    """Deleting an EXPORTED device-resident pod point re-exchanges the
+    halo through the cached ppermute program: ZERO host syncs (the
+    window's claim -- staging and ICI never block the host), the full
+    modeled wire volume on ICI, every traced record mapping to a claimed
+    site.  A non-exported delete skips the re-exchange entirely."""
+    from cuda_knearests_tpu.pod.reshard import PodOverlay
+
+    maps = _reexchange_site_lines()
+    pp = PodKnnProblem.prepare(np.array(uniform_4k), n_devices=NDEV,
+                               config=KnnConfig(k=8))
+    pp.solve()                       # halo exchange + ready state cached
+    ov = PodOverlay(pp)
+    exported = None
+    interior = None
+    for pid in range(ov.n0):
+        chip = int(ov._chip_of[pid])
+        cell = int(ov._cells_of(pp._points_host[pid:pid + 1])[0])
+        if cell in ov._exported[chip]:
+            exported = pid if exported is None else exported
+        else:
+            interior = pid if interior is None else interior
+        if exported is not None and interior is not None:
+            break
+    assert exported is not None and interior is not None
+    dispatch.reset_stats()
+    with dispatch.trace_sites() as records:
+        ov.delete(np.asarray([exported]))
+    stats = dispatch.stats()
+    assert ov.stats["reexchanges"] == 1
+    win = syncflow.WINDOWS["pod-reexchange"]
+    env = dict(syncflow.worst_case_env(), xchg=1, steps=pp.meta.steps,
+               hcap=pp.meta.hcap, ndev=pp.meta.ndev)
+    assert stats.host_syncs == win.syncs_bound(env) == 0
+    ici_model = syncflow.evaluate(win.sites["pod-reexchange-ici"].bytes,
+                                  env)
+    assert stats.ici_bytes == ici_model == pp.meta.halo_bytes() > 0
+    icis = [r for r in records if r.kind == "ici"]
+    assert len(icis) == 1 and icis[0].nbytes == ici_model
+    assert maps.get(("ici", icis[0].path, icis[0].line)) \
+        == "pod-reexchange-ici"
+    stages = [r for r in records if r.kind == "stage"]
+    assert 0 < len(stages) <= syncflow.evaluate(
+        win.sites["pod-reexchange-stage"].mult, env)
+    for r in stages:
+        assert maps.get(("stage", r.path, r.line)) \
+            == "pod-reexchange-stage", (r.path, r.line)
+    # interior delete: dirty chip restages, but no exported cell went
+    # dirty -> the export-block invalidation PROVES the skip
+    dispatch.reset_stats()
+    ov.delete(np.asarray([interior]))
+    again = dispatch.stats()
+    assert again.ici_bytes == 0 and again.host_syncs == 0
+    assert ov.stats["reexchanges"] == 1
+    assert ov.stats["reexchanges_skipped"] >= 1
+
+
+def test_elastic_windows_registered():
+    """The ISSUE 17 windows are first-class citizens of the dataflow
+    model: registered routes, claimed sites discovered and annotated,
+    bounds inside budget at the worst-case env."""
+    for name in ("pod-reexchange", "pod-overlay-query",
+                 "pod-overlay-solve", "elastic-query"):
+        assert syncflow.ROUTE_WINDOWS[name] == name
+        assert name in syncflow.WINDOWS
+    ids = {s.site_id for s in syncflow.discover_sites() if s.site_id}
+    for sid in ("pod-reexchange-stage", "pod-reexchange-ici",
+                "reshard-delta-stage", "reshard-delta-query-stage",
+                "reshard-delta-final"):
+        assert sid in ids, sid
+    env = syncflow.worst_case_env()
+    for name in ("pod-reexchange", "pod-overlay-query",
+                 "pod-overlay-solve", "elastic-query"):
+        win = syncflow.WINDOWS[name]
+        assert win.syncs_bound(env) <= syncflow.evaluate(win.budget, env)
+
+
+def test_elastic_live_reshard_byte_identity_every_pump():
+    """Queries stay byte-identical to the rebuild-from-scratch oracle at
+    EVERY migration pump -- the old owner answers until handover, so live
+    resharding is invisible to readers -- and after handover the moved
+    range answers from its new owner, still byte-identical."""
+    from cuda_knearests_tpu.pod.reshard import ElasticIndex
+
+    el = ElasticIndex(generate_uniform(420, seed=21), k=6, nshards=2,
+                      compact_threshold=64, skew_threshold=3.0,
+                      migration_chunk=8)
+    rng = np.random.default_rng(4)
+    el.insert((rng.random((48, 3)) * 110.0 + 5.0).astype(np.float32))
+    q = (np.random.default_rng(6).random((20, 3)) * 980.0
+         + 10.0).astype(np.float32)
+    assert el.force_rebalance()
+    pumps = 0
+    while el.migration is not None and pumps < 10_000:
+        gi, gd = el.query(q, 6)
+        oi, od = el.rebuild_oracle_query(q, 6)
+        np.testing.assert_array_equal(gi, oi)
+        np.testing.assert_array_equal(gd, od)
+        el.pump()
+        pumps += 1
+    assert el.migrations_done == 1 and pumps > 1
+    gi, gd = el.query(q, 6)
+    oi, od = el.rebuild_oracle_query(q, 6)
+    np.testing.assert_array_equal(gi, oi)
+    np.testing.assert_array_equal(gd, od)

@@ -223,6 +223,46 @@ def test_artifact_good_pod_row_kind(tmp_path):
     assert not tpu_watch._artifact_good(str(p))
 
 
+def test_artifact_good_rebalance_row_kind(tmp_path):
+    """ISSUE 17: rebalance_under_load rows are accepted only with BOTH
+    machine-checked verdicts present and true -- a p999 banked over a
+    stalled migration (migration_ok missing/false) or an unbounded tail
+    (p999_ok false) is not a record.  The same two booleans are strict
+    in scripts/bench_diff.py: once true in a baseline they may never
+    silently flip."""
+    p = tmp_path / "rb.json"
+    good_row = {"platform": "tpu", "unit": "p999_ms", "value": 12.0,
+                "config": "serving fleet [rebalance_under_load]: pod "
+                          "tenant, forced live Morton rebalance",
+                "migration_ok": True, "p999_ok": True, "failover_ok": True}
+    p.write_text(json.dumps({"rc": 0, "lines": [good_row]}))
+    assert tpu_watch._artifact_good(str(p))
+    for flag in ("migration_ok", "p999_ok"):
+        # verdict missing entirely -> refused
+        p.write_text(json.dumps({"rc": 0, "lines": [
+            {k: v for k, v in good_row.items() if k != flag}]}))
+        assert not tpu_watch._artifact_good(str(p)), flag
+        # verdict false -> refused
+        p.write_text(json.dumps({"rc": 0, "lines": [
+            dict(good_row, **{flag: False})]}))
+        assert not tpu_watch._artifact_good(str(p)), flag
+    # non-rebalance rows are unaffected by the new row-kind law
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        {"platform": "tpu", "unit": "p999_ms", "value": 1.0,
+         "config": "other row"}]}))
+    assert tpu_watch._artifact_good(str(p))
+    # and bench_diff treats both verdicts as strict booleans
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff_rb", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "bench_diff.py"))
+    bd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bd)
+    assert "migration_ok" in bd.STRICT_BOOLS
+    assert "p999_ok" in bd.STRICT_BOOLS
+
+
 # -- kntpu-scope capture harness (ISSUE 15) -----------------------------------
 
 def _capture_row(platform="tpu", **over):
