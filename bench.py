@@ -869,6 +869,7 @@ def _fleet_scenario(name: str) -> dict:
             "unit": "failover_ok",
             "backend": "subprocess",
             **drill,
+            **_proto_fields(),
         }
     n = int(os.environ.get("BENCH_FLEET_N", "6000"))
     k = 10
@@ -1020,6 +1021,7 @@ def _rebalance_scenario() -> dict:
             "committed_mutations", "snapshot_seq", "replay_tail",
             "zero_lost_committed", "post_failover_byte_identical",
             "mesh_failover_ok")},
+        **_proto_fields(),
     }
 
 
@@ -1113,6 +1115,21 @@ def serve_scenario(name: str) -> dict:
             and summary["failure_kinds"].get("oom") == 1
             and summary["completed_queries"] > 0 and refused_probe)
     return row
+
+
+def _proto_fields() -> dict:
+    """kntpu-proto traceability stamp (ISSUE 18): which protocol model
+    set the fleet rows' replication/migration/admission machinery is
+    checked against, and that every model explored clean.  Only the
+    fleet_failover / rebalance_under_load rows carry it -- those are the
+    rows whose verdicts lean on the modeled protocols.  Pure host work,
+    cached per process."""
+    try:
+        from cuda_knearests_tpu.analysis.models import proto_stamp
+
+        return proto_stamp()
+    except Exception:  # noqa: BLE001 -- never let the stamp kill the output
+        return {}
 
 
 def _analysis_fields() -> dict:

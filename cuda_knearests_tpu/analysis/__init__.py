@@ -1,6 +1,6 @@
-"""kntpu-check: static contracts + TPU-hazard lint + dataflow verifier.
+"""kntpu-check: contracts + lint + dataflow verifier + protocol models.
 
-Three engines gate every solve route before it ever touches a chip:
+Four engines gate every solve route before it ever touches a chip:
 
 * :mod:`.contracts` -- abstract contract checker: traces the adaptive,
   legacy-pack, external-query, and sharded per-chip solve routes with
@@ -17,8 +17,16 @@ Three engines gate every solve route before it ever touches a chip:
   class x capacity x k lattice, and certifies cross-route jaxpr
   equivalence (the committed ``equivalence.json``, which collapses the
   contract engine's route matrix -- ROADMAP item 5's precondition).
+* :mod:`.proto` (+ :mod:`.models`, :mod:`.concurrency`) -- kntpu-proto,
+  the protocol model checker: exhaustive small-scope BFS over the
+  declared fleet protocols (replication commit, migration handover, mesh
+  snapshot+replay, DRR admission) with crash injected at every state,
+  plus the syncflow-style conformance pass binding ``# proto:``
+  annotations in serve/fleet + pod/reshard to the models, plus the
+  concurrency-discipline lint rules (registered into the engine-2
+  registry).
 
-One command runs all three: ``python -m cuda_knearests_tpu.analysis``
+One command runs all four: ``python -m cuda_knearests_tpu.analysis``
 (CPU-only by construction; see :mod:`.cli`).  The gate is
 zero-findings-vs-baseline (:mod:`.findings`); tests/test_analysis.py and
 tests/test_verify.py keep it tier-1.
@@ -42,6 +50,7 @@ __all__ = [
     "load_baseline",
     "run_contracts",
     "run_lint",
+    "run_proto",
     "run_verify",
     "save_baseline",
 ]
@@ -63,3 +72,9 @@ def run_verify(fault=None):
     from .verify import run_verify as _rv
 
     return _rv(fault=fault)
+
+
+def run_proto(fault=None):
+    from .proto import run_proto as _rp
+
+    return _rp(fault=fault)

@@ -1,8 +1,9 @@
 """``python -m cuda_knearests_tpu.analysis`` -- the one-command gate.
 
-Runs all three engines (abstract contract checker + TPU-hazard lint +
-the kntpu-verify dataflow verifier), compares against the committed
-baseline, and exits non-zero on any new finding.  The whole run is
+Runs all four engines (abstract contract checker + TPU-hazard lint +
+the kntpu-verify dataflow verifier + the kntpu-proto protocol model
+checker), compares against the committed baseline, and exits non-zero
+on any new finding.  The whole run is
 chip-free: main() pins JAX_PLATFORMS=cpu (env + jax config, before any
 backend initializes) and the contract engine refuses any other backend.
 The pin lives in main(), never at import time, so programmatic importers
@@ -30,9 +31,10 @@ from .contracts import FAULTS as CONTRACT_FAULTS
 from .findings import (ANALYSIS_VERSION, Finding, analysis_stamp,
                        baseline_hash, diff_vs_baseline, load_baseline,
                        save_baseline, schema_finding)
+from .proto import FAULTS as PROTO_FAULTS
 from .verify import FAULTS as VERIFY_FAULTS
 
-FAULTS = CONTRACT_FAULTS + VERIFY_FAULTS
+FAULTS = CONTRACT_FAULTS + VERIFY_FAULTS + PROTO_FAULTS
 
 # Schema version of the --json output document.  Bump on any key change:
 # the CI annotation renderer keys off this.
@@ -74,6 +76,10 @@ def _run(engine: str, paths: Optional[List[str]],
         from .verify import run_verify
 
         findings.extend(run_verify(fault=fault))
+    if engine in ("proto", "all") and paths is None:
+        from .proto import run_proto
+
+        findings.extend(run_proto(fault=fault))
     return findings
 
 
@@ -82,7 +88,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m cuda_knearests_tpu.analysis",
         description=__doc__.splitlines()[0])
     ap.add_argument("--engine",
-                    choices=("contracts", "lint", "verify", "all"),
+                    choices=("contracts", "lint", "verify", "proto", "all"),
                     default="all", help="which engine(s) to run")
     ap.add_argument("--paths", nargs="+", default=None, metavar="PATH",
                     help="lint these files/dirs instead of the default "
@@ -134,9 +140,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             running.add("contracts")
         if args.engine in ("verify", "all"):
             running.add("verify")
+        if args.engine in ("proto", "all"):
+            running.add("proto")
 
     def _fault_engine(fault: str) -> str:
-        return "contracts" if fault in CONTRACT_FAULTS else "verify"
+        if fault in CONTRACT_FAULTS:
+            return "contracts"
+        if fault in VERIFY_FAULTS:
+            return "verify"
+        return "proto"
 
     if args.fault and _fault_engine(args.fault) not in running:
         ap.error(f"--fault {args.fault} seeds the "

@@ -953,15 +953,17 @@ def run_contracts(fault: Optional[str] = None) -> List[Finding]:
     only widen, never narrow, without a committed proof."""
     import jax
 
+    from .proto import FAULTS as PROTO_FAULTS
     from .verify import FAULTS as VERIFY_FAULTS
 
     fault = fault if fault is not None else _fault()
     if fault is not None and fault not in FAULTS:
-        if fault in VERIFY_FAULTS:
-            fault = None  # seeded into the verify engine, not this one
+        if fault in VERIFY_FAULTS + PROTO_FAULTS:
+            fault = None  # seeded into another engine, not this one
         else:
-            raise ValueError(f"unknown analysis fault {fault!r}: "
-                             f"expected one of {FAULTS + VERIFY_FAULTS}")
+            raise ValueError(
+                f"unknown analysis fault {fault!r}: expected one of "
+                f"{FAULTS + VERIFY_FAULTS + PROTO_FAULTS}")
     ck = _Checker(fault=fault)
     if jax.default_backend() != "cpu":
         # the whole point is a chip-free gate; a non-cpu backend means a

@@ -27,7 +27,7 @@ from typing import Iterable, List, Optional, Tuple
 # Version of the analysis subsystem: bump on any rule/contract change so
 # bench artifacts (which stamp it, see bench.py) are traceable to the
 # exact gate a tree passed.
-ANALYSIS_VERSION = "2.1.0"
+ANALYSIS_VERSION = "2.2.0"
 
 # Schema of the committed baseline file.  Bumped whenever the fingerprint
 # law changes (occurrence indexing, subject hashing, ...): a baseline

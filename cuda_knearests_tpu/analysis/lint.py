@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 from typing import Iterable, List, Optional
 
+from . import concurrency  # noqa: F401 -- registers the discipline rules
 from .findings import Finding
 from .rules import all_rules, build_context
 

@@ -419,14 +419,16 @@ def run_verify(fault: Optional[str] = None) -> List[Finding]:
     seeds one deliberate violation; contract-engine faults are ignored
     here (they seed engine 1)."""
     from .contracts import FAULTS as CONTRACT_FAULTS
+    from .proto import FAULTS as PROTO_FAULTS
 
     fault = fault if fault is not None else _fault()
     if fault is not None and fault not in FAULTS:
-        if fault in CONTRACT_FAULTS:
+        if fault in CONTRACT_FAULTS + PROTO_FAULTS:
             fault = None
         else:
-            raise ValueError(f"unknown analysis fault {fault!r}: "
-                             f"expected one of {CONTRACT_FAULTS + FAULTS}")
+            raise ValueError(
+                f"unknown analysis fault {fault!r}: expected one of "
+                f"{CONTRACT_FAULTS + FAULTS + PROTO_FAULTS}")
     findings = check_syncflow(fault)
     findings += check_signatures(fault)
     findings += check_equivalence(fault)

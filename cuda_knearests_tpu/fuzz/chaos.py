@@ -385,8 +385,20 @@ def run_chaos_campaign(n_cases: int = 16, seed: int = 0,
     In-process fault schedules first, then (unless a seeded fleet fault
     is active, whose corruption would taint the child meshes too) ONE
     cross-mesh SIGKILL drill -- the genuine mid-migration kill the
-    in-process cases cannot express."""
+    in-process cases cannot express.
+
+    The whole campaign runs under the protocol-action recorder
+    (utils/prototrace.py): every ``# proto:``-annotated site in
+    serve/fleet + pod/reshard appends its (model, action) event, and the
+    manifest carries the ``proto_stamp(trace)`` reconciliation -- the
+    drained trace must be a word in the declared models' language
+    (vocabulary + prefix-count laws), and a violation fails ``ok`` just
+    like a banked case would."""
     log = log or (lambda s: None)
+    from ..analysis.models import proto_stamp
+    from ..utils import prototrace
+
+    prototrace.enable()
     t0 = time.monotonic()
     rng = np.random.default_rng(seed)
     specs = [ChaosSpec(
@@ -423,9 +435,17 @@ def run_chaos_campaign(n_cases: int = 16, seed: int = 0,
     elif drill and fault is not None:
         log(f"[drill] skipped: KNTPU_FLEET_FAULT={fault} would taint "
             f"the child meshes")
+    trace = prototrace.drain()
+    prototrace.disable()
+    stamp = proto_stamp(trace)
+    if stamp.get("proto_trace_violations"):
+        log(f"[proto] trace violations: "
+            f"{stamp['proto_trace_violations']}")
     return {
         "ok": not failures and (mesh is None
-                                or bool(mesh["mesh_failover_ok"])),
+                                or bool(mesh["mesh_failover_ok"]))
+        and bool(stamp["proto_models_ok"]),
+        **stamp,
         "flavor": "chaos-stream",
         "requested_cases": n_cases,
         "completed_cases": completed,

@@ -356,8 +356,18 @@ def run_fleet_campaign(n_cases: int = 16, seed: int = 0,
                        budget_s: Optional[float] = None,
                        minimize: bool = True,
                        log=print) -> dict:
-    """The fleet campaign; manifest['ok'] is the rc-0 bar."""
+    """The fleet campaign; manifest['ok'] is the rc-0 bar.
+
+    Runs under the protocol-action recorder (utils/prototrace.py) like
+    the chaos campaign: the manifest's ``proto_stamp(trace)`` fields
+    prove the replication/admission action sequence the cases actually
+    walked is a word in the declared models' language, and a trace
+    violation fails ``ok``."""
     log = log or (lambda s: None)
+    from ..analysis.models import proto_stamp
+    from ..utils import prototrace
+
+    prototrace.enable()
     t0 = time.monotonic()
     rng = np.random.default_rng(seed)
     specs = []
@@ -391,8 +401,15 @@ def run_fleet_campaign(n_cases: int = 16, seed: int = 0,
         log(f"[{i + 1}/{len(specs)}] {spec.case_id()} {tag}")
         if f is not None:
             failures.append(f)
+    trace = prototrace.drain()
+    prototrace.disable()
+    stamp = proto_stamp(trace)
+    if stamp.get("proto_trace_violations"):
+        log(f"[proto] trace violations: "
+            f"{stamp['proto_trace_violations']}")
     return {
-        "ok": not failures,
+        "ok": not failures and bool(stamp["proto_models_ok"]),
+        **stamp,
         "flavor": "fleet-stream",
         "requested_cases": n_cases,
         "completed_cases": completed,

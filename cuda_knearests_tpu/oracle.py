@@ -34,7 +34,7 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib or None
         try:
             if not os.path.exists(_LIB_PATH):
-                subprocess.run(["make", "-C", _ORACLE_DIR, "-s"], check=True,
+                subprocess.run(["make", "-C", _ORACLE_DIR, "-s"], check=True,  # kntpu-ok: blocking-under-lock -- once-only build: concurrent loaders MUST wait here (releasing would race parallel makes on the same .so); the False cache makes it once-ever
                                capture_output=True)
             lib = ctypes.CDLL(_LIB_PATH)
             lib.kdt_build.restype = ctypes.c_void_p

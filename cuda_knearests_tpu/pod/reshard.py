@@ -37,6 +37,25 @@ partitioned index when the cloud refuses to hold still:
 The chaos campaign (fuzz/chaos.py) drives both layers through seeded
 fault schedules -- torn migration steps, lost ranges, wedged receivers,
 delayed handovers, chip loss -- against those oracles.
+
+Protocol table (model ``migration-handover``, analysis/models.py):
+
+========  ======================================================
+action    site
+========  ======================================================
+start     ``ElasticIndex.maybe_rebalance`` / ``force_rebalance``
+ship      ``Migration._append`` (commit) + ``_ship`` (deliver)
+insert    ``Migration.on_insert`` / ``on_delete`` (mid-migration
+          mutations entering the committed stream)
+pump      ``ElasticIndex.pump`` (bounded work per call)
+handover  ``Migration.handover`` (the atomic ownership flip)
+abort     ``Migration.abort`` (wedge bound / chip loss)
+========  ======================================================
+
+The ``# proto:`` annotations at those sites bind them to the model;
+exhaustive exploration (crash at every state) proves exactly-one
+authoritative owner per uid at all times, no torn handover (the flip
+requires acked == committed), and the wedge/abort pump bound.
 """
 
 from __future__ import annotations
@@ -56,6 +75,7 @@ from ..ops.query import launch_brute
 from ..ops.topk import INVALID_ID
 from ..runtime import dispatch as _dispatch
 from ..serve.delta import _FAR, DeltaOverlay, _merge_rows, _round_pow2
+from ..utils import prototrace
 from ..utils.profiling import annotate
 from . import halo as _halo
 from .partition import morton3
@@ -500,6 +520,8 @@ class Migration:
 
     def _append(self, kind: str, uids: np.ndarray,
                 points: Optional[np.ndarray]) -> ShipRecord:
+        # proto: migration-handover.ship
+        prototrace.record("migration-handover", "ship")
         rec = ShipRecord(seq=self.committed_seq + 1, kind=kind,
                          uids=np.asarray(uids, np.int64).reshape(-1),  # kntpu-ok: wide-dtype -- uid payload, host-only
                          points=points)
@@ -513,6 +535,7 @@ class Migration:
         receiver drops the delivery AND the ack -- the handover gate
         (acked == committed) then holds the flip forever, which is what
         makes wedging safe: the donor keeps serving."""
+        # proto: migration-handover.ship
         if self.wedged:
             return
         if rec.seq != self.acked_seq + 1:
@@ -533,6 +556,8 @@ class Migration:
         """New points that routed to the donor but live in the MOVING
         range: the donor serves them (old owner answers until handover)
         and the stream ships them."""
+        # proto: migration-handover.insert
+        prototrace.record("migration-handover", "insert")
         for u in np.asarray(uids).tolist():
             self.moving.add(int(u))
         self._append("insert", uids, np.asarray(points, np.float32))
@@ -541,6 +566,8 @@ class Migration:
         """Deletes of moving uids: already applied to the donor by the
         index; unshipped ones silently leave the queue, shipped ones ship
         a delete record so the receiver's pending set drops them."""
+        # proto: migration-handover.insert -- mid-migration mutation, same action
+        prototrace.record("migration-handover", "insert")
         dead = set(int(u) for u in np.asarray(uids).tolist()) & self.moving
         if not dead:
             return
@@ -589,6 +616,8 @@ class Migration:
         """Abandon the move: the receiver discards its pending set, the
         cuts never flip, the donor never deleted -- zero data loss by
         construction (the donor stayed the serving truth throughout)."""
+        # proto: migration-handover.abort
+        prototrace.record("migration-handover", "abort")
         self.pending.clear()
         self.state = "aborted"
 
@@ -601,6 +630,8 @@ class Migration:
         receiver misses committed data it acked), 'lost-range' flips the
         cut and deletes from the donor while the receiver applies NOTHING
         -- both provably detectable by the rebuild/differential oracles."""
+        # proto: migration-handover.handover
+        prototrace.record("migration-handover", "handover")
         index = self.index
         pend = dict(self.pending)
         if fault == "torn-migration" and pend:
@@ -909,21 +940,27 @@ class ElasticIndex:
     def maybe_rebalance(self) -> bool:
         """Start a migration when the population skew crosses the
         threshold (deterministic: same stream -> same trigger)."""
+        # proto: migration-handover.start
         if self.migration is not None or self.nshards < 2:
             return False
         skew, hot = self._skew()
         if skew < self.skew_threshold:
             return False
         self.migration = self._plan_rebalance(hot)
+        if self.migration is not None:
+            prototrace.record("migration-handover", "start")
         return self.migration is not None
 
     def force_rebalance(self) -> bool:
         """Start a boundary move off the hottest shard regardless of the
         threshold (the bench/chaos trigger)."""
+        # proto: migration-handover.start
         if self.migration is not None or self.nshards < 2:
             return False
         _, hot = self._skew()
         self.migration = self._plan_rebalance(hot)
+        if self.migration is not None:
+            prototrace.record("migration-handover", "start")
         return self.migration is not None
 
     def pump(self) -> Optional[dict]:
@@ -931,9 +968,11 @@ class ElasticIndex:
         summary on the pump that completes it.  Called between batches by
         the fleet front door -- resharding progresses UNDER traffic, and
         no single pump does unbounded work (no stop-the-world)."""
+        # proto: migration-handover.pump
         mig = self.migration
         if mig is None:
             return None
+        prototrace.record("migration-handover", "pump")
         if mig.state != "shipping":
             self.migration = None
             return None

@@ -26,6 +26,14 @@ Two mechanisms, two time scales (DESIGN.md section 17):
 
 Pure host bookkeeping: no jax, no clocks of its own (callers inject
 ``now``), unit-testable with synthetic time like serve/batching.py.
+
+Protocol binding (model ``drr-admission``, analysis/models.py):
+``enqueue`` = admission (``try_take`` / the front door's ready-queue
+appends), ``rotate`` = one :meth:`DrrScheduler.select` drain.  The
+exhaustive exploration proves the deficit stays bounded by quantum +
+max-batch and every enqueued batch dispatches within the starvation
+bound -- the state-space twin of the in-source rotation-bound argument
+in :meth:`DrrScheduler.select`.
 """
 
 from __future__ import annotations
@@ -57,6 +65,7 @@ class TokenBucket:
     def try_take(self, rows: int, now: float) -> bool:
         """Spend ``rows`` tokens if available; False = over quota (the
         caller refuses typed).  Unmetered buckets always admit."""
+        # proto: drr-admission.enqueue -- admission is where work enters a queue
         if self.rate is None:
             self.admitted_rows += int(rows)
             return True
@@ -127,6 +136,7 @@ class DrrScheduler:
         max_batch, every tenant's head batch is dispatchable within
         ceil(max_batch / quantum) visits, so the drain terminates and no
         batch starves."""
+        # proto: drr-admission.rotate
         out: List[Tuple[str, object, DrrDispatch]] = []
         if not self._order:
             return out

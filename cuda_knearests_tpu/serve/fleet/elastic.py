@@ -38,6 +38,25 @@ the elastic pod placement (DESIGN.md section 22):
   mid-migration SIGKILL, standby promotion, and the three-way verdict
   (``zero_lost`` + ``byte_identical`` + ``killed_mid_migration``) that
   becomes the ``mesh_failover`` column of the rebalance bench row.
+
+Protocol table (model ``mesh-snapshot-replay``, analysis/models.py):
+
+========  =======================================================
+action    site
+========  =======================================================
+snapshot  ``write_snapshot`` (atomic publish) / ``snapshot_tenant``
+          / ``MeshController.snapshot``
+restore   ``load_snapshot`` (checksum refusal) /
+          ``MeshProcess.restore`` / the failover restore
+replay    ``MeshController.failover``'s ``log.since`` re-ship loop
+========  =======================================================
+
+The ``# proto:`` annotations at those sites bind them to the model; the
+exhaustive exploration proves snapshot ∘ committed-tail replay
+reconstructs exactly the committed state and a torn snapshot can never
+seed a promoted standby (crash injected at every state).  The commit
+path here additionally walks ``replication-commit.apply/append`` --
+same commit law as replica.py, lifted across meshes.
 """
 
 from __future__ import annotations
@@ -58,6 +77,7 @@ import numpy as np
 from ...obs import metrics as _metrics
 from ...obs import spans as _spans
 from ...runtime.supervisor import _REPO_ROOT, RESULT_PREFIX
+from ...utils import prototrace
 from ...utils.memory import CorruptInputError, TransportError
 from .replica import (DeltaRecord, ReplicationLog, _decode_d2, _encode_rows,
                       replay_on_host)
@@ -100,6 +120,7 @@ def write_snapshot(path: str, points: np.ndarray, k: int,
     sha256 over everything.  The write goes to a same-directory temp file
     and lands via ``os.replace`` -- readers see the old snapshot or the
     new one, never a torn one."""
+    # proto: mesh-snapshot-replay.snapshot
     from ... import KnnConfig, KnnProblem
     from ...api import save_problem
 
@@ -141,10 +162,12 @@ def snapshot_tenant(tenant, path: str) -> dict:
     ``mutated_points`` is migration-aware, so the snapshot reflects
     exactly the committed state the log sequence promises."""
     nshards = tenant.elastic.nshards if tenant.elastic is not None else 1
-    return write_snapshot(
+    info = write_snapshot(                # proto: mesh-snapshot-replay.snapshot
         path, tenant.mutated_points(), tenant.spec.k,
         tenant.log.committed_seq if tenant.log is not None else 0,
         nshards)
+    prototrace.record("mesh-snapshot-replay", "snapshot")
+    return info
 
 
 def load_snapshot(path: str) -> dict:
@@ -154,6 +177,7 @@ def load_snapshot(path: str) -> dict:
     unreadable file, missing envelope, unknown/stale schema tag, or a
     checksum mismatch.  A standby mesh NEVER promotes from a snapshot
     this function refused."""
+    # proto: mesh-snapshot-replay.restore
     path = _npz_path(path)
     try:
         with np.load(path) as z:
@@ -392,6 +416,7 @@ class MeshProcess:
         """Promote this standby from a snapshot: the child refuses
         (typed, surfaced as a TransportError error frame) anything
         :func:`load_snapshot` refuses."""
+        # proto: mesh-snapshot-replay.restore
         return self._call({"op": "restore", "path": str(path)})
 
     def shards(self) -> dict:
@@ -447,15 +472,18 @@ class MeshController:
         rec = DeltaRecord(seq=self.log.committed_seq + 1, kind=kind,
                           payload=np.asarray(payload))
         self.primary.mutate(rec)         # raises TransportError if dead
-        self.log.records.append(rec)     # COMMIT
+        prototrace.record("replication-commit", "apply")  # proto: replication-commit.apply
+        self.log.records.append(rec)     # COMMIT  # proto: replication-commit.append
+        prototrace.record("replication-commit", "append")
         return rec
 
     def query(self, queries: np.ndarray, k: Optional[int] = None):
         return self.primary.query(queries, k)
 
     def snapshot(self) -> dict:
-        info = self.primary.snapshot(self.snapshot_path)
+        info = self.primary.snapshot(self.snapshot_path)  # proto: mesh-snapshot-replay.snapshot
         self.snapshot_seq = int(info["committed_seq"])
+        prototrace.record("mesh-snapshot-replay", "snapshot")
         return info
 
     def kill_primary(self) -> int:
@@ -475,12 +503,16 @@ class MeshController:
                 f"mutation(s) for a future mesh)")
         if not self.standby.alive:
             raise TransportError("mesh failover impossible: standby dead")
-        restored = self.standby.restore(self.snapshot_path)
+        restored = self.standby.restore(self.snapshot_path)  # proto: mesh-snapshot-replay.restore
+        prototrace.record("mesh-snapshot-replay", "restore")
         base_seq = int(restored["seq"])
         replayed = 0
         for rec in self.log.since(base_seq):
-            self.standby.mutate(rec)
+            self.standby.mutate(rec)     # proto: mesh-snapshot-replay.replay
             replayed += 1
+        # one replay event: the model's `replay` is the atomic tail
+        # composition (restore + replay == committed), not per record
+        prototrace.record("mesh-snapshot-replay", "replay")
         self.primary = self.standby
         self.standby = None
         self.failovers += 1

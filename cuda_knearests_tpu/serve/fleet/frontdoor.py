@@ -29,6 +29,15 @@ delta from the replication log, promoting a stale replica without the
 re-ship, tearing the last committed record out of a pod tenant's
 migration handover, and flipping a migration's range cut while the
 receiver applies nothing (pod/reshard.Migration.handover).
+
+Protocol binding (analysis/models.py; the ``# proto:`` annotations on
+the call sites below are proven complete by ``--engine proto``):
+admission's ``try_take`` and the ``t.ready.append`` sites walk
+``drr-admission.enqueue``; ``pump``'s ``drr.select`` walks
+``drr-admission.rotate`` (the exhaustive exploration proves the deficit
+bound and bounded starvation); the commit/ship path delegates to
+tenants.py (``replication-commit``) and the live-rebalance pumping to
+pod/reshard.py (``migration-handover``).
 """
 
 from __future__ import annotations
@@ -44,6 +53,7 @@ from ...config import DOMAIN_SIZE, ServeFleetConfig
 from ...io import validate_request
 from ...obs import metrics as _metrics
 from ...obs import spans as _spans
+from ...utils import prototrace
 from ...utils.memory import (InputContractError, InvalidConfigError,
                              InvalidRequestError)
 from ..batching import Batch, Request
@@ -144,7 +154,7 @@ class FleetDaemon:
         t = self.tenants.get(tenant)
         quota_ok = None
         if t is not None:
-            quota_ok = self.quota[tenant].try_take(
+            quota_ok = self.quota[tenant].try_take(   # proto: drr-admission.enqueue
                 _rows_estimate(kind, payload), now)
         try:
             payload = validate_request(
@@ -294,7 +304,8 @@ class FleetDaemon:
                           k=int(k) if k else t.spec.k, arrived_at=now,
                           trace_id=trace_id, t_perf=_spans.now())
             for batch in t.daemon.batcher.admit(req, now):
-                t.ready.append(batch)
+                t.ready.append(batch)                 # proto: drr-admission.enqueue
+                prototrace.record("drr-admission", "enqueue")
             return self.pump(now)
         # mutation / fof barriers: THIS tenant's already-flushed batches
         # execute first (they formed first -- per-tenant stream order),
@@ -306,7 +317,8 @@ class FleetDaemon:
         out = self._execute_ready(t)
         pending = t.daemon.batcher.flush("barrier", now)
         if pending is not None:
-            t.ready.append(pending)
+            t.ready.append(pending)                   # proto: drr-admission.enqueue
+            prototrace.record("drr-admission", "enqueue")
             out.extend(self._execute_ready(t))
         responses = t.daemon.submit(req_id, kind, payload, k=k, now=now,
                                     trace_id=trace_id)
@@ -355,8 +367,10 @@ class FleetDaemon:
         is stamped into the per-batch stats."""
         ready = {name: t.ready for name, t in self.tenants.items()
                  if t.daemon is not None}
+        if any(q for q in ready.values()):
+            prototrace.record("drr-admission", "rotate")
         out: List[Response] = []
-        for name, batch, disp in self.drr.select(ready):
+        for name, batch, disp in self.drr.select(ready):  # proto: drr-admission.rotate
             out.extend(self._run_batch(
                 self.tenants[name], batch,
                 {"deficit_after": disp.deficit_after,
@@ -374,7 +388,8 @@ class FleetDaemon:
                 continue
             batch = t.daemon.batcher.poll(now)
             if batch is not None:
-                t.ready.append(batch)
+                t.ready.append(batch)                 # proto: drr-admission.enqueue
+                prototrace.record("drr-admission", "enqueue")
         return self.pump(now)
 
     def drain(self, now: Optional[float] = None) -> List[Response]:
@@ -384,7 +399,8 @@ class FleetDaemon:
                 continue
             batch = t.daemon.batcher.flush("drain", now)
             if batch is not None:
-                t.ready.append(batch)
+                t.ready.append(batch)                 # proto: drr-admission.enqueue
+                prototrace.record("drr-admission", "enqueue")
         return self.pump(now)
 
     def next_deadline(self) -> Optional[float]:

@@ -34,6 +34,23 @@ stream into a REPLICATION LOG (DESIGN.md section 17):
   so callers can machine-check both halves of the failover law: the
   promoted replica's cloud equals the committed log's cloud exactly, and
   its query answers are byte-identical to a rebuild oracle on it.
+
+Protocol table (model ``replication-commit``, analysis/models.py; the
+``# proto:`` annotations below bind each action to its site and the
+proto engine proves the binding complete in both directions):
+
+========  ====================================================
+action    site
+========  ====================================================
+apply     ``Replica.apply`` / ``FailoverController.mutate``
+append    ``ReplicationLog.append`` / the ``# COMMIT`` line
+ship      per-replica ``rep.apply`` fan-out after commit
+failover  ``FailoverController.failover`` (re-ship + promote)
+========  ====================================================
+
+Invariants proven by exhaustive exploration (crash enabled at every
+state): only committed mutations acked, zero lost committed mutations
+across failover, dense sequence numbers.
 """
 
 from __future__ import annotations
@@ -54,6 +71,7 @@ import numpy as np
 from ...obs import metrics as _metrics
 from ...obs import spans as _spans
 from ...runtime.supervisor import _REPO_ROOT, RESULT_PREFIX
+from ...utils import prototrace
 from ...utils.memory import TransportError
 
 
@@ -87,6 +105,7 @@ class ReplicationLog:
         return len(self.records)
 
     def append(self, kind: str, payload: np.ndarray) -> DeltaRecord:
+        # proto: replication-commit.append
         rec = DeltaRecord(seq=self.committed_seq + 1, kind=kind,
                           payload=np.asarray(payload))
         self.records.append(rec)
@@ -126,6 +145,9 @@ class Replica:
         """Apply one record; strict sequencing (a gap means the shipper
         lost a committed delta -- corrupting silently is the one
         unacceptable outcome)."""
+        # proto: replication-commit.apply -- primary-side; as the replica
+        # receive path this same method is the ship target:
+        # proto: replication-commit.ship
         if record.seq != self.applied_seq + 1:
             raise RuntimeError(
                 f"replication sequence gap: replica at seq "
@@ -345,12 +367,15 @@ class FailoverController:
         rec = DeltaRecord(seq=self.log.committed_seq + 1, kind=kind,
                           payload=np.asarray(payload))
         self.primary.apply(rec)          # raises TransportError if dead
-        self.log.records.append(rec)     # COMMIT
+        prototrace.record("replication-commit", "apply")  # proto: replication-commit.apply
+        self.log.records.append(rec)     # COMMIT  # proto: replication-commit.append
+        prototrace.record("replication-commit", "append")
         for rep in self.replicas:
             if not rep.alive:
                 continue
             try:
-                rep.apply(rec)
+                rep.apply(rec)           # proto: replication-commit.ship
+                prototrace.record("replication-commit", "ship")
             except TransportError:
                 pass  # a dead replica just stops being a failover target
         return rec
@@ -374,14 +399,17 @@ class FailoverController:
                 "failover impossible: no live replica (committed log "
                 f"retains {self.log.committed_seq} mutation(s) for a "
                 f"future replica)")
+        # proto: replication-commit.failover
         target = max(live, key=lambda p: p.acked_seq)
         replayed = 0
         for rec in self.log.since(target.acked_seq):
-            target.apply(rec)
+            target.apply(rec)            # proto: replication-commit.ship
+            prototrace.record("replication-commit", "ship")
             replayed += 1
         target.promote()
         self.primary = target
         self.failovers += 1
+        prototrace.record("replication-commit", "failover")
         return {"promoted_pid": target.pid, "replayed": replayed,
                 "committed_seq": self.log.committed_seq}
 

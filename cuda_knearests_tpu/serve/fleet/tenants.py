@@ -38,6 +38,15 @@ byte-identical state, and the fuzz campaign drives both.  ``failover()``
 promotes the most-caught-up replica (re-shipping its committed tail) into
 the primary slot; the daemon's FoF memo is invalidated because the
 overlay identity changed.
+
+Protocol binding (model ``replication-commit``, analysis/models.py --
+the in-process twin of replica.py's process-level table): ``apply`` is
+the caller's successful primary mutation, ``append`` =
+``commit_mutation``'s log append (the commit point), ``ship`` = the
+sync-mode replica fan-out and failover's re-ship, ``failover`` =
+:meth:`Tenant.failover`.  The exhaustive exploration proves ack-only-
+after-commit and zero-lost-committed-mutations over every interleaving
+the chaos campaign samples.
 """
 
 from __future__ import annotations
@@ -51,6 +60,7 @@ import numpy as np
 from ...api import KnnProblem
 from ...config import (SLO_CLASSES, KnnConfig, ServeFleetConfig, SloClass)
 from ...pod.reshard import ElasticIndex
+from ...utils import prototrace
 from ...utils.memory import InvalidConfigError, TransportError
 from ..daemon import ServeDaemon
 from .replica import Replica, ReplicationLog
@@ -239,10 +249,13 @@ class Tenant:
             return
         if drop_from_log:
             return
-        rec = self.log.append(kind, np.asarray(payload))
+        prototrace.record("replication-commit", "apply")  # the caller's successful primary apply
+        rec = self.log.append(kind, np.asarray(payload))  # proto: replication-commit.append
+        prototrace.record("replication-commit", "append")
         if self.spec.ship_mode == "sync":
             for rep in self.replica_pool:
-                rep.apply(rec)
+                rep.apply(rec)                            # proto: replication-commit.ship
+                prototrace.record("replication-commit", "ship")
 
     def failover(self, *, skip_reship: bool = False) -> dict:
         """Kill the primary overlay and promote the most-caught-up
@@ -255,16 +268,19 @@ class Tenant:
             raise TransportError(
                 f"tenant {self.spec.name!r}: failover impossible "
                 f"(replicas={len(self.replica_pool)})")
+        # proto: replication-commit.failover
         target = max(self.replica_pool, key=lambda r: r.applied_seq)
         replayed = 0
         if not skip_reship:
             for rec in self.log.since(target.applied_seq):
-                target.apply(rec)
+                target.apply(rec)           # proto: replication-commit.ship
+                prototrace.record("replication-commit", "ship")
                 replayed += 1
         self.replica_pool.remove(target)
         self.daemon.overlay = target.overlay
         self.daemon.invalidate_fof_memo()   # memo keyed on the old overlay
         self.failovers += 1
+        prototrace.record("replication-commit", "failover")
         return {"tenant": self.spec.name, "replayed": replayed,
                 "committed_seq": self.log.committed_seq,
                 "remaining_replicas": len(self.replica_pool)}

@@ -1,0 +1,79 @@
+"""Runtime protocol-action trace recorder.
+
+The declared protocol models (analysis/models.py) are only worth
+anything if the REAL code walks the transitions they declare.  Protocol
+methods in serve/fleet/{replica,elastic,frontdoor}.py and
+pod/reshard.py call :func:`record` at each ``# proto:``-annotated site;
+the chaos campaign and the failover drills :func:`enable` the recorder
+around a run and reconcile the drained trace with
+``models.conform(...)`` -- the runtime twin of syncflow's
+``trace_sites`` reconciliation.
+
+Off by default and O(1) when off (one attribute load + truth test), so
+the hot serve path pays nothing in production.  The buffer is bounded:
+chaos cases are budgeted, but a runaway loop must not turn the recorder
+into a leak.  Thread-safe -- the fleet daemon pumps from worker
+threads.
+
+Lives in ``utils`` (not ``analysis``) because the recording sites are
+inside serve/fleet and pod, which must not import the analysis package
+(analysis imports nothing from the runtime, and the runtime must stay
+importable without it).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Tuple
+
+_MAX_EVENTS = 100_000
+
+_lock = threading.Lock()
+_events: List[Tuple[str, str]] = []
+_dropped = 0
+enabled = False
+
+
+def enable() -> None:
+    """Start recording (clears any previous trace)."""
+    global enabled, _dropped
+    with _lock:
+        _events.clear()
+        _dropped = 0
+        enabled = True
+
+
+def disable() -> None:
+    global enabled
+    with _lock:
+        enabled = False
+
+
+def record(model: str, action: str) -> None:
+    """Append one (model, action) event; no-op unless enabled."""
+    global _dropped
+    if not enabled:
+        return
+    with _lock:
+        if not enabled:
+            return
+        if len(_events) >= _MAX_EVENTS:
+            _dropped += 1
+            return
+        _events.append((model, action))
+
+
+def drain() -> List[Tuple[str, str]]:
+    """Return and clear the recorded trace (oldest first)."""
+    global _dropped
+    with _lock:
+        out = list(_events)
+        _events.clear()
+        _dropped = 0
+        return out
+
+
+def dropped() -> int:
+    """Events discarded because the bounded buffer was full."""
+    with _lock:
+        return _dropped

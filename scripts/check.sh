@@ -5,9 +5,10 @@
 #   scripts/check.sh --strict   # additionally FAIL if ruff is missing
 #
 # kntpu-check (the committed gate, needs only the runtime deps) runs the
-# abstract contract checker over every solve route, the TPU-hazard lint,
-# and the kntpu-verify dataflow verifier, entirely on CPU -- see DESIGN.md
-# sections 10 and 15.
+# abstract contract checker over every solve route, the TPU-hazard +
+# concurrency-discipline lint, the kntpu-verify dataflow verifier, and the
+# kntpu-proto protocol model checker, entirely on CPU -- see DESIGN.md
+# sections 10, 15 and 23.
 #
 # mypy is a HARD gate (ISSUE 8): its version is pinned in pyproject.toml
 # ([project.optional-dependencies] check) and CI installs it
@@ -44,7 +45,7 @@ else
     rc=1
 fi
 
-echo "== kntpu-check (contracts + lint + verify, CPU-only) =="
+echo "== kntpu-check (contracts + lint + verify + proto, CPU-only) =="
 JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.analysis || rc=1
 
 # kntpu-verify seeded-fault self-tests (DESIGN.md section 15): each of the
@@ -56,6 +57,23 @@ for fault in sync-leak sig-data-dep route-diverge; do
         python -m cuda_knearests_tpu.analysis --engine verify \
         >/dev/null 2>&1; then
         echo "   FAIL: seeded fault '$fault' was not detected (rc 0)"
+        rc=1
+    else
+        echo "   ok: '$fault' detected"
+    fi
+done
+
+# kntpu-proto seeded-fault self-tests (DESIGN.md section 23): the protocol
+# model checker's detectors must FIRE when their faults are seeded -- a
+# torn commit (ack of an unlogged mutation) and an ack-before-commit
+# reordering must each produce a model counterexample, and an unclaimed
+# protocol action site must produce a proto-leak.
+echo "== kntpu-proto seeded-fault self-tests (torn-commit / ack-before-commit / unclaimed-action) =="
+for fault in torn-commit ack-before-commit unclaimed-action; do
+    if KNTPU_ANALYSIS_FAULT=$fault JAX_PLATFORMS=cpu \
+        python -m cuda_knearests_tpu.analysis --engine proto \
+        >/dev/null 2>&1; then
+        echo "   FAIL: seeded proto fault '$fault' was not detected (rc 0)"
         rc=1
     else
         echo "   ok: '$fault' detected"

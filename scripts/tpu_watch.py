@@ -136,6 +136,18 @@ def _artifact_good(path: str, allow_partial: bool = False) -> bool:
                 ln.get("migration_ok") is True
                 and ln.get("p999_ok") is True):
             return False
+    # protocol stamp (ISSUE 18 satellite): the fleet failover and
+    # rebalance rows lean on the modeled protocols (replication commit,
+    # migration handover, mesh snapshot+replay), so a row missing the
+    # proto_stamp -- or carrying proto_models_ok != true, i.e. a model
+    # whose exhaustive exploration found a violation -- is not a record:
+    # the machinery it measured is not the machinery that was proved.
+    for ln in lines:
+        if str(ln.get("unit", "")) == "failover_ok" \
+                or "rebalance_under_load" in str(ln.get("config", "")):
+            if not ln.get("proto_version") \
+                    or ln.get("proto_models_ok") is not True:
+                return False
     # pod weak-scaling rows (ISSUE 12 satellite) are accepted as their own
     # row kind: unit 'queries/sec/chip' with pod_scaling=true.  A pod row
     # must carry its halo accounting (halo_bytes + ring_depth) and the

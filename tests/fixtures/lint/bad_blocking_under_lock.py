@@ -1,0 +1,17 @@
+"""Fixture: blocking calls while holding a lock stall every contender."""
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def tick(sock):
+    with _lock:
+        time.sleep(0.5)
+        data = sock.recv(1024)
+    return data
+
+
+def tock(proc):
+    with _lock:
+        proc.communicate()  # kntpu-ok: blocking-under-lock -- child exited already: bounded drain

@@ -1,0 +1,17 @@
+"""Fixture: ABBA deadlock shape -- opposite lock nesting in one file."""
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+
+
+def forward():
+    with a_lock:
+        with b_lock:
+            pass
+
+
+def backward():
+    with b_lock:
+        with a_lock:
+            pass

@@ -1,0 +1,18 @@
+"""Fixture: torn-state hazard -- guarded attr written without its lock."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.total = 0  # pre-publication write: never flagged
+
+    def add(self, n):
+        with self.lock:
+            self.total += n
+
+    def reset(self):
+        self.total = 0
+
+    def reset_waived(self):
+        self.total = 0  # kntpu-ok: unguarded-shared-mutable -- teardown path, single-threaded by contract

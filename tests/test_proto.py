@@ -1,0 +1,261 @@
+"""Tier-1 gate for kntpu-proto (ISSUE 18): protocol models + conformance
+binding + concurrency discipline.
+
+Four layers, mirroring the engine:
+
+* the model checker itself: deterministic exhaustive exploration, every
+  healthy model clean, every known-violating mutant in MUTANTS caught by
+  exactly the invariant that claims it, counterexamples minimal;
+* runtime trace conformance (models.conform / proto_stamp): accept/reject
+  pairs for the vocabulary and the prefix-count laws -- the contract the
+  chaos/fleet campaign manifests and the bench fleet rows stamp;
+* the conformance binding (proto.scan_scope / check_conformance): the
+  ``# proto:`` annotation parser on a fixture module, and the shipped
+  surface reconciling clean with zero unclaimed trigger calls;
+* the concurrency-discipline lint rules against their fixture corpus
+  (each fires exactly where a known-bad snippet plants it, waived twins
+  stay silent) and against the shipped tree (zero findings -- the EMPTY
+  baseline is the promise, not an aspiration);
+
+plus the CLI's exit-code contract for the seeded proto faults.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+# -- layer 1: the model checker -----------------------------------------------
+
+def test_exploration_is_deterministic():
+    from cuda_knearests_tpu.analysis.models import explore_all
+
+    a = explore_all()
+    b = explore_all()
+    assert a == b  # sorted-BFS: byte-identical reruns, reproducible traces
+
+
+def test_every_healthy_model_explores_clean():
+    from cuda_knearests_tpu.analysis.models import explore_all
+
+    for name, ex in explore_all().items():
+        assert ex.ok, f"{name}: {[v.render() for v in ex.violations]}"
+        assert ex.n_states > 1, name
+        assert ex.n_transitions >= ex.n_states - 1, name
+
+
+def test_every_mutant_caught_by_its_claimed_invariant():
+    """Each invariant is load-bearing: a model seeded with the violation
+    it guards against must be caught BY THAT invariant (catching it with
+    a different one would mean the claimed invariant is dead weight)."""
+    from cuda_knearests_tpu.analysis.models import MUTANTS, explore
+
+    for name, (model, invariant) in MUTANTS.items():
+        ex = explore(model)
+        assert not ex.ok, f"mutant {name} explored clean"
+        hit = {v.invariant for v in ex.violations}
+        assert invariant in hit, \
+            f"mutant {name}: expected '{invariant}', got {hit}"
+
+
+def test_counterexamples_are_minimal():
+    """BFS layers mean the first violation carries a shortest trace: the
+    torn-commit counterexample is the canonical 2-step ack-of-unlogged."""
+    from cuda_knearests_tpu.analysis.models import MUTANTS, explore
+
+    ex = explore(MUTANTS["torn-commit"][0])
+    v = ex.violations[0]
+    assert v.invariant == "committed-acked"
+    assert len(v.trace) == 2, v.render()
+    assert "->" in v.render()
+
+
+# -- layer 2: runtime trace conformance ---------------------------------------
+
+def test_conform_accepts_protocol_words():
+    from cuda_knearests_tpu.analysis.models import conform
+
+    assert conform([("replication-commit", "apply"),
+                    ("replication-commit", "append"),
+                    ("replication-commit", "ack")]) == []
+    assert conform([("mesh-snapshot-replay", "snapshot"),
+                    ("mesh-snapshot-replay", "restore"),
+                    ("mesh-snapshot-replay", "replay")]) == []
+    assert conform([]) == []
+
+
+@pytest.mark.parametrize("trace", [
+    # ack outran append: the exact shape the torn-commit fault produces
+    [("replication-commit", "apply"), ("replication-commit", "ack")],
+    # restore with no snapshot ever taken
+    [("mesh-snapshot-replay", "restore")],
+    # two replays after one restore (the per-record-recording bug shape)
+    [("mesh-snapshot-replay", "snapshot"),
+     ("mesh-snapshot-replay", "restore"),
+     ("mesh-snapshot-replay", "replay"),
+     ("mesh-snapshot-replay", "replay")],
+    # vocabulary violations: unknown action / unknown model
+    [("replication-commit", "frobnicate")],
+    [("no-such-model", "apply")],
+])
+def test_conform_rejects_non_words(trace):
+    from cuda_knearests_tpu.analysis.models import conform
+
+    assert conform(trace), trace
+
+
+def test_proto_stamp_carries_trace_verdict():
+    from cuda_knearests_tpu.analysis.models import (PROTO_VERSION,
+                                                    proto_stamp)
+
+    bare = proto_stamp()
+    assert bare == {"proto_version": PROTO_VERSION,
+                    "proto_models_ok": True}
+    good = proto_stamp([("replication-commit", "apply"),
+                        ("replication-commit", "append")])
+    assert good["proto_models_ok"] is True
+    assert good["proto_trace_events"] == 2
+    assert good["proto_trace_violations"] == []
+    bad = proto_stamp([("replication-commit", "ack")])
+    assert bad["proto_models_ok"] is False
+    assert bad["proto_trace_violations"]
+
+
+def test_prototrace_recorder_is_bounded_and_off_by_default():
+    from cuda_knearests_tpu.utils import prototrace
+
+    assert not prototrace.enabled
+    prototrace.record("replication-commit", "apply")  # no-op when off
+    prototrace.enable()
+    try:
+        prototrace.record("replication-commit", "apply")
+        prototrace.record("replication-commit", "append")
+        assert prototrace.drain() == [("replication-commit", "apply"),
+                                      ("replication-commit", "append")]
+        assert prototrace.drain() == []  # drain clears
+        assert prototrace.dropped() == 0
+    finally:
+        prototrace.disable()
+
+
+# -- layer 3: the conformance binding -----------------------------------------
+
+def test_scan_scope_parses_annotations_and_trigger_calls(tmp_path):
+    from cuda_knearests_tpu.analysis.proto import scan_scope
+
+    mod = tmp_path / "surface.py"
+    mod.write_text(
+        "class T:\n"
+        "    def commit(self, rec):\n"
+        "        self.log.append(rec)  # proto: replication-commit.append\n"
+        "    def leak(self, rec):\n"
+        "        self.log.append(rec)\n"
+        "    def tunnel(self, t):\n"
+        "        self.quota[t].try_take(1)\n")
+    defs, calls, claims, findings = scan_scope(paths=["surface.py"],
+                                               root=str(tmp_path))
+    assert findings == []
+    assert {d.qualname for d in defs} == {"T.commit", "T.leak", "T.tunnel"}
+    # both .log.append sites trigger; the subscript tunnels to try_take
+    assert sorted((c.lineno, c.method) for c in calls) == \
+        [(3, "append"), (5, "append"), (7, "try_take")]
+    assert [(c.model, c.action, c.lineno) for c in claims] == \
+        [("replication-commit", "append", 3)]
+
+
+def test_scan_scope_parse_error_is_a_gating_finding(tmp_path):
+    from cuda_knearests_tpu.analysis.proto import scan_scope
+
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    _, _, _, findings = scan_scope(paths=["broken.py"],
+                                   root=str(tmp_path))
+    assert [f.rule for f in findings] == ["proto-leak"]
+    assert findings[0].severity == "error"
+
+
+def test_shipped_surface_reconciles_clean():
+    """The acceptance bar: zero unclaimed trigger calls, zero stale
+    claims, every model's code actions claimed at least once."""
+    from cuda_knearests_tpu.analysis.proto import run_proto
+
+    findings = run_proto()
+    bad = [f for f in findings if f.severity != "info"]
+    assert bad == [], [f.render() for f in bad]
+    assert any("reconciled" in f.message for f in findings)
+
+
+@pytest.mark.parametrize("fault,needle", [
+    ("torn-commit", "committed-acked"),
+    ("ack-before-commit", "committed-acked"),
+    ("unclaimed-action", "proto-leak"),
+])
+def test_seeded_fault_provably_fires(fault, needle):
+    from cuda_knearests_tpu.analysis.proto import run_proto
+
+    findings = run_proto(fault=fault)
+    errors = [f for f in findings if f.severity == "error"]
+    assert errors, fault
+    assert any(needle in (f.message + f.rule) for f in errors), \
+        [f.render() for f in errors]
+    # every proto finding routes as a contract-class failure (rc 1)
+    assert all(f.path.startswith("route:") for f in errors)
+
+
+def test_unknown_fault_is_refused():
+    from cuda_knearests_tpu.analysis.proto import run_proto
+
+    with pytest.raises(ValueError, match="torn-commit"):
+        run_proto(fault="no-such-fault")
+
+
+# -- layer 4: concurrency-discipline lint -------------------------------------
+
+@pytest.mark.parametrize("fixture,rule,lines", [
+    ("bad_unguarded_shared.py", "unguarded-shared-mutable", {15}),
+    ("bad_lock_order.py", "lock-order", {10}),
+    ("bad_blocking_under_lock.py", "blocking-under-lock", {10, 11}),
+])
+def test_discipline_rule_fires_exactly_where_planted(fixture, rule, lines):
+    from cuda_knearests_tpu.analysis.lint import lint_paths
+
+    findings = lint_paths([os.path.join(FIXTURES, fixture)])
+    assert {f.rule for f in findings} == {rule}, findings
+    assert {f.line for f in findings} == lines, findings
+
+
+def test_discipline_rules_clean_on_shipped_tree():
+    """The EMPTY-baseline promise for the three new rules specifically:
+    real finds were fixed (or waived with reasons) at introduction time,
+    so the shipped threaded tree carries zero findings of each."""
+    from cuda_knearests_tpu.analysis.lint import lint_paths
+
+    rules = {"unguarded-shared-mutable", "lock-order",
+             "blocking-under-lock"}
+    hits = [f for f in lint_paths() if f.rule in rules]
+    assert hits == [], [f.render() for f in hits]
+
+
+# -- the CLI exit-code contract -----------------------------------------------
+
+def _cli(*args, **env):
+    e = dict(os.environ, JAX_PLATFORMS="cpu", **env)
+    return subprocess.run(
+        [sys.executable, "-m", "cuda_knearests_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO, env=e)
+
+
+def test_cli_proto_engine_rc0_on_clean_tree():
+    r = _cli("--engine", "proto")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "reconciled" in r.stdout
+
+
+def test_cli_proto_fault_exits_rc1():
+    r = _cli("--engine", "proto", KNTPU_ANALYSIS_FAULT="torn-commit")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "committed-acked" in r.stdout

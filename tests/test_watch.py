@@ -234,7 +234,8 @@ def test_artifact_good_rebalance_row_kind(tmp_path):
     good_row = {"platform": "tpu", "unit": "p999_ms", "value": 12.0,
                 "config": "serving fleet [rebalance_under_load]: pod "
                           "tenant, forced live Morton rebalance",
-                "migration_ok": True, "p999_ok": True, "failover_ok": True}
+                "migration_ok": True, "p999_ok": True, "failover_ok": True,
+                "proto_version": "1.0.0", "proto_models_ok": True}
     p.write_text(json.dumps({"rc": 0, "lines": [good_row]}))
     assert tpu_watch._artifact_good(str(p))
     for flag in ("migration_ok", "p999_ok"):
@@ -261,6 +262,38 @@ def test_artifact_good_rebalance_row_kind(tmp_path):
     spec.loader.exec_module(bd)
     assert "migration_ok" in bd.STRICT_BOOLS
     assert "p999_ok" in bd.STRICT_BOOLS
+
+
+def test_artifact_good_requires_proto_stamp_on_fleet_rows(tmp_path):
+    """ISSUE 18 satellite: the fleet_failover and rebalance_under_load
+    rows lean on the modeled protocols (replication commit, migration
+    handover, mesh snapshot+replay), so a row missing the proto_stamp --
+    or whose proto_models_ok is not true -- is refused: the machinery the
+    row measured is not the machinery that was proved."""
+    p = tmp_path / "proto.json"
+    failover_row = {"platform": "tpu", "unit": "failover_ok", "value": 1.0,
+                    "failover_ok": True,
+                    "proto_version": "1.0.0", "proto_models_ok": True}
+    rebalance_row = {"platform": "tpu", "unit": "p999_ms", "value": 9.0,
+                     "config": "serving fleet [rebalance_under_load]: x",
+                     "migration_ok": True, "p999_ok": True,
+                     "proto_version": "1.0.0", "proto_models_ok": True}
+    for row in (failover_row, rebalance_row):
+        p.write_text(json.dumps({"rc": 0, "lines": [row]}))
+        assert tpu_watch._artifact_good(str(p))
+        # stamp missing entirely -> refused
+        p.write_text(json.dumps({"rc": 0, "lines": [
+            {k: v for k, v in row.items()
+             if k not in ("proto_version", "proto_models_ok")}]}))
+        assert not tpu_watch._artifact_good(str(p))
+        # models explored dirty (or trace violated) -> refused
+        p.write_text(json.dumps({"rc": 0, "lines": [
+            dict(row, proto_models_ok=False)]}))
+        assert not tpu_watch._artifact_good(str(p))
+    # non-fleet rows carry no such obligation
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        {"platform": "tpu", "unit": "GB/s", "value": 1.0}]}))
+    assert tpu_watch._artifact_good(str(p))
 
 
 # -- kntpu-scope capture harness (ISSUE 15) -----------------------------------
