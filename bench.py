@@ -797,11 +797,12 @@ def bench_frontier() -> list:
 
 _SERVE_SCENARIOS = ("serve_20k_steady", "serve_20k_mutating",
                     "serve_20k_contained_fault", "fleet_4tenant_mix",
-                    "fleet_failover", "rebalance_under_load")
+                    "fleet_failover", "rebalance_under_load",
+                    "diurnal_autoscale")
 
 # names routed to _fleet_scenario (everything else is a single-daemon row)
 _FLEET_SCENARIO_NAMES = ("fleet_4tenant_mix", "fleet_failover",
-                         "rebalance_under_load")
+                         "rebalance_under_load", "diurnal_autoscale")
 
 
 def _serve_scenario_names() -> list:
@@ -848,7 +849,15 @@ def _fleet_scenario(name: str) -> dict:
     migration, decomposed via latency_decomposition), and
     ``failover_ok`` (the cross-mesh mid-migration SIGKILL drill:
     snapshot + committed-log replay, zero lost committed mutations,
-    post-failover answers byte-identical to the rebuild oracle)."""
+    post-failover answers byte-identical to the rebuild oracle).
+
+    ``diurnal_autoscale``: the traffic-driven autoscale + brownout row
+    (DESIGN.md section 24) -- sine-modulated Poisson arrivals with
+    client backoff, the Autoscaler live on the front door, and two
+    strict booleans: ``autoscale_ok`` (all three actuator families
+    fired, zero lost committed mutations, zero steady-state recompiles)
+    and ``brownout_ok`` (the ladder stepped down under the flood AND
+    recovered to exact, byte-identical)."""
     from cuda_knearests_tpu.serve.fleet import (TenantLoad,
                                                 default_fleet_builds,
                                                 failover_drill)
@@ -857,6 +866,8 @@ def _fleet_scenario(name: str) -> dict:
 
     if name == "rebalance_under_load":
         return _rebalance_scenario()
+    if name == "diurnal_autoscale":
+        return _diurnal_autoscale_scenario()
     if name == "fleet_failover":
         drill = failover_drill(
             n=int(os.environ.get("BENCH_FLEET_FAILOVER_N", "1500")),
@@ -1025,6 +1036,249 @@ def _rebalance_scenario() -> dict:
     }
 
 
+def _diurnal_autoscale_scenario() -> dict:
+    """The ``diurnal_autoscale`` row (DESIGN.md section 24): a 6-tenant
+    fleet under sine-modulated Poisson arrivals with client backoff, the
+    Autoscaler closing the sensor -> policy -> actuator loop live.  The
+    flood peak must fire all THREE actuator families (replica scale-up,
+    a pod boundary move, a measured-load dense -> pod promotion) and walk
+    the throughput class down the brownout ladder; the trough must walk
+    it all the way back.  Two strict booleans ride the row:
+
+    ``autoscale_ok``: scale_up >= 1 AND a widen-or-narrow boundary move
+    AND promote >= 1, with ZERO steady-state recompiles (index builds
+    carved into ``elastic_recompiles``), zero failed requests, full
+    recovery (every added replica gone), the no-drop-tail probe (every
+    committed log tail replayable from its pool's applied floor), and a
+    zero-lost-committed failover drill over the LAZY-shipped replication
+    path.
+
+    ``brownout_ok``: brown_down >= 1 and brown_up >= 1 with degraded
+    rows actually served on the wire, every dense tenant back at the
+    exact tier, and a fixed query batch answered BYTE-IDENTICALLY before
+    the flood and after recovery (degradation is an episode, not a
+    ratchet)."""
+    import dataclasses as _dc
+    import time as _time
+
+    import numpy as np
+
+    from cuda_knearests_tpu.config import ServeFleetConfig
+    from cuda_knearests_tpu.io import generate_uniform
+    from cuda_knearests_tpu.mxu.solve import solve_general
+    from cuda_knearests_tpu.serve.fleet import (AutoscaleConfig,
+                                                TenantLoad,
+                                                default_fleet_builds)
+    from cuda_knearests_tpu.serve.fleet.frontdoor import FleetDaemon
+    from cuda_knearests_tpu.serve.fleet.loadgen import run_fleet_session
+    from cuda_knearests_tpu.serve.fleet.tenants import TenantSpec
+
+    n = int(os.environ.get("BENCH_AUTOSCALE_N", "2500"))
+    k = 8
+    _dispatch.EXEC_CACHE.clear()
+    builds = default_fleet_builds(n_tenants=5, base_n=n, k=k, seed=17)
+    # lazy shipping (the scale-down compaction floor is only observable
+    # when replicas genuinely lag) -- the same flip the --autoscale smoke
+    # makes
+    builds = [(_dc.replace(spec, ship_mode="lazy"), pts)
+              for spec, pts in builds]
+    # one pod tenant in the FLOOD class so the widen/narrow boundary-move
+    # actuator has a target; the threshold sits above every dense cloud
+    pod_threshold = n + 1024 * 5
+    cfg = _dc.replace(ServeFleetConfig(),
+                      pod_threshold=pod_threshold, pod_shards=2)
+    builds.append((TenantSpec(name="pod0", k=k, slo="throughput"),
+                   generate_uniform(pod_threshold + 512, seed=17 + 997)))
+    as_cfg = AutoscaleConfig(
+        period_s=0.005,
+        # only t3 (n + 3072 points) clears the size floor -- t1 (n) stays
+        # dense as the brownout probe and t2 (n + 2048, the largest
+        # LATENCY tenant) stays under it, so exactly one measured-load
+        # promotion can fire and it must be the flooded large tenant
+        promote_min_points=n + 3000,
+        # high enough that promotion needs the diurnal PEAK's sustained
+        # rows -- on the shoulders the ladder reaches the brownout rung
+        # first, so the row exercises degrade-then-reprovision, not just
+        # reprovision
+        promote_load_rows=int(os.environ.get(
+            "BENCH_AUTOSCALE_PROMOTE_ROWS", "192")))
+    _watchdog.heartbeat()
+    fleet = FleetDaemon(builds, cfg, autoscale=as_cfg)
+    _watchdog.heartbeat()
+    # warm the brownout tiers' mxu shapes for both dense throughput
+    # tenants (tier 1: bf16 + brute refine; tier 2: bf16 + lowered
+    # recall) -- qc depends only on the padded cloud, so ONE warm query
+    # batch covers every batch width the session can form
+    wq = (np.random.default_rng(5).random((4, 3)) * 100.0
+          + 5.0).astype(np.float32)
+    for t in fleet.tenants.values():
+        if t.daemon is None or t.spec.slo != "throughput":
+            continue
+        pts = t.daemon.overlay.mutated_points()
+        for rt, refine in ((1.0, "brute"), (as_cfg.recall_target, "none")):
+            solve_general(pts, k=k, recall_target=rt, refine=refine,
+                          queries=wq, scorer="mxu", precision="bf16")
+        _watchdog.heartbeat()
+    # seed hotspot skew on the pod tenant (one bulk insert into a hot
+    # range, past the compaction threshold so the delta folds now) and
+    # warm its batch shapes: the policy's widen actuator is a
+    # force_rebalance boundary move, which only has a move to make on a
+    # genuinely skewed shard map
+    el = fleet.tenants["pod0"].elastic
+    rng0 = np.random.default_rng(31)
+    el.insert((rng0.random((cfg.compact_threshold + 64, 3)) * 110.0
+               + 5.0).astype(np.float32))
+    for m in (1, 4, 16, 64):
+        el.query(np.zeros((m, 3), np.float32), k)
+    _watchdog.heartbeat()
+    # warm the shapes the PROMOTED pod will serve with: the session is
+    # mutation-free, so t3's cloud at promotion time is its cloud now,
+    # and the Morton shard split is deterministic -- a throwaway build
+    # over the same cloud populates the executable cache with exactly
+    # the scatter-gather shapes the mid-session promotion would
+    # otherwise compile inside the measured window
+    from cuda_knearests_tpu.pod.reshard import ElasticIndex
+    warm_el = ElasticIndex(
+        fleet.tenants["t3"].daemon.overlay.mutated_points(),
+        k=k, nshards=cfg.pod_shards,
+        compact_threshold=cfg.compact_threshold,
+        skew_threshold=cfg.pod_skew_threshold)
+    for m in (1, 4, 16, 64):
+        warm_el.query(np.zeros((m, 3), np.float32), k)
+    del warm_el
+    _watchdog.heartbeat()
+    # the byte-identity pin: a fixed batch on the brownout-probe tenant,
+    # answered exact BEFORE the flood (pre-session, so outside the
+    # measured recompile window) and again after full recovery
+    probe_q = (np.random.default_rng(6).random((8, 3)) * 100.0
+               + 5.0).astype(np.float32)
+
+    def _probe(rid: int):
+        now = fleet.clock()
+        rs = fleet.submit(rid, "t1", "query", probe_q, k=k, now=now)
+        rs = list(rs) + list(fleet.drain(now))
+        return next((r for r in rs if r.req_id == rid), None)
+
+    pre = _probe(10 ** 8)
+    reqs = int(os.environ.get("BENCH_AUTOSCALE_REQUESTS", "240"))
+    rate = float(os.environ.get("BENCH_AUTOSCALE_RATE", "3600"))
+    loads = []
+    for i, (spec, _pts) in enumerate(builds):
+        t = fleet.tenants[spec.name]
+        flood = spec.slo == "throughput" and t.daemon is not None
+        loads.append(TenantLoad(
+            tenant=spec.name,
+            rate=rate if flood else 400.0,
+            requests=reqs * 2 if flood else reqs,
+            diurnal=4.0, backoff=True, seed=70 + i))
+    summary = run_fleet_session(fleet, loads)
+    _watchdog.heartbeat()
+    sc = fleet.autoscaler
+    # recovery: pump synthetic ticks (idle sensors -> clear streaks) until
+    # the ladder walks back to exact and every added replica is gone --
+    # the same deterministic tail as the __main__ --autoscale epilogue
+    base = _time.monotonic()
+    recovered = False
+    for i in range(1200):
+        fleet.poll(base + (i + 1) * as_cfg.period_s * 1.01)
+        dense = [t for t in fleet.tenants.values() if t.daemon is not None]
+        if (all(t.degraded_tier == 0 for t in dense)
+                and all(st.tier == 0 for st in sc.classes.values())
+                and sum(sc.added.values()) == 0):
+            recovered = True
+            break
+    post = _probe(10 ** 8 + 1)
+    byte_identical = bool(
+        pre is not None and post is not None and pre.ok and post.ok
+        and pre.degraded is None and post.degraded is None
+        and np.array_equal(pre.ids, post.ids)
+        and np.array_equal(pre.d2, post.d2))
+    # zero-lost-committed drill on a LATENCY tenant (never browned, never
+    # the probe): one replica born lazy at today's seq, two committed
+    # inserts it never saw shipped, then failover must replay exactly
+    # that tail and land byte-identical to the host oracle
+    t0t = fleet.tenants["t0"]
+    rng = np.random.default_rng(9)
+    before = t0t.daemon.overlay.mutated_points().copy()
+    zero_lost = bool(t0t.add_replica())
+    tail = [(rng.random((3, 3)) * 100.0 + 5.0).astype(np.float32)
+            for _ in range(2)]
+    for j, pts in enumerate(tail):
+        rs = fleet.submit(10 ** 8 + 2 + j, "t0", "insert", pts,
+                          now=fleet.clock())
+        zero_lost = zero_lost and bool(rs and rs[-1].ok)
+    fo = t0t.failover() if zero_lost else {"replayed": -1}
+    zero_lost = (zero_lost and fo["replayed"] == 2
+                 and np.array_equal(
+                     t0t.daemon.overlay.mutated_points(),
+                     np.concatenate([before] + tail)))
+    # no-drop-tail: every surviving committed tail still replayable from
+    # its pool's applied floor (the scale-down compaction-floor law)
+    drop_tail = None
+    for t in fleet.tenants.values():
+        if t.log is None:
+            continue
+        floor = min((r.applied_seq for r in t.replica_pool), default=0)
+        try:
+            list(t.log.since(floor))
+        except RuntimeError as e:
+            drop_tail = f"{t.spec.name}: {e}"
+            break
+    _watchdog.heartbeat()
+    stats = sc.stats_dict()
+    dense_tiers_exact = all(
+        t.degraded_tier == 0 for t in fleet.tenants.values()
+        if t.daemon is not None)
+    autoscale_ok = bool(
+        stats["scale_up"] >= 1
+        and (stats["widen"] + stats["narrow"]) >= 1
+        and stats["promote"] >= 1
+        and summary["recompiles"] == 0
+        and summary["exec_cache_enabled"]
+        and summary["failed_requests"] == 0
+        and recovered
+        and drop_tail is None
+        and zero_lost)
+    brownout_ok = bool(
+        stats["brown_down"] >= 1
+        and stats["brown_up"] >= 1
+        and sum(summary["degraded_rows"].values()) > 0
+        and dense_tiers_exact
+        and byte_identical)
+    return {
+        "config": f"serving fleet [diurnal_autoscale]: 6 tenants under "
+                  f"sine-modulated Poisson (peak/trough 4x) with client "
+                  f"backoff; autoscaler drives replicas + boundary "
+                  f"moves + promotion + the brownout ladder "
+                  f"(base n={n}, k={k})",
+        "value": summary["sustained_qps"],
+        "unit": "queries/sec",
+        "backend": "fleet",
+        "recall": 1.0,  # exact again at rest: the recovery IS the verdict
+        "precision": "f32",
+        "n_points": n,
+        "autoscale_ok": autoscale_ok,
+        "brownout_ok": brownout_ok,
+        "autoscale_recovered": recovered,
+        "byte_identical_after_recovery": byte_identical,
+        "zero_lost_committed": zero_lost,
+        "drop_tail": drop_tail,
+        "autoscale_counters": {key: stats[key] for key in (
+            "ticks", "scale_up", "scale_down", "widen", "narrow",
+            "promote", "brown_down", "brown_up", "shed",
+            "actuation_failures")},
+        **{key: summary[key] for key in (
+            "requests", "completed_queries", "failed_requests",
+            "refused_requests", "deferred_requests", "degraded_rows",
+            "elapsed_s", "recompiles", "elastic_recompiles",
+            "migrations_done", "fleet_batches", "occupancy_mean",
+            "jain_fairness", "n_tenants", "host_syncs",
+            "exec_cache_hits", "exec_cache_misses",
+            "latency_decomposition")},
+        **_proto_fields(),
+    }
+
+
 def serve_scenario(name: str) -> dict:
     """One open-loop serving session (serve/, DESIGN.md section 13) as a
     bench row: sustained QPS under Poisson arrivals, p50/p99/p999 latency,
@@ -1121,9 +1375,9 @@ def _proto_fields() -> dict:
     """kntpu-proto traceability stamp (ISSUE 18): which protocol model
     set the fleet rows' replication/migration/admission machinery is
     checked against, and that every model explored clean.  Only the
-    fleet_failover / rebalance_under_load rows carry it -- those are the
-    rows whose verdicts lean on the modeled protocols.  Pure host work,
-    cached per process."""
+    fleet_failover / rebalance_under_load / diurnal_autoscale rows carry
+    it -- those are the rows whose verdicts lean on the modeled
+    protocols.  Pure host work, cached per process."""
     try:
         from cuda_knearests_tpu.analysis.models import proto_stamp
 

@@ -47,7 +47,7 @@ from typing import (Callable, Dict, Iterable, List, Mapping, Optional,
 # Version of the protocol-model subsystem: bump on any model/invariant
 # change so chaos manifests and fleet bench rows (which stamp it) are
 # traceable to the exact model set a run reconciled against.
-PROTO_VERSION = "1.0.0"
+PROTO_VERSION = "1.1.0"
 
 State = tuple
 ActionFn = Callable[[State], Iterable[Tuple[str, State]]]
@@ -618,15 +618,183 @@ def _drr_model(*, no_deficit_reset: bool = False,
 
 
 # =============================================================================
+# Model 5: autoscale -- sensor -> policy -> actuator loop + brownout ladder
+# =============================================================================
+
+_A_B = 2      # hysteresis: consecutive breach/clear ticks before acting
+_A_C = 2      # cooldown ticks after any actuation (C <= B => anti-flap)
+_A_TIER = 2   # ladder depth: 0 exact -> 1 bf16 -> 2 lowered recall
+_A_BOUND = _A_B + _A_C  # truth-ticks a condition may persist unanswered
+
+
+def _autoscale_model(*, stuck_sensor: bool = False,
+                     flap_policy: bool = False,
+                     drop_tail: bool = False,
+                     no_recovery: bool = False,
+                     brown_regress: bool = False) -> Model:
+    """The traffic-driven autoscale + brownout control loop
+    (serve/fleet/autoscale.py): a deterministic tick samples one sensor
+    bit (the class is over / under its SLO budget), hysteresis requires
+    B consecutive agreeing ticks before any actuation, and every
+    actuation opens a C-tick cooldown.  Breach ladder: provision a
+    replica first, then step the brownout tier down, then shed; clear
+    ladder: ALWAYS recover to the exact tier before de-provisioning.
+    Scale-down compacts the replication log only to the remaining pool's
+    applied floor, never to the committed head.
+
+    State: (load, tier, bs, cs, bt, ct, cool, extra, committed, applied,
+    compacted, since, gap, wrong) -- bs/cs are the SENSED breach/clear
+    streaks the policy acts on, bt/ct the TRUE ones (they diverge only
+    under the stuck-sensor mutant), ``since`` ticks since the last
+    actuation, ``gap`` the minimum such spacing ever observed, ``wrong``
+    a flag the brown-regress mutant sets by stepping the ladder DOWN on
+    a clear signal.  The tick is enabled only when no actuation is --
+    the policy is deterministic, so liveness ("the loop reacts within
+    B + C ticks") is a state invariant, not a fairness assumption.
+    """
+    initial = (0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, _A_C, _A_C, 0)
+
+    def actions(s: State):
+        (load, tier, bs, cs, bt, ct, cool, extra,
+         committed, applied, compacted, since, gap, wrong) = s
+        out = []
+        # -- environment: load flips, a mutation commits, a replica ships
+        out.append(("breach" if load == 0 else "clear",
+                    (1 - load, tier, bs, cs, bt, ct, cool, extra,
+                     committed, applied, compacted, since, gap, wrong)))
+        if committed == 0:
+            out.append(("commit",
+                        (load, tier, bs, cs, bt, ct, cool, extra, 1,
+                         applied, compacted, since, gap, wrong)))
+        if applied < committed:
+            out.append(("ship",
+                        (load, tier, bs, cs, bt, ct, cool, extra,
+                         committed, applied + 1, compacted, since, gap,
+                         wrong)))
+
+        # -- policy: which actuation (if any) is enabled right now
+        def actuate(label, breach_side, *, tier2=tier, extra2=extra,
+                    compacted2=compacted, wrong2=wrong):
+            nbs, nbt = (0, 0) if breach_side else (bs, bt)
+            ncs, nct = (cs, ct) if breach_side else (0, 0)
+            return (label, (load, tier2, nbs, ncs, nbt, nct, _A_C,
+                            extra2, committed, applied, compacted2, 0,
+                            min(gap, since), wrong2))
+
+        ready = flap_policy or cool == 0
+        need = 1 if flap_policy else _A_B
+        acts = []
+        if ready and bs >= need:
+            if extra == 0:
+                acts.append(actuate("scale_up", True, extra2=1))
+            elif tier < _A_TIER:
+                acts.append(actuate("brown_down", True, tier2=tier + 1))
+            else:
+                acts.append(actuate("shed", True))
+        if ready and cs >= need:
+            if brown_regress and tier < _A_TIER:
+                # mutant: the ladder steps the WRONG direction on a
+                # clear signal -- brownout is no longer monotone per
+                # episode
+                acts.append(actuate("brown_down", False, tier2=tier + 1,
+                                    wrong2=1))
+            if tier > 0 and not no_recovery:
+                acts.append(actuate("brown_up", False, tier2=tier - 1))
+            elif tier == 0 and extra == 1 and not no_recovery:
+                target = committed if drop_tail else applied
+                acts.append(actuate("scale_down", False, extra2=0,
+                                    compacted2=max(compacted, target)))
+        out.extend(acts)
+
+        # -- tick: enabled only when the deterministic policy has
+        # nothing to fire (see docstring)
+        if not acts:
+            sensed = 0 if stuck_sensor else load
+            out.append(("tick",
+                        (load, tier,
+                         min(_A_B, bs + 1) if sensed else 0,
+                         min(_A_B, cs + 1) if not sensed else 0,
+                         min(_A_BOUND + 1, bt + 1) if load else 0,
+                         min(_A_BOUND + 1, ct + 1) if not load else 0,
+                         max(0, cool - 1), extra, committed, applied,
+                         compacted, min(_A_C, since + 1), gap, wrong)))
+        return out
+
+    def inv_reaction(s: State) -> Optional[str]:
+        bt = s[4]
+        if bt > _A_BOUND:
+            return (f"a breach persisted through {bt} ticks without any "
+                    f"actuation (bound {_A_BOUND} = hysteresis {_A_B} + "
+                    f"cooldown {_A_C}): the sensor->policy loop is not "
+                    f"reacting")
+        return None
+
+    def inv_recovery(s: State) -> Optional[str]:
+        tier, ct, extra = s[1], s[5], s[7]
+        if ct > _A_BOUND and (tier > 0 or extra):
+            return (f"the load cleared {ct} ticks ago yet the fleet is "
+                    f"still degraded (tier {tier}, extra replicas "
+                    f"{extra}): brownout does not recover to exact")
+        return None
+
+    def inv_flap(s: State) -> Optional[str]:
+        gap = s[12]
+        if gap < _A_C:
+            return (f"two actuations fired only {gap} tick(s) apart "
+                    f"(cooldown {_A_C}): oscillation is unbounded")
+        return None
+
+    def inv_tail(s: State) -> Optional[str]:
+        applied, compacted = s[9], s[10]
+        if compacted > applied:
+            return (f"scale-down compacted the replication log to seq "
+                    f"{compacted} past the remaining pool's applied "
+                    f"floor {applied}: a later failover hits a gap")
+        return None
+
+    def inv_monotone(s: State) -> Optional[str]:
+        if s[13]:
+            return ("the ladder stepped DOWN on a clear signal: "
+                    "brownout is not monotone within the episode")
+        return None
+
+    return Model(
+        name="autoscale",
+        doc="B-tick hysteresis + C-tick cooldown around a provision -> "
+            "brownout -> shed ladder; recovery always restores the "
+            "exact tier before de-provisioning, and scale-down never "
+            "compacts past the applied floor",
+        initial=initial,
+        actions_fn=actions,
+        invariants={
+            "breach-reaction": inv_reaction,
+            "bounded-recovery": inv_recovery,
+            "anti-flap": inv_flap,
+            "no-drop-tail": inv_tail,
+            "brownout-monotone": inv_monotone,
+        },
+        vocabulary=("breach", "clear", "commit", "ship", "tick",
+                    "scale_up", "scale_down", "brown_down", "brown_up",
+                    "shed"),
+        code_actions=("tick", "scale_up", "scale_down", "brown_down",
+                      "brown_up", "shed"),
+        scope=f"1 class, ladder depth {_A_TIER}, hysteresis {_A_B}, "
+              f"cooldown {_A_C}, 1 elastic replica, 1 in-flight delta",
+        prefix_laws=(("scale_down", "scale_up"),
+                     ("brown_up", "brown_down")),
+    )
+
+
+# =============================================================================
 # Registry + faults + mutants
 # =============================================================================
 
 def healthy_models() -> Dict[str, Model]:
-    """The four shipped models (all invariants hold; proto.py explores
+    """The five shipped models (all invariants hold; proto.py explores
     every one on every gate run)."""
     return {m.name: m for m in (
         _replication_model(), _migration_model(), _snapshot_model(),
-        _drr_model())}
+        _drr_model(), _autoscale_model())}
 
 
 # Known-violating mutant models: each weakens exactly one guard and is
@@ -657,6 +825,15 @@ MUTANTS: Dict[str, Tuple[Model, str]] = {
     "no-deficit-reset": (_drr_model(no_deficit_reset=True),
                          "deficit-bound"),
     "skip-tenant": (_drr_model(skip_tenant=True), "starvation-bound"),
+    "stuck-sensor": (_autoscale_model(stuck_sensor=True),
+                     "breach-reaction"),
+    "flap-policy": (_autoscale_model(flap_policy=True), "anti-flap"),
+    "scale-drop-tail": (_autoscale_model(drop_tail=True),
+                        "no-drop-tail"),
+    "no-recovery": (_autoscale_model(no_recovery=True),
+                    "bounded-recovery"),
+    "brown-regress": (_autoscale_model(brown_regress=True),
+                      "brownout-monotone"),
 }
 
 

@@ -68,6 +68,7 @@ SCOPE = (
     "cuda_knearests_tpu/serve/fleet/frontdoor.py",
     "cuda_knearests_tpu/serve/fleet/tenants.py",
     "cuda_knearests_tpu/serve/fleet/admission.py",
+    "cuda_knearests_tpu/serve/fleet/autoscale.py",
     "cuda_knearests_tpu/pod/reshard.py",
 )
 
@@ -100,6 +101,10 @@ TRIGGERS: Tuple[Tuple[str, Tuple[Tuple[str, str], ...]], ...] = (
     (".drr.select", (("drr-admission", "rotate"),)),
     ("try_take", (("drr-admission", "enqueue"),)),
     (".ready.append", (("drr-admission", "enqueue"),)),
+    ("add_replica", (("autoscale", "scale_up"),)),
+    ("remove_replica", (("autoscale", "scale_down"),)),
+    ("brown_down", (("autoscale", "brown_down"),)),
+    ("brown_up", (("autoscale", "brown_up"),)),
 )
 
 

@@ -240,15 +240,21 @@ WINDOWS: Dict[str, Window] = {
     # scheduler dispatches one tenant's flushed batch through that
     # tenant's OWN ServeDaemon._execute -- the fleet tier adds admission,
     # scheduling, and replication bookkeeping (all host-side), never a
-    # transfer site, so the proven bound is exactly the serve bound.
+    # transfer site, so the proven bound is exactly the serve bound.  A
+    # BROWNED tenant (DESIGN.md section 24) executes through the
+    # mxu-brute window instead (solve_general at the degraded tier), an
+    # either/or whose 1 + fb is dominated by the serve expression, so
+    # the proven bound is unchanged.
     "fleet-batch": Window(
         entries=("serve.fleet.frontdoor.FleetDaemon._run_batch",),
-        includes=("serve-batch",),
+        includes=("serve-batch", "mxu-brute"),
         sites={},
         syncs="(1 + fb) + tomb + delta", budget="4",
         notes="_run_batch -> tenant.daemon._execute is attribute "
-              "dispatch; declared via includes and pinned by the fleet "
-              "cache-sharing tests (tests/test_fleet.py)"),
+              "dispatch, _execute_degraded -> solve_general is the "
+              "brownout tier; both declared via includes and pinned by "
+              "the fleet cache-sharing + brownout byte-identity tests "
+              "(tests/test_fleet.py, tests/test_autoscale.py)"),
     # Replication apply: a replica applies one committed DeltaRecord
     # through the overlay's insert/delete -- pure host CSR bookkeeping
     # (tombstones, delta rows, cache invalidation).  ZERO host syncs: the

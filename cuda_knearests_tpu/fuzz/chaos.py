@@ -27,6 +27,15 @@ attacks tenant isolation:
   corruptions (a dropped final handover record / a fully lost range);
   each provably yields a banked failure (the check.sh self-tests),
   diverted away from the real corpus like every faulted flavor.
+* Four NAMED autoscale schedules (DESIGN.md section 24) ride the same
+  replay: a stuck sensor under ticking load, a flapping brownout ladder,
+  a scale-down racing a live migration (the compaction-floor probe runs
+  inline), and a brownout spanning a failover with the byte-exact
+  differential check re-armed after recovery.  Their op kinds
+  (scale-up/-down, brown-down/-up, failover, stick-sensors, tick) drive
+  the REAL actuators -- the same calls the Autoscaler's policy makes --
+  and ``KNTPU_FLEET_FAULT=scale-drop-tail`` corrupts them exactly as it
+  corrupts the policy (banked + diverted like every faulted flavor).
 * The campaign's last case is the cross-mesh SIGKILL drill
   (serve/fleet/elastic.mesh_failover_drill): a genuine mid-migration kill
   of a child-process mesh, standby promotion from the checksummed
@@ -56,6 +65,13 @@ CHAOS_POD_THRESHOLD = 160
 CHAOS_MIGRATION_CHUNK = 8
 CHAOS_ABORT_AFTER_PUMPS = 40
 _HOT = 0.12          # the hotspot sub-cube: [0, _HOT*domain)^3
+
+# op kinds that exercise the autoscale surface; a schedule containing
+# any of them replays with the Autoscaler attached and the dense tenant
+# shipping LAZILY (so the scale-down compaction floor is real)
+_AUTOSCALE_OPS = frozenset({"scale-up", "scale-down", "brown-down",
+                            "brown-up", "failover", "stick-sensors",
+                            "tick"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -176,6 +192,82 @@ def generate_ops(spec: ChaosSpec) -> List[dict]:
     return ops
 
 
+def named_autoscale_schedules(seed: int = 0) \
+        -> List[Tuple[str, ChaosSpec, List[dict]]]:
+    """The four named autoscale scenario schedules (DESIGN.md section
+    24), each a deterministic op stream through replay_ops's real front
+    door.  They assert the same contracts as every chaos case -- answer
+    correctness, shard conservation, the inline compaction-floor probe
+    -- under the autoscale-specific interleavings the random generator
+    would rarely compose."""
+    rng = np.random.default_rng(seed + 4242)
+
+    def q(tenant: str, hot: bool = False) -> dict:
+        m = int(rng.integers(2, 6))
+        qs = (_hot_points(rng, m) if hot
+              else (rng.random((m, 3)) * (DOMAIN_SIZE * 0.98)
+                    + DOMAIN_SIZE * 0.01).astype(np.float32))
+        return {"op": "query", "tenant": tenant, "queries": qs}
+
+    def ins(tenant: str, m: int, hot: bool = False) -> dict:
+        pts = (_hot_points(rng, m) if hot
+               else (rng.random((m, 3)) * (DOMAIN_SIZE * 0.98)
+                     + DOMAIN_SIZE * 0.01).astype(np.float32))
+        return {"op": "insert", "tenant": tenant, "points": pts}
+
+    def sp(seed_tag: int) -> ChaosSpec:
+        return ChaosSpec(seed=seed_tag, n0=200, dense_n0=90, k=6,
+                         nshards=2, n_ops=0)
+
+    # 1. stuck sensor under ticking load: the policy goes blind, the
+    #    answers must not
+    stuck = [{"op": "stick-sensors", "tenant": "p0"},
+             {"op": "tick", "tenant": "p0", "n": 2},
+             ins("d0", 8), q("d0"),
+             {"op": "tick", "tenant": "p0", "n": 3},
+             {"op": "scale-up", "tenant": "d0"},
+             ins("d0", 6), q("d0"),
+             {"op": "tick", "tenant": "p0", "n": 3},
+             {"op": "scale-down", "tenant": "d0"},
+             q("d0"), q("p0", hot=True)]
+    # 2. flapping load: the ladder walked down and up repeatedly, with
+    #    the differential compare re-arming at every exact interval
+    flap: List[dict] = []
+    for _ in range(3):
+        flap += [{"op": "brown-down", "tenant": "d0"}, q("d0"),
+                 {"op": "tick", "tenant": "p0", "n": 2},
+                 {"op": "brown-up", "tenant": "d0"}, q("d0")]
+    flap += [q("d0"), q("p0")]
+    # 3. scale-down racing a live migration: the pod tenant mid-pump
+    #    while the dense tenant's replica pool shrinks over a lazy tail
+    race = [ins("p0", 12, hot=True), ins("p0", 12, hot=True),
+            {"op": "rebalance", "tenant": "p0"},
+            {"op": "scale-up", "tenant": "d0"},
+            ins("d0", 6), ins("d0", 6),
+            {"op": "pump", "tenant": "p0", "n": 3},
+            q("p0", hot=True),
+            {"op": "scale-down", "tenant": "d0"},
+            {"op": "pump", "tenant": "p0", "n": 64},
+            q("p0", hot=True), q("d0")]
+    # 4. brownout during failover: degrade, fail over mid-brownout
+    #    (the lazy tail re-ships), recover, then the byte-exact compare
+    #    must hold again
+    brown = [{"op": "scale-up", "tenant": "d0"},
+             ins("d0", 8),
+             {"op": "brown-down", "tenant": "d0"},
+             {"op": "brown-down", "tenant": "d0"},
+             q("d0"),
+             {"op": "failover", "tenant": "d0"},
+             q("d0"),
+             {"op": "brown-up", "tenant": "d0"},
+             {"op": "brown-up", "tenant": "d0"},
+             q("d0"), q("p0")]
+    return [("stuck-sensor-ticking-load", sp(90_001), stuck),
+            ("flapping-brownout-ladder", sp(90_002), flap),
+            ("scale-down-racing-migration", sp(90_003), race),
+            ("brownout-during-failover", sp(90_004), brown)]
+
+
 def replay_ops(spec: ChaosSpec, ops: Sequence[dict]) \
         -> Optional[Tuple[str, str, int]]:
     """Run one schedule through a fresh two-tenant fleet, differentially
@@ -184,21 +276,26 @@ def replay_ops(spec: ChaosSpec, ops: Sequence[dict]) \
     op_index).  A raise on a legal schedule IS the failure."""
     from .. import KnnConfig, KnnProblem
     from ..config import ServeFleetConfig
+    from ..serve.fleet.autoscale import AutoscaleConfig
     from ..serve.fleet.frontdoor import FleetDaemon
     from ..serve.fleet.tenants import TenantSpec
 
     try:
+        as_ops = any(op["op"] in _AUTOSCALE_OPS for op in ops)
         pod_cloud, dense_cloud = initial_clouds(spec)
         tracked = {"p0": np.array(pod_cloud), "d0": np.array(dense_cloud)}
         fleet = FleetDaemon(
             [(TenantSpec(name="p0", k=spec.k), pod_cloud),
-             (TenantSpec(name="d0", k=spec.k), dense_cloud)],
+             (TenantSpec(name="d0", k=spec.k,
+                         ship_mode="lazy" if as_ops else "sync"),
+              dense_cloud)],
             ServeFleetConfig(
                 min_bucket=8, max_batch=64, compact_threshold=32,
                 warmup=False, sidecar_threshold=48,
                 pod_threshold=CHAOS_POD_THRESHOLD,
                 pod_shards=spec.nshards, pod_skew_threshold=1.5,
-                drr_quantum=16))
+                drr_quantum=16),
+            autoscale=AutoscaleConfig() if as_ops else None)
         el = fleet.tenants["p0"].elastic
         if el is not None:
             el.migration_chunk = CHAOS_MIGRATION_CHUNK
@@ -242,6 +339,44 @@ def replay_ops(spec: ChaosSpec, ops: Sequence[dict]) \
             elif kind == "delay-handover":
                 if el is not None:
                     el.delay_handover(int(op.get("pumps") or 1))
+            elif kind == "scale-up":
+                t = fleet.tenants[name]
+                if t.daemon is not None:
+                    t.add_replica()
+            elif kind == "scale-down":
+                t = fleet.tenants[name]
+                res = t.remove_replica(
+                    unsafe_compact=fleet._fault == "scale-drop-tail")
+                if res is not None and t.log is not None:
+                    # the inline compaction-floor probe: the committed
+                    # tail a surviving consumer still needs must stay
+                    # replayable (a raise here IS the banked failure)
+                    floor = min((r.applied_seq
+                                 for r in t.replica_pool), default=0)
+                    list(t.log.since(floor))
+            elif kind == "brown-down":
+                t = fleet.tenants[name]
+                if t.daemon is not None:
+                    t.brown_down()
+            elif kind == "brown-up":
+                t = fleet.tenants[name]
+                if t.daemon is not None:
+                    t.brown_up()
+            elif kind == "failover":
+                t = fleet.tenants[name]
+                if t.daemon is not None and t.replica_pool:
+                    t.failover()
+            elif kind == "stick-sensors":
+                # the stuck-sensor fault's in-schedule twin: the NEXT
+                # sensor sample freezes forever (answers must stay
+                # correct; the policy just goes blind)
+                fleet._fault = "stuck-sensor"
+            elif kind == "tick":
+                sc = fleet.autoscaler
+                per = sc.config.period_s if sc is not None else 0.02
+                for _ in range(max(1, int(op.get("n") or 1))):
+                    now += per * 1.01
+                    fleet.poll(now)
             else:
                 queries = np.asarray(op["queries"], np.float32)  # kntpu-ok: host-sync-loop -- host-resident op payload (pure numpy), no device array rides this loop
                 responses = fleet.submit(i, name, "query", queries,
@@ -254,20 +389,27 @@ def replay_ops(spec: ChaosSpec, ops: Sequence[dict]) \
                     return ("mismatch",
                             f"op {i}: tenant {name} query got no clean "
                             f"response: {err}", i)
-                got_i = np.asarray(mine[0].ids)  # kntpu-ok: host-sync-loop -- Response rows are host numpy (the daemon fetched them through dispatch already)
-                got_d = np.asarray(mine[0].d2)  # kntpu-ok: host-sync-loop -- Response rows are host numpy (the daemon fetched them through dispatch already)
-                pts = tracked[name]
-                ref = KnnProblem.prepare(
-                    pts, KnnConfig(k=spec.k, adaptive=False),
-                    validate=False)
-                _ref_i, ref_d = ref.query(queries, spec.k)
-                bad = check_route_result(pts, queries, got_i, got_d,
-                                         np.asarray(ref_d), spec.k)  # kntpu-ok: host-sync-loop -- one oracle readback per QUERY op is the differential harness's job
-                if bad is not None:
-                    return ("mismatch",
-                            f"op {i}: tenant {name} diverged from its "
-                            f"rebuild oracle under the fault schedule: "
-                            f"{bad.render()}", i)
+                if mine[0].degraded is None:
+                    # a browned-out answer is certified-approximate BY
+                    # DECLARATION (the tier rides the wire), so the
+                    # distance-multiset contract is suspended for it --
+                    # and re-arms the moment the tenant recovers to
+                    # exact (the brownout-during-failover schedule ends
+                    # on exactly that re-armed compare)
+                    got_i = np.asarray(mine[0].ids)  # kntpu-ok: host-sync-loop -- Response rows are host numpy (the daemon fetched them through dispatch already)
+                    got_d = np.asarray(mine[0].d2)  # kntpu-ok: host-sync-loop -- Response rows are host numpy (the daemon fetched them through dispatch already)
+                    pts = tracked[name]
+                    ref = KnnProblem.prepare(
+                        pts, KnnConfig(k=spec.k, adaptive=False),
+                        validate=False)
+                    _ref_i, ref_d = ref.query(queries, spec.k)
+                    bad = check_route_result(pts, queries, got_i, got_d,
+                                             np.asarray(ref_d), spec.k)  # kntpu-ok: host-sync-loop -- one oracle readback per QUERY op is the differential harness's job
+                    if bad is not None:
+                        return ("mismatch",
+                                f"op {i}: tenant {name} diverged from "
+                                f"its rebuild oracle under the fault "
+                                f"schedule: {bad.render()}", i)
             # conservation invariant: every canonical id lives in exactly
             # one shard, and the ledger tracks the acked mutations.  A
             # torn handover (the receiver missing a record it acked)
@@ -349,10 +491,12 @@ def load_chaos_case(path: str) -> dict:
 
 
 def run_chaos_case(spec: ChaosSpec, bank_dir: Optional[str] = None,
-                   minimize: bool = True,
-                   max_probes: int = 24) -> Optional[ChaosFailure]:
-    """One schedule end to end: generate, replay, minimize, bank."""
-    ops = generate_ops(spec)
+                   minimize: bool = True, max_probes: int = 24,
+                   ops: Optional[List[dict]] = None
+                   ) -> Optional[ChaosFailure]:
+    """One schedule end to end: generate (unless ``ops`` is handed in --
+    the named autoscale schedules do), replay, minimize, bank."""
+    ops = generate_ops(spec) if ops is None else list(ops)
     got = replay_ops(spec, ops)
     if got is None:
         return None
@@ -423,6 +567,22 @@ def run_chaos_campaign(n_cases: int = 16, seed: int = 0,
         log(f"[{i + 1}/{len(specs)}] {spec.case_id()} {tag}")
         if f is not None:
             failures.append(f)
+    # the four named autoscale schedules ride every campaign (cheap,
+    # deterministic, budget-respecting)
+    if truncated_after is None:
+        for label, nspec, nops in named_autoscale_schedules(seed):
+            if budget_s is not None and time.monotonic() - t0 > budget_s:
+                truncated_after = completed
+                log(f"[named] budget {budget_s:.0f}s exhausted before "
+                    f"{label}")
+                break
+            f = run_chaos_case(nspec, bank_dir=bank_dir,
+                               minimize=minimize, ops=nops)
+            completed += 1
+            tag = "ok" if f is None else f"FAIL {f.kind}"
+            log(f"[named] {label} {tag}")
+            if f is not None:
+                failures.append(f)
     mesh = None
     fault = _parse_fleet_fault()
     if drill and fault is None and truncated_after is None:

@@ -16,7 +16,11 @@ three primitives and one unified snapshot:
 * :class:`MetricsRegistry` / :data:`REGISTRY` -- the process-wide name ->
   instrument table, plus pluggable *providers* (callables returning a
   dict) so subsystem-owned counters (dispatch, executable cache) join the
-  snapshot without being rewritten.
+  snapshot without being rewritten.  The fleet autoscaler registers its
+  sensor set this way (provider ``fleet_autoscale``: per-SLO-class queue
+  depth, occupancy EWMA, windowed p999, refusal rate, ladder position --
+  DESIGN.md section 24), so the policy's inputs are inspectable through
+  the same ``metrics`` wire op that serves everything else.
 * :func:`metrics_snapshot` -- the one document: registry + dispatch
   counters + executable-cache counters, schema-stamped.  The serve wire's
   ``metrics`` command and the ``--metrics-jsonl`` periodic emitter both

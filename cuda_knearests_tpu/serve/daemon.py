@@ -79,6 +79,12 @@ class Response:
     queue_ms: Optional[float] = None
     dispatch_ms: Optional[float] = None
     device_ms: Optional[float] = None
+    # brownout (serve/fleet/autoscale.py, DESIGN.md section 24): the
+    # ladder tier this answer was served at ('bf16' | 'recall'; None =
+    # exact), and the typed defer hint a shed/over-quota refusal carries
+    # so a backoff client re-offers instead of losing the request
+    degraded: Optional[str] = None
+    retry_after_ms: Optional[float] = None
 
     @property
     def latency_s(self) -> float:
@@ -106,6 +112,10 @@ class Response:
             out["timing"] = {"queue_ms": self.queue_ms,
                              "dispatch_ms": self.dispatch_ms,
                              "device_ms": self.device_ms}
+        if self.degraded is not None:
+            out["degraded"] = self.degraded
+        if self.retry_after_ms is not None:
+            out["retry_after_ms"] = self.retry_after_ms
         if not self.ok:
             out["error"] = self.error
             out["failure_kind"] = self.failure_kind

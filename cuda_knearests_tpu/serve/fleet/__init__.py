@@ -16,6 +16,9 @@ front door (ROADMAP item 3).  This package is that tier:
   ("Hybrid KNN-Join", arXiv 1810.04758).
 * :mod:`frontdoor` -- the FleetDaemon multiplexing all of it behind one
   wire surface.
+* :mod:`autoscale` -- the traffic-driven sensor -> policy -> actuator
+  loop and the brownout ladder (exact -> bf16 -> lowered recall) with
+  hysteresis + cooldown (DESIGN.md section 24).
 * :mod:`loadgen` -- the multi-tenant open-loop harness (per-tenant
   percentiles, Jain fairness, SLO verdicts) behind ``bench.py --serve``'s
   fleet rows.
@@ -31,6 +34,7 @@ from __future__ import annotations
 
 from ...config import SLO_CLASSES, ServeFleetConfig, SloClass
 from .admission import DrrScheduler, TokenBucket, jain_index
+from .autoscale import TIER_NAMES, AutoscaleConfig, Autoscaler
 from .frontdoor import FLEET_FAULTS, FleetDaemon
 from .loadgen import (TenantLoad, build_fleet_schedule,
                       default_fleet_builds, run_fleet_session)
@@ -41,7 +45,8 @@ from .sidecar import CpuSidecar
 from .tenants import Tenant, TenantSpec
 
 __all__ = ["SLO_CLASSES", "ServeFleetConfig", "SloClass", "DrrScheduler",
-           "TokenBucket", "jain_index", "FLEET_FAULTS", "FleetDaemon",
+           "TokenBucket", "jain_index", "TIER_NAMES", "AutoscaleConfig",
+           "Autoscaler", "FLEET_FAULTS", "FleetDaemon",
            "TenantLoad", "build_fleet_schedule", "default_fleet_builds",
            "run_fleet_session", "DeltaRecord", "FailoverController",
            "Replica", "ReplicaProcess", "ReplicationLog", "failover_drill",

@@ -1,6 +1,6 @@
 """``python -m cuda_knearests_tpu.serve.fleet`` -- the fleet's front door.
 
-Two self-driving modes over the fleet tier (DESIGN.md section 17):
+Three self-driving modes over the fleet tier (DESIGN.md section 17):
 
 * ``--loadgen`` (default): a mixed-SLO multi-tenant open-loop session
   (fleet/loadgen.py) -- tenants alternate latency/throughput classes,
@@ -9,6 +9,23 @@ Two self-driving modes over the fleet tier (DESIGN.md section 17):
   ``--assert-steady`` exits nonzero unless the session flushed batches
   for >= 2 tenants with ZERO steady-state recompiles fleet-wide and a
   defined Jain fairness index -- the scripts/check.sh fleet smoke's gate.
+* ``--autoscale``: the traffic-driven autoscale + brownout smoke
+  (DESIGN.md section 24).  A diurnal (sine-modulated Poisson) session
+  with client backoff drives the Autoscaler's sensor -> policy ->
+  actuator loop; dense tenants ship lazily so the replication-log
+  compaction floor is genuinely exercised.  After the measured window a
+  deterministic recovery epilogue pumps synthetic ticks until the
+  ladder walks back to exact and every autoscaler-added replica is
+  de-provisioned.  Exit 0 requires (a) liveness: >= 1 scale event fired
+  (a stuck sensor provably fails this), (b) full recovery to the exact
+  tier with zero added replicas left (a frozen-breach sensor fails
+  this), (c) the anti-flap bound: total actuations <=
+  classes * (ticks // (cooldown+1) + slack) (a hysteresis-bypassing
+  policy fails this), and (d) the no-drop-tail probe: every tenant's
+  committed log tail is still replayable from its surviving pool's
+  applied floor (an unsafe scale-down compaction fails this).
+  Composes with ``--assert-steady``: the usual steady-state gates apply
+  on top.
 * ``--failover-smoke``: the process-level failover proof.  A primary and
   a replica run as REAL child processes (fleet/replica.py, the PR 2
   framed-JSON transport); a seeded mutation+query stream commits through
@@ -39,6 +56,92 @@ def _failover_smoke(n: int, k: int, ops: int, seed: int) -> int:
                                     json.dumps({"event": s}), flush=True))}
     print(json.dumps(summary), flush=True)
     return 0 if summary["failover_ok"] else 1
+
+
+def _autoscale_epilogue(fleet, summary: dict) -> int:
+    """The --autoscale smoke's deterministic tail.
+
+    Pumps synthetic ticks until the ladder walks back to exact and
+    every added replica is gone, then runs a deterministic scale-down
+    drill -- add a replica, commit an UNSHIPPED tail past it (lazy
+    shipping keeps the replica at its birth seq), remove it through the
+    same actuator call the policy uses -- and finally the four
+    assertions the seeded autoscale faults must each fail: liveness,
+    recovery, anti-flap, no-drop-tail."""
+    import time
+
+    import numpy as np
+
+    sc = fleet.autoscaler
+    cfg = sc.config
+    base = time.monotonic()
+    recovered = False
+    for i in range(600):
+        fleet.poll(base + (i + 1) * cfg.period_s * 1.01)
+        dense = [t for t in fleet.tenants.values()
+                 if t.daemon is not None]
+        if (all(t.degraded_tier == 0 for t in dense)
+                and all(st.tier == 0 for st in sc.classes.values())
+                and sum(sc.added.values()) == 0):
+            recovered = True
+            break
+    # the scale-down drill: the policy's own scale_down may have fired
+    # before any mutation committed (nothing at risk), so exercise the
+    # compaction floor deterministically with the SAME actuator pair
+    # the policy calls -- under the scale-drop-tail fault this compacts
+    # the committed tail away and the probe below provably fails
+    drill = next((t for t in fleet.tenants.values()
+                  if t.daemon is not None and not t.spec.replicas), None)
+    if drill is not None and drill.add_replica():
+        pts = (np.random.default_rng(7).random((4, 3)) * 100.0
+               + 5.0).astype(np.float32)
+        rs = drill.daemon.submit(10**9, "insert", pts,
+                                 now=fleet.clock())
+        if rs and rs[-1].ok:
+            drill.commit_mutation("insert", pts)
+        drill.remove_replica(
+            unsafe_compact=fleet._fault == "scale-drop-tail")
+    stats = sc.stats_dict()
+    # anti-flap: within one class, consecutive actuations must be
+    # separated by MORE than the cooldown (the policy's structural
+    # bound; the flap-policy fault fires back-to-back and fails this)
+    flap_ok = True
+    by_cls: dict = {}
+    for ev in stats["events"]:
+        by_cls.setdefault(ev["class"], []).append(ev["tick"])
+    for ticks in by_cls.values():
+        for a, b in zip(ticks, ticks[1:]):
+            if b - a <= cfg.cooldown_ticks:
+                flap_ok = False
+    # no-drop-tail: every tenant's committed log tail must still be
+    # replayable from its surviving pool's applied floor (an unsafe
+    # compaction past that floor makes the next failover's re-ship
+    # unrecoverable -- the scale-drop-tail fault's exact corruption)
+    drop_tail = None
+    for t in fleet.tenants.values():
+        if t.log is None:
+            continue
+        floor = min((r.applied_seq for r in t.replica_pool), default=0)
+        try:
+            list(t.log.since(floor))
+        except RuntimeError as e:
+            drop_tail = f"{t.spec.name}: {e}"
+            break
+    checks = {
+        "scale_event": stats["scale_up"] >= 1,
+        "recovered_to_exact": recovered,
+        "anti_flap": flap_ok,
+        "no_drop_tail": drop_tail is None,
+    }
+    summary["autoscale"] = stats
+    summary["autoscale_recovered"] = recovered
+    summary["autoscale_checks"] = checks
+    if all(checks.values()):
+        return 0
+    print(f"AUTOSCALE ASSERTION FAILED: {checks} "
+          f"ticks={stats['ticks']} drop_tail={drop_tail}",
+          file=sys.stderr, flush=True)
+    return 1
 
 
 def main(argv=None) -> int:
@@ -72,6 +175,16 @@ def main(argv=None) -> int:
                          "maintenance is carved out of the recompile gate; "
                          "the session additionally requires >= 1 completed "
                          "migration)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the diurnal autoscale + brownout smoke: "
+                         "sine-modulated arrivals with client backoff, "
+                         "lazy-shipping dense tenants, a deterministic "
+                         "recovery epilogue, then the liveness / "
+                         "recovery / anti-flap / no-drop-tail "
+                         "assertions (exit 1 on any)")
+    ap.add_argument("--diurnal", type=float, default=4.0,
+                    help="peak/trough arrival ratio for --autoscale's "
+                         "sine-modulated Poisson loads (default 4.0)")
     ap.add_argument("--assert-steady", action="store_true",
                     help="exit 1 unless >= 2 tenants flushed batches with "
                          "zero fleet-wide steady-state recompiles and a "
@@ -106,6 +219,20 @@ def main(argv=None) -> int:
         builds = default_fleet_builds(
             n_tenants=max(1, args.tenants), base_n=args.points, k=args.k,
             seed=args.seed, replicas=args.replicas)
+        as_cfg = None
+        if args.autoscale:
+            import dataclasses as _dc
+
+            from .autoscale import AutoscaleConfig
+
+            # dense tenants ship lazily so the scale-down compaction
+            # floor (the no-drop-tail probe below) is genuinely
+            # exercised; promotion is the bench row's proof -- the smoke
+            # disables it so the clear ladder's scale-down is
+            # deterministic (the added replica is never promoted away)
+            builds = [(_dc.replace(spec, ship_mode="lazy"), pts)
+                      for spec, pts in builds]
+            as_cfg = AutoscaleConfig(promote_min_points=1 << 30)
         cfg = None
         if args.pod_tenant:
             import dataclasses as _dc
@@ -124,8 +251,7 @@ def main(argv=None) -> int:
             builds.append((TenantSpec(name="pod0", k=args.k),
                            generate_uniform(pod_threshold + 512,
                                             seed=args.seed + 997)))
-        fleet = FleetDaemon(builds) if cfg is None \
-            else FleetDaemon(builds, cfg)
+        fleet = FleetDaemon(builds, cfg, autoscale=as_cfg)
         loads = []
         for i, (spec, _) in enumerate(builds):
             t = fleet.tenants[spec.name]
@@ -134,6 +260,9 @@ def main(argv=None) -> int:
             loads.append(TenantLoad(tenant=spec.name, rate=args.rate,
                                     requests=args.requests,
                                     mutation_ratio=mr, hotspot=hotspot,
+                                    diurnal=(args.diurnal
+                                             if args.autoscale else None),
+                                    backoff=args.autoscale,
                                     seed=args.seed + 31 * i))
         if args.pod_tenant:
             el = fleet.tenants["pod0"].elastic
@@ -167,6 +296,8 @@ def main(argv=None) -> int:
                 emitter.stop()
             if trace_sink is not None:
                 trace_sink.close()
+        as_rc = (_autoscale_epilogue(fleet, summary)
+                 if args.autoscale else 0)
     except InputContractError as e:
         print(json.dumps({"error": str(e),
                           "failure_kind": getattr(e, "kind", "crash")}),
@@ -204,7 +335,7 @@ def main(argv=None) -> int:
                   f"pod_ok={pod_ok}",
                   file=sys.stderr, flush=True)
             return 1
-    return 0
+    return as_rc
 
 
 if __name__ == "__main__":

@@ -77,6 +77,17 @@ class TokenBucket:
         self.refusals += 1
         return False
 
+    def retry_after_s(self, rows: int, now: float) -> Optional[float]:
+        """How long until ``rows`` tokens will be available -- the typed
+        retry-after hint a refusal carries on the wire so a backoff
+        client can defer instead of losing the request.  None on an
+        unmetered bucket (a refusal there is not quota-shaped)."""
+        if self.rate is None:
+            return None
+        self._refill(now)
+        deficit = min(float(rows), self.burst) - self.tokens
+        return max(0.0, deficit / self.rate)
+
     def stats_dict(self) -> dict:
         return {"quota_qps": self.rate, "quota_burst": self.burst,
                 "quota_refusals": self.refusals,

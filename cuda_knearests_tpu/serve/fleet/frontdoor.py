@@ -55,14 +55,20 @@ from ...obs import metrics as _metrics
 from ...obs import spans as _spans
 from ...utils import prototrace
 from ...utils.memory import (InputContractError, InvalidConfigError,
-                             InvalidRequestError)
+                             InvalidRequestError, OverQuotaError)
 from ..batching import Batch, Request
 from ..daemon import Response
 from .admission import DrrScheduler, TokenBucket
+from .autoscale import AutoscaleConfig, Autoscaler
 from .tenants import Tenant, TenantSpec
 
 FLEET_FAULTS = ("cross-tenant", "drop-delta", "stale-replica",
-                "torn-migration", "lost-range")
+                "torn-migration", "lost-range",
+                # autoscale faults (serve/fleet/autoscale.py, the runtime
+                # twins of the analysis/models.py autoscale mutants):
+                # frozen sensor snapshot, hysteresis/cooldown bypass,
+                # unsafe log compaction on scale-down
+                "stuck-sensor", "flap-policy", "scale-drop-tail")
 
 
 def _parse_fleet_fault() -> Optional[str]:
@@ -97,7 +103,8 @@ class FleetDaemon:
 
     def __init__(self, builds: Sequence[Tuple[TenantSpec, np.ndarray]],
                  config: Optional[ServeFleetConfig] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 autoscale: Optional[AutoscaleConfig] = None):
         self.config = config or ServeFleetConfig()
         self.clock = clock
         self.tenants: Dict[str, Tenant] = {}
@@ -130,17 +137,32 @@ class FleetDaemon:
             self.drr.register(spec.name)
             self.refused[spec.name] = 0
             self.served_rows[spec.name] = 0
+        # the sensor -> policy -> actuator loop (DESIGN.md section 24):
+        # None = no autoscaling, zero behavior change for existing fleets
+        self.autoscaler: Optional[Autoscaler] = (
+            Autoscaler(self, autoscale) if autoscale is not None else None)
 
     # -- admission + routing --------------------------------------------------
 
     def _refusal(self, req_id, tenant, e: InputContractError,
-                 now: float,
-                 trace_id: Optional[str] = None) -> List[Response]:
+                 now: float, trace_id: Optional[str] = None,
+                 retry_after_s: Optional[float] = None) -> List[Response]:
         self.refused[tenant] = self.refused.get(tenant, 0) + 1
+        if retry_after_s is None and isinstance(e, OverQuotaError):
+            # a quota refusal is load-shaped, not malformed: tell the
+            # caller WHEN the bucket will admit this cost again so a
+            # backoff client defers instead of losing the request
+            bucket = self.quota.get(tenant)
+            if bucket is not None:
+                retry_after_s = bucket.retry_after_s(
+                    getattr(e, "rows", 1) or 1, now)
         return [Response(req_id=req_id, ok=False, error=str(e),
                          failure_kind=e.kind, arrived_at=now,
                          completed_at=self.clock(), tenant=tenant,
-                         trace_id=trace_id)]
+                         trace_id=trace_id,
+                         retry_after_ms=(None if retry_after_s is None
+                                         else round(retry_after_s * 1e3,
+                                                    4)))]
 
     def submit(self, req_id: int, tenant: str, kind: str, payload,
                k: Optional[int] = None, now: Optional[float] = None,
@@ -166,7 +188,26 @@ class FleetDaemon:
                 tenant=tenant, tenants=tuple(self.tenants),
                 quota_ok=quota_ok)
         except InputContractError as e:
-            return self._refusal(req_id, tenant, e, now, trace_id)
+            retry = None
+            if isinstance(e, OverQuotaError):
+                retry = self.quota[tenant].retry_after_s(
+                    _rows_estimate(kind, payload), now)
+            return self._refusal(req_id, tenant, e, now, trace_id,
+                                 retry_after_s=retry)
+        if kind == "query" and self.autoscaler is not None:
+            shed = self.autoscaler.shed_hint(t, now)
+            if shed is not None:
+                # the brownout ladder's floor: admission refuses QUERIES
+                # typed with a defer hint (mutations are never shed --
+                # zero lost committed mutations stays a law)
+                return self._refusal(
+                    req_id, tenant,
+                    OverQuotaError(
+                        f"tenant {tenant!r}: query shed by the autoscale "
+                        f"brownout ladder (class "
+                        f"{t.spec.slo!r} at ladder floor); retry after "
+                        f"{shed * 1e3:.1f} ms"),
+                    now, trace_id, retry_after_s=shed)
         if kind == "query" and self._fault == "cross-tenant" \
                 and len(self.tenants) > 1:
             return self._cross_tenant_fault(req_id, tenant, payload, k, now)
@@ -338,7 +379,10 @@ class FleetDaemon:
 
     def _run_batch(self, t: Tenant, batch: Batch,
                    accounting: Optional[dict] = None) -> List[Response]:
-        responses = t.daemon._execute(batch)
+        if t.degraded_tier > 0:
+            responses = self._execute_degraded(t, batch)
+        else:
+            responses = t.daemon._execute(batch)
         name = t.spec.name
         for r in responses:
             r.tenant = name
@@ -350,7 +394,80 @@ class FleetDaemon:
             "slo": t.spec.slo,
             **(accounting or {})})
         self.n_batches += 1
+        if self.autoscaler is not None:
+            self.autoscaler.observe(t.spec.slo, responses)
         return responses
+
+    def _execute_degraded(self, t: Tenant, batch: Batch) -> List[Response]:
+        """Serve one batch at the tenant's brownout tier (DESIGN.md
+        section 24): tier 1 scores in bf16 with brute refinement (ids
+        still exact -- the MXU solver's refined path), tier 2 lowers the
+        recall target (certified-approximate).  The mxu route does not
+        ride the serving ExecutableCache, so degraded batches add ZERO
+        counted recompiles and the steady-state law keeps holding
+        through a brownout episode.  Mutations never reach this path
+        (they are barriers through the daemon), so the overlay state --
+        and with it the post-recovery byte-identity pin -- is
+        tier-independent.  Same containment law as the dense executor:
+        a raise costs this batch's riders typed failures, nothing more."""
+        from ...mxu.solve import solve_general
+
+        tier_name = t.degraded_tier_name
+        kmax = max(r.k for r in batch.requests)
+        failed: Optional[BaseException] = None
+        res = None
+        with _spans.span("serve.degraded", force=True,
+                         tenant=t.spec.name, tier=tier_name,
+                         rows=batch.total) as ex:
+            try:
+                res = solve_general(
+                    t.daemon.overlay.mutated_points(), k=kmax,
+                    recall_target=t.degraded_recall,
+                    refine="brute" if t.degraded_tier == 1 else "none",
+                    queries=batch.queries, scorer="mxu",
+                    precision="bf16")
+            except Exception as e:  # noqa: BLE001 -- containment IS the contract, same as ServeDaemon._execute
+                failed = e
+        done = self.clock()
+        if failed is not None:
+            kind = t.daemon._classify(failed)
+            t.daemon.failed_batches += 1
+            t.daemon.failure_kinds[kind] = \
+                t.daemon.failure_kinds.get(kind, 0) + 1
+            return [Response(req_id=r.req_id, ok=False,
+                             error=f"degraded batch failed: "
+                                   f"{type(failed).__name__}: {failed}",
+                             failure_kind=kind, arrived_at=r.arrived_at,
+                             completed_at=done, trace_id=r.trace_id)
+                    for r in batch.requests]
+        t.daemon.batches_executed += 1
+        t.daemon.occupancies.append(batch.occupancy)
+        out = []
+        for req, a, b in batch.slices():
+            out.append(Response(
+                req_id=req.req_id, ok=True,
+                ids=np.ascontiguousarray(res.neighbors[a:b, :req.k]),
+                d2=np.ascontiguousarray(res.dists_sq[a:b, :req.k]),
+                arrived_at=req.arrived_at, completed_at=done,
+                trace_id=req.trace_id,
+                queue_ms=t.daemon._queue_ms(req, ex.t0),
+                dispatch_ms=0.0, device_ms=round(ex.dur_ms, 4),
+                degraded=tier_name))
+        return out
+
+    def _drain_tenant(self, t: Tenant, now: float) -> List[Response]:
+        """Drain ONE dense tenant completely (ready queue + pending
+        batcher work) through the fleet's own accounting -- the
+        autoscaler's promotion actuator needs the dense daemon idle
+        before it swaps the placement out from under it."""
+        out = self._execute_ready(t)
+        if t.daemon is not None:
+            batch = t.daemon.batcher.flush("drain", now)
+            if batch is not None:
+                t.ready.append(batch)             # proto: drr-admission.enqueue
+                prototrace.record("drr-admission", "enqueue")
+                out.extend(self._execute_ready(t))
+        return out
 
     def _execute_ready(self, t: Tenant) -> List[Response]:
         """Drain ONE tenant's ready queue in FIFO order (the mutation
@@ -364,7 +481,13 @@ class FleetDaemon:
     def pump(self, now: Optional[float] = None) -> List[Response]:
         """Execute every ready batch in deficit-round-robin order; each
         dispatch's fairness accounting (deficit after, backlog snapshot)
-        is stamped into the per-batch stats."""
+        is stamped into the per-batch stats.  The autoscaler (when
+        configured) ticks here as well as in poll: a saturated open
+        loop spends its passes in submit -> pump, and the policy must
+        keep sensing exactly when the fleet is busiest (period-gated,
+        so the extra call sites cost one comparison)."""
+        if self.autoscaler is not None and now is not None:
+            self.autoscaler.tick(now)
         ready = {name: t.ready for name, t in self.tenants.items()
                  if t.daemon is not None}
         if any(q for q in ready.values()):
@@ -381,8 +504,12 @@ class FleetDaemon:
         return out
 
     def poll(self, now: Optional[float] = None) -> List[Response]:
-        """Deadline-trigger check across every dense tenant, then pump."""
+        """Deadline-trigger check across every dense tenant, then pump.
+        The autoscaler (when configured) ticks here -- the same injected
+        clock that drives the batching law drives the policy."""
         now = self.clock() if now is None else now
+        if self.autoscaler is not None:
+            self.autoscaler.tick(now)
         for t in self.tenants.values():
             if t.daemon is None:
                 continue
@@ -447,4 +574,6 @@ class FleetDaemon:
             "fleet_batches": self.n_batches,
             **self.drr.stats_dict(),
             **_dispatch.EXEC_CACHE.stats_dict(),
+            **({"autoscale": self.autoscaler.stats_dict()}
+               if self.autoscaler is not None else {}),
         }

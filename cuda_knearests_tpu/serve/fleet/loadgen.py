@@ -13,6 +13,7 @@ the numbers that become ``bench.py --serve`` fleet rows.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -36,7 +37,21 @@ class TenantLoad:
     low-Morton range when ``lo`` is near 0 -- so a pod tenant's
     population skews deterministically and the live-rebalance trigger
     (pod/reshard.ElasticIndex.maybe_rebalance) fires reproducibly in
-    tier-1 and bench.  Queries and deletes are unaffected."""
+    tier-1 and bench.  Queries and deletes are unaffected.
+
+    ``diurnal`` (ISSUE 19 satellite) sine-modulates the Poisson
+    intensity: it is the peak/trough ratio of ``rate(t) = rate * (1 + a
+    sin(2 pi t / P))`` with ``a = (diurnal - 1) / (diurnal + 1)`` and
+    period ``P = diurnal_period_s`` (default: the load's nominal
+    duration, one full cycle).  Arrivals come from inverting the
+    cumulative intensity on a unit-rate seeded Poisson stream, so the
+    pattern is exactly regenerable and the MEAN rate stays ``rate``.
+
+    ``backoff`` opts this tenant's client into honoring typed
+    ``retry_after_ms`` hints: a refused request that carries one is
+    RE-OFFERED after the hinted delay (up to ``max_retries`` times)
+    instead of being lost -- shed load becomes measurable as
+    ``deferred_requests`` in the session summary."""
 
     tenant: str
     rate: float = 200.0
@@ -48,6 +63,44 @@ class TenantLoad:
     k: Optional[int] = None
     seed: int = 0
     hotspot: Optional[Tuple[float, float]] = None
+    diurnal: Optional[float] = None
+    diurnal_period_s: Optional[float] = None
+    backoff: bool = False
+    max_retries: int = 3
+
+    def arrivals(self) -> np.ndarray:
+        """This load's seeded arrival times (flat or diurnal).  The flat
+        path is bit-identical to the pre-diurnal harness (same rng, same
+        expression), so every existing pinned schedule is unchanged."""
+        rate = max(self.rate, 1e-9)
+        if self.diurnal is None or self.diurnal <= 1.0:
+            return np.cumsum(np.random.default_rng(self.seed).exponential(
+                1.0 / rate, self.requests))
+        unit = np.cumsum(np.random.default_rng(self.seed).exponential(
+            1.0, self.requests))
+        a = (self.diurnal - 1.0) / (self.diurnal + 1.0)
+        period = (self.diurnal_period_s if self.diurnal_period_s
+                  else self.requests / rate)
+        return _invert_diurnal(unit, rate, a, period)
+
+
+def _invert_diurnal(unit: np.ndarray, rate: float, a: float,
+                    period: float) -> np.ndarray:
+    """Arrival times of an inhomogeneous Poisson process by numeric
+    inversion of the cumulative intensity ``L(t) = rate * (t + a P /
+    (2 pi) * (1 - cos(2 pi t / P)))`` (monotone: |a| < 1) applied to a
+    unit-rate stream -- bisection, fully vectorized, deterministic."""
+    u = np.asarray(unit, np.float64)  # kntpu-ok: wide-dtype -- host-side schedule synthesis, never staged
+    lo = np.zeros_like(u)
+    hi = np.full_like(u, float(u[-1]) / (rate * (1.0 - a)) + period)
+    for _ in range(60):
+        mid = 0.5 * (lo + hi)
+        val = rate * (mid + a * period / (2 * np.pi)
+                      * (1.0 - np.cos(2 * np.pi * mid / period)))
+        take = val < u
+        lo = np.where(take, mid, lo)
+        hi = np.where(take, hi, mid)
+    return hi
 
 
 def build_fleet_schedule(loads: List[TenantLoad],
@@ -60,8 +113,7 @@ def build_fleet_schedule(loads: List[TenantLoad],
     out: List[dict] = []
     for load in loads:
         rng = np.random.default_rng(load.seed + 1)
-        arrivals = np.cumsum(np.random.default_rng(load.seed).exponential(
-            1.0 / max(load.rate, 1e-9), load.requests))
+        arrivals = load.arrivals()
         sizes = np.asarray([s for s, _ in load.batch_mix])
         weights = np.asarray([w for _, w in load.batch_mix], np.float64)  # kntpu-ok: wide-dtype -- host-side sampling weights, never staged
         weights = weights / weights.sum()
@@ -123,33 +175,66 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
     aggs: Dict[str, SessionAggregate] = {
         load.tenant: SessionAggregate(query_only=True) for load in loads}
     fleet_agg = SessionAggregate(query_only=True)
+    degraded_rows: Dict[str, int] = {}
 
     def absorb(rs: List[Response]) -> None:
         fleet_agg.absorb(rs)
         for r in rs:
             if r.tenant in aggs:
                 aggs[r.tenant].absorb([r])
+            if r.degraded is not None and r.ids is not None:
+                degraded_rows[r.degraded] = (
+                    degraded_rows.get(r.degraded, 0)
+                    + int(r.ids.shape[0]))
+
+    # client-side backoff (ISSUE 19 satellite): tenants with
+    # TenantLoad.backoff re-offer a refusal that carries a typed
+    # retry_after_ms hint -- shed load is DEFERRED, not lost
+    backoff = {load.tenant: load for load in loads if load.backoff}
+    reoffer: List[tuple] = []        # (due, seq, tries, item) min-heap
+    deferred = 0
+    rid = 0
+
+    def offer(item: dict, now: float, tries: int) -> None:
+        nonlocal rid, deferred
+        rid += 1
+        rs = fleet.submit(
+            req_id=rid, tenant=item["tenant"], kind=item["kind"],
+            payload=item["payload"], k=item.get("k"), now=now,
+            trace_id=f"{item['tenant']}-{rid}")
+        load = backoff.get(item["tenant"])
+        if load is not None and tries < load.max_retries:
+            mine = next((r for r in rs if r.req_id == rid), None)
+            if mine is not None and not mine.ok \
+                    and mine.retry_after_ms is not None:
+                deferred += 1
+                heapq.heappush(reoffer,
+                               (now + mine.retry_after_ms / 1e3 + 1e-3,
+                                rid, tries + 1, item))
+        absorb(rs)
 
     t0 = clock()
     i = 0
     pending = (lambda: any(t.ready or (t.daemon is not None
                                        and t.daemon.batcher.pending_queries)
                            for t in fleet.tenants.values()))
-    while i < len(schedule) or pending():
+    while i < len(schedule) or reoffer or pending():
         now = clock()
+        if reoffer and reoffer[0][0] <= now:
+            _, _, tries, item = heapq.heappop(reoffer)
+            offer(item, now, tries)
+            continue
         if i < len(schedule) and t0 + schedule[i]["t"] <= now:
             item = schedule[i]
             i += 1
-            absorb(fleet.submit(
-                req_id=i, tenant=item["tenant"], kind=item["kind"],
-                payload=item["payload"], k=item.get("k"),
-                now=t0 + item["t"],
-                trace_id=f"{item['tenant']}-{i}"))
+            offer(item, t0 + item["t"], 0)
             continue
         absorb(fleet.poll(now))
         next_events = []
         if i < len(schedule):
             next_events.append(t0 + schedule[i]["t"])
+        if reoffer:
+            next_events.append(reoffer[0][0])
         deadline = fleet.next_deadline()
         if deadline is not None:
             next_events.append(deadline)
@@ -218,6 +303,8 @@ def run_fleet_session(fleet: FleetDaemon, loads: List[TenantLoad],
         "completed_queries": total_served,
         "failed_requests": fleet_agg.failed,
         "refused_requests": int(sum(fleet.refused.values())),
+        "deferred_requests": deferred,
+        "degraded_rows": dict(degraded_rows),
         "elapsed_s": round(elapsed, 4),
         "sustained_qps": round(total_served / elapsed, 1),
         "recompiles": int(cache1["exec_cache_misses"]

@@ -95,14 +95,23 @@ class DeltaRecord:
 
 
 class ReplicationLog:
-    """The ordered committed-mutation record (one per tenant)."""
+    """The ordered committed-mutation record (one per tenant).
+
+    ``compact(upto)`` drops the prefix every surviving consumer has
+    already applied (the autoscaler's scale-down path calls it with the
+    remaining pool's applied floor).  ``base_seq`` records how much was
+    dropped; asking for a tail that starts inside the compacted prefix
+    raises LOUDLY -- a silent empty tail here is exactly the
+    lost-committed-mutation corruption the replication model forbids.
+    """
 
     def __init__(self) -> None:
         self.records: List[DeltaRecord] = []
+        self.base_seq = 0
 
     @property
     def committed_seq(self) -> int:
-        return len(self.records)
+        return self.base_seq + len(self.records)
 
     def append(self, kind: str, payload: np.ndarray) -> DeltaRecord:
         # proto: replication-commit.append
@@ -111,9 +120,27 @@ class ReplicationLog:
         self.records.append(rec)
         return rec
 
+    def compact(self, upto: int) -> int:
+        """Drop records with seq <= ``upto``; returns how many were
+        dropped.  The caller owns the safety argument (every surviving
+        consumer has applied past ``upto``) -- see Tenant.remove_replica."""
+        upto = min(int(upto), self.committed_seq)
+        drop = max(0, upto - self.base_seq)
+        if drop:
+            self.records = self.records[drop:]
+            self.base_seq += drop
+        return drop
+
     def since(self, seq: int) -> List[DeltaRecord]:
         """Records with sequence number > ``seq`` (the re-ship tail)."""
-        return self.records[max(0, int(seq)):]
+        seq = max(0, int(seq))
+        if seq < self.base_seq:
+            raise RuntimeError(
+                f"replication log compacted past seq {seq}: records "
+                f"<= {self.base_seq} were dropped, the re-ship tail is "
+                f"unrecoverable (scale-down compacted a tail a live "
+                f"consumer still needed)")
+        return self.records[seq - self.base_seq:]
 
 
 def replay_on_host(points: np.ndarray,

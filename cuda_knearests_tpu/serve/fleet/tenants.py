@@ -132,6 +132,12 @@ class Tenant:
         self.replica_pool: List[Replica] = []
         self.promotions = 0
         self.failovers = 0
+        # brownout ladder position (serve/fleet/autoscale.py): 0 exact
+        # f32, 1 bf16 scoring (refined => still byte-sound ids), 2 bf16 +
+        # lowered recall_target (certified-approximate).  Queries answered
+        # above tier 0 carry the tier name on the wire ('degraded').
+        self.degraded_tier = 0
+        self.degraded_recall = 1.0
         points = np.ascontiguousarray(points, np.float32).reshape(-1, 3)
         if self._wants_sidecar(points.shape[0]):
             self.sidecar = CpuSidecar(points, spec.k)
@@ -166,11 +172,21 @@ class Tenant:
         """The pod rung: Morton-range shards behind the shared front
         door.  Pod tenants ALWAYS keep a replication log -- the committed
         seq is what a mesh snapshot stamps and what a standby mesh
-        replays past it (serve/fleet/elastic.py)."""
+        replays past it (serve/fleet/elastic.py).  The shard builds'
+        exec-cache misses are attributed to the new index's
+        ``elastic_recompiles``: a mid-session promotion (the
+        autoscaler's measured-load actuator) is index work, not a
+        serving-path recompile, so the steady-state carve-out must
+        cover it."""
+        from ...runtime import dispatch as _dispatch
+
+        m0 = _dispatch.EXEC_CACHE.misses
         self.elastic = ElasticIndex(
             points, k=self.spec.k, nshards=self.fleet.pod_shards,
             compact_threshold=self.fleet.compact_threshold,
             skew_threshold=self.fleet.pod_skew_threshold)
+        self.elastic.elastic_recompiles += \
+            _dispatch.EXEC_CACHE.misses - m0
         self.log = ReplicationLog()
 
     def maybe_promote_from_sidecar(self) -> bool:
@@ -190,13 +206,17 @@ class Tenant:
         self.promotions += 1
         return True
 
-    def maybe_promote_to_pod(self) -> bool:
+    def maybe_promote_to_pod(self, *, force: bool = False) -> bool:
         """Promote a dense tenant whose cloud grew past ``pod_threshold``
         to the elastic placement (same canonical cloud, same canonical
         ids -- both placements use np.delete/concatenate indexing).
         The replication log carries over: committed seq is placement-
-        independent."""
-        if self.daemon is None or not self._wants_pod(self.n_points):
+        independent.  ``force=True`` is the autoscaler's measured-load
+        trigger (ISSUE 19): promotion driven by sustained served rows,
+        not just the static size threshold -- the caller owns draining
+        this tenant's queued batches first."""
+        if self.daemon is None or (not force
+                                   and not self._wants_pod(self.n_points)):
             return False
         points = self.daemon.overlay.mutated_points()
         log = self.log
@@ -257,6 +277,98 @@ class Tenant:
                 rep.apply(rec)                            # proto: replication-commit.ship
                 prototrace.record("replication-commit", "ship")
 
+    # -- elastic replication + brownout (serve/fleet/autoscale.py) ------------
+
+    def add_replica(self) -> bool:
+        """Provision ONE more in-process replica (the autoscaler's
+        scale-up actuator).  The newcomer bootstraps from a snapshot of
+        the CURRENT cloud and is stamped caught-up at today's committed
+        seq -- unconditionally correct even when the tenant never logged
+        (replicas=0 history) or the primary overlay compacted its base.
+        From then on it rides the existing replication machinery: the
+        committed tail ships per record under ship_mode='sync', or
+        lazily at failover's re-ship.  The snapshot prepare shares
+        compiled launches through the executable cache's shape census,
+        so a same-signature scale-up costs zero new compiles."""
+        # proto: autoscale.scale_up
+        if self.daemon is None:
+            return False
+        if self.log is None:
+            self.log = ReplicationLog()
+        problem = KnnProblem.prepare(
+            self.daemon.overlay.mutated_points(),
+            KnnConfig(k=self.spec.k, adaptive=False))
+        rep = Replica(problem,
+                      compact_threshold=self.fleet.compact_threshold)
+        rep.applied_seq = self.log.committed_seq
+        self.replica_pool.append(rep)
+        prototrace.record("autoscale", "scale_up")
+        return True
+
+    def remove_replica(self, *, unsafe_compact: bool = False
+                       ) -> Optional[dict]:
+        """De-provision ONE replica (the autoscaler's scale-down
+        actuator).  Refuses -- returns None -- at or below the spec's
+        provisioned baseline: the policy only removes what it added.
+        The victim is the LEAST caught-up replica, so no unique progress
+        is dropped, and the log then compacts ONLY to the remaining
+        pool's applied floor (the model's no-drop-tail invariant: a
+        compaction past a survivor's applied seq would make the next
+        failover's re-ship tail unrecoverable).  ``unsafe_compact`` is
+        the seeded scale-drop-tail fault's hook: compact to the
+        committed head regardless -- the corruption check.sh must prove
+        detectable."""
+        # proto: autoscale.scale_down
+        if self.daemon is None \
+                or len(self.replica_pool) <= self.spec.replicas:
+            return None
+        target = min(self.replica_pool, key=lambda r: r.applied_seq)
+        self.replica_pool.remove(target)
+        floor = min((r.applied_seq for r in self.replica_pool),
+                    default=0)
+        dropped = 0
+        if self.log is not None:
+            dropped = self.log.compact(
+                self.log.committed_seq if unsafe_compact else floor)
+        prototrace.record("autoscale", "scale_down")
+        return {"tenant": self.spec.name,
+                "victim_seq": target.applied_seq,
+                "compacted": dropped,
+                "remaining_replicas": len(self.replica_pool)}
+
+    @property
+    def degraded_tier_name(self) -> Optional[str]:
+        """Wire name of the current brownout rung (None at exact)."""
+        if self.degraded_tier <= 0:
+            return None
+        return "bf16" if self.degraded_tier == 1 else "recall"
+
+    def brown_down(self, *, recall_target: float = 0.9,
+                   max_tier: int = 2) -> int:
+        """Step one rung DOWN the declared ladder: exact f32 -> bf16
+        scoring (brute-refined, ids still exact) -> bf16 + lowered
+        recall_target (certified-approximate).  Monotone within the
+        episode by construction: this method only ever steps down."""
+        # proto: autoscale.brown_down
+        if self.degraded_tier < max_tier:
+            self.degraded_tier += 1
+            self.degraded_recall = (1.0 if self.degraded_tier == 1
+                                    else float(recall_target))
+            prototrace.record("autoscale", "brown_down")
+        return self.degraded_tier
+
+    def brown_up(self) -> int:
+        """Step one rung back UP; at tier 0 the tenant serves exactly as
+        one that was never degraded (the byte-identity pin in
+        tests/test_autoscale.py)."""
+        # proto: autoscale.brown_up
+        if self.degraded_tier > 0:
+            self.degraded_tier -= 1
+            self.degraded_recall = (1.0 if self.degraded_tier <= 1
+                                    else self.degraded_recall)
+            prototrace.record("autoscale", "brown_up")
+        return self.degraded_tier
+
     def failover(self, *, skip_reship: bool = False) -> dict:
         """Kill the primary overlay and promote the most-caught-up
         replica: re-ship its committed tail from the log, swap its overlay
@@ -294,7 +406,8 @@ class Tenant:
                 "committed_seq": (self.log.committed_seq
                                   if self.log is not None else 0),
                 "failovers": self.failovers,
-                "promotions": self.promotions}
+                "promotions": self.promotions,
+                "degraded_tier": self.degraded_tier}
         if self.sidecar is not None:
             base.update(self.sidecar.stats_dict())
         elif self.elastic is not None:

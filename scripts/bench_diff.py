@@ -71,7 +71,8 @@ KIND_TOLERANCE = {
 #: -- the exact failure that blesses a would-OOM launch.
 STRICT_BOOLS = ("slo_ok_all", "steady_ok", "failover_ok",
                 "containment_ok", "sync_bound_ok", "recall_ok",
-                "hbm_model_ok", "migration_ok", "p999_ok")
+                "hbm_model_ok", "migration_ok", "p999_ok",
+                "autoscale_ok", "brownout_ok")
 
 RECALL_EPS = 1e-3
 

@@ -198,6 +198,38 @@ for fault in torn-migration lost-range; do
     fi
 done
 
+# Autoscale smoke (DESIGN.md section 24): a diurnal (sine-modulated
+# Poisson, client backoff) session through the fleet front door with
+# the Autoscaler live.  --assert-steady must STILL hold through the
+# scale events (zero unattributed recompiles, no failed requests), and
+# the epilogue's four assertions must pass: >= 1 scale event fired
+# (liveness), full recovery to the exact tier with every added replica
+# gone, the anti-flap tick-gap law, and the no-drop-tail replication
+# probe.
+echo "== autoscale smoke (diurnal flood + brownout ladder under --assert-steady, CPU-only) =="
+JAX_PLATFORMS=cpu python -m cuda_knearests_tpu.serve.fleet --autoscale \
+    --tenants 4 --points 6000 --rate 3000 --requests 300 --seed 3 \
+    --assert-steady || rc=1
+
+# Autoscale seeded-fault self-tests (DESIGN.md section 24, the runtime
+# twins of the autoscale model's mutants): a stuck sensor (policy reads
+# frozen truth, never reacts -> liveness assertion), a flapping policy
+# (hysteresis + cooldown bypassed -> anti-flap assertion), and a
+# scale-down that compacts the committed tail away (-> no-drop-tail
+# probe) must each be provably detected (rc != 0).
+echo "== autoscale seeded-fault self-tests (stuck-sensor / flap-policy / scale-drop-tail) =="
+for fault in stuck-sensor flap-policy scale-drop-tail; do
+    if KNTPU_FLEET_FAULT=$fault JAX_PLATFORMS=cpu \
+        python -m cuda_knearests_tpu.serve.fleet --autoscale \
+        --tenants 4 --points 6000 --rate 3000 --requests 300 --seed 3 \
+        --assert-steady >/dev/null 2>&1; then
+        echo "   FAIL: seeded autoscale fault '$fault' was not detected (rc 0)"
+        rc=1
+    else
+        echo "   ok: '$fault' detected"
+    fi
+done
+
 # MXU smoke (DESIGN.md section 16): the blocked-matmul subsystem's three
 # CPU-checkable claims -- the recall_target=1.0 byte-identity pin vs the
 # exact elementwise path (the blocked-exactness pin's CPU form), one

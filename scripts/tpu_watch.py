@@ -148,6 +148,21 @@ def _artifact_good(path: str, allow_partial: bool = False) -> bool:
             if not ln.get("proto_version") \
                     or ln.get("proto_models_ok") is not True:
                 return False
+    # diurnal-autoscale rows (ISSUE 19 tentpole) are accepted as their
+    # own row kind: a traffic-driven autoscale + brownout session.  The
+    # row must carry BOTH machine-checked verdicts and both must hold --
+    # a throughput number banked over an actuator family that never
+    # fired (autoscale_ok missing or false) or a brownout that never
+    # recovered to exact byte-identical answers (brownout_ok false) is
+    # not a record -- and, like the other fleet rows, its verdicts lean
+    # on the modeled protocols, so the proto stamp is required too.
+    for ln in lines:
+        if "diurnal_autoscale" in str(ln.get("config", "")) and not (
+                ln.get("autoscale_ok") is True
+                and ln.get("brownout_ok") is True
+                and ln.get("proto_version")
+                and ln.get("proto_models_ok") is True):
+            return False
     # pod weak-scaling rows (ISSUE 12 satellite) are accepted as their own
     # row kind: unit 'queries/sec/chip' with pod_scaling=true.  A pod row
     # must carry its halo accounting (halo_bytes + ring_depth) and the

@@ -1,0 +1,258 @@
+"""Autoscale + brownout ladder pins (serve/fleet/autoscale.py, ISSUE 19).
+
+What is pinned here and why:
+
+* the policy's hysteresis/anti-flap law -- an actuation requires
+  ``breach_streak`` CONSECUTIVE breach ticks and opens a cooldown, so
+  consecutive same-class actuations are separated by MORE than
+  ``cooldown_ticks`` ticks (the exact structural property the
+  --autoscale smoke's anti-flap assertion checks, and the property the
+  flap-policy seeded fault provably breaks);
+* scale-down safety -- ``remove_replica`` refuses at the provisioned
+  baseline, compacts the replication log only to the surviving pool's
+  applied floor, and the unsafe (seeded-fault) compaction makes the next
+  failover's re-ship provably unrecoverable;
+* the brownout ladder's byte-identity law -- a browned tenant stamps its
+  tier on the wire, tier-1 ids stay exact (brute-refined), and after
+  brown-up the tenant answers BYTE-IDENTICALLY to before the episode
+  (degradation is an episode, not a ratchet);
+* seeded-fault liveness -- a stuck sensor freezes the first snapshot and
+  the policy provably never reacts.
+
+The end-to-end diurnal session (all three actuator families under a
+sine-modulated flood) is the check.sh --autoscale smoke and the
+``diurnal_autoscale`` bench row; these tests pin the laws one actuator
+at a time so a regression names the broken rung.
+"""
+
+import numpy as np
+import pytest
+
+from cuda_knearests_tpu.config import ServeFleetConfig
+from cuda_knearests_tpu.io import generate_uniform
+from cuda_knearests_tpu.serve.fleet import (AutoscaleConfig, Autoscaler,
+                                            FleetDaemon, TenantSpec,
+                                            TIER_NAMES)
+
+CFG = ServeFleetConfig(min_bucket=8, max_batch=64, compact_threshold=64,
+                       warmup=True, sidecar_threshold=192, drr_quantum=16)
+
+
+def _mk_fleet(**as_kw):
+    """Two dense throughput tenants (lazy shipping so replicas genuinely
+    lag and the compaction floor is observable) behind an autoscaling
+    front door."""
+    builds = [
+        (TenantSpec(name="a", k=6, slo="throughput", ship_mode="lazy"),
+         generate_uniform(256, seed=1)),
+        (TenantSpec(name="b", k=6, slo="throughput", ship_mode="lazy"),
+         generate_uniform(256, seed=2)),
+    ]
+    return FleetDaemon(builds, CFG,
+                       autoscale=AutoscaleConfig(period_s=0.01, **as_kw))
+
+
+def _query_through(fleet, req_id, tenant, queries, k=None):
+    out = fleet.submit(req_id, tenant, "query", queries, k=k)
+    out += fleet.drain()
+    mine = [r for r in out if r.req_id == req_id]
+    assert len(mine) == 1, [r.error for r in out if not r.ok]
+    return mine[0]
+
+
+def _force_sense(sc: Autoscaler, breach_flag):
+    """Replace the sensor pass with a deterministic one: ``breach_flag``
+    is a 1-element list the test flips; everything else reads idle."""
+    def fake(now):
+        b = bool(breach_flag[0])
+        out = {"throughput": {
+            "queue_rows": 999 if b else 0, "refused_delta": 0,
+            "served_delta": 0, "p999_ms": None,
+            "breach": b, "clear": not b}}
+        sc.last_sensors = out
+        return out
+    sc._sense = fake
+
+
+def _run_ticks(fleet, n, start=0.0):
+    sc = fleet.autoscaler
+    per = sc.config.period_s
+    for i in range(n):
+        sc.tick(start + (i + 1) * per * 1.01)
+
+
+# -- policy law: hysteresis + anti-flap ---------------------------------------
+
+def test_no_actuation_below_breach_streak():
+    fleet = _mk_fleet(breach_streak=3)
+    sc = fleet.autoscaler
+    breach = [False]
+    _force_sense(sc, breach)
+    sc.tick(0.0)                      # arm the period
+    # alternate breach/idle: the streak resets every other tick and the
+    # hysteresis gate must never open
+    for i in range(12):
+        breach[0] = i % 2 == 0
+        sc.tick((i + 1) * 0.011)
+    assert not sc.events
+    assert sc.counters["scale_up"] == 0
+
+
+def test_anti_flap_gap_exceeds_cooldown():
+    fleet = _mk_fleet()
+    sc = fleet.autoscaler
+    cfg = sc.config
+    breach = [True]
+    _force_sense(sc, breach)
+    sc.tick(0.0)
+    _run_ticks(fleet, 20, start=0.0)
+    ticks = [ev["tick"] for ev in sc.events]
+    assert len(ticks) >= 2, "sustained breach must actuate repeatedly"
+    assert ticks[0] == cfg.breach_streak
+    # the structural law the flap-policy fault breaks: consecutive
+    # actuations in one class are separated by MORE than the cooldown
+    for a, b in zip(ticks, ticks[1:]):
+        assert b - a > cfg.cooldown_ticks
+
+
+def test_flap_policy_fault_breaks_the_gap_law():
+    fleet = _mk_fleet()
+    fleet._fault = "flap-policy"
+    sc = fleet.autoscaler
+    breach = [True]
+    _force_sense(sc, breach)
+    sc.tick(0.0)
+    _run_ticks(fleet, 6, start=0.0)
+    ticks = [ev["tick"] for ev in sc.events]
+    assert len(ticks) >= 2
+    # back-to-back actuations: exactly what the smoke's anti-flap
+    # assertion (and the autoscale model's mutant) must catch
+    assert any(b - a <= sc.config.cooldown_ticks
+               for a, b in zip(ticks, ticks[1:]))
+
+
+def test_stuck_sensor_fault_freezes_policy_liveness():
+    fleet = _mk_fleet()
+    fleet._fault = "stuck-sensor"
+    sc = fleet.autoscaler
+    sc.tick(0.0)
+    sc.tick(0.011)                    # first REAL sample (idle) freezes
+    assert sc._frozen is not None
+    # pile up genuine load the frozen sensor can never see
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        fleet.submit(100 + i, "a", "query",
+                     (rng.random((32, 3)) * 100.0 + 5.0).astype(
+                         np.float32), now=0.012)
+    _run_ticks(fleet, 12, start=0.011)
+    assert sc._sense(1.0) is sc._frozen
+    assert not sc.events, "a stuck sensor must starve the policy"
+
+
+# -- scale-down safety: baseline refusal + the compaction floor ---------------
+
+def test_remove_replica_refuses_at_baseline():
+    fleet = _mk_fleet()
+    t = fleet.tenants["a"]
+    assert t.remove_replica() is None
+    assert t.add_replica()
+    assert t.remove_replica() is not None
+    assert t.remove_replica() is None     # back at the baseline
+
+
+def test_safe_scale_down_compacts_only_to_applied_floor():
+    fleet = _mk_fleet()
+    t = fleet.tenants["a"]
+    assert t.add_replica()                        # replica r1 at seq 0
+    pts = (np.random.default_rng(3).random((4, 3)) * 100.0
+           + 5.0).astype(np.float32)
+    assert fleet.submit(1, "a", "insert", pts)[-1].ok   # committed seq 1
+    assert t.add_replica()                        # replica r2 born at seq 1
+    res = t.remove_replica()
+    # victim is the LEAST caught-up (r1 at 0); the floor is r2's seq 1,
+    # so exactly the shipped prefix compacts and the tail survives
+    assert res["victim_seq"] == 0
+    assert res["compacted"] == 1
+    assert list(t.log.since(1)) == []
+    with pytest.raises(RuntimeError):
+        list(t.log.since(0))          # the prefix is genuinely gone
+    # the surviving replica still fails over with zero lost mutations
+    pts2 = (np.random.default_rng(4).random((4, 3)) * 100.0
+            + 5.0).astype(np.float32)
+    before = t.daemon.overlay.mutated_points().copy()
+    assert fleet.submit(2, "a", "insert", pts2)[-1].ok
+    fo = t.failover()
+    assert fo["replayed"] == 1
+    assert np.array_equal(t.daemon.overlay.mutated_points(),
+                          np.concatenate([before, pts2]))
+
+
+def test_unsafe_compaction_makes_failover_unrecoverable():
+    fleet = _mk_fleet()
+    fleet._fault = "scale-drop-tail"
+    t = fleet.tenants["b"]
+    assert t.add_replica() and t.add_replica()    # both at seq 0
+    pts = (np.random.default_rng(5).random((4, 3)) * 100.0
+           + 5.0).astype(np.float32)
+    assert fleet.submit(3, "b", "insert", pts)[-1].ok
+    res = t.remove_replica(unsafe_compact=True)
+    assert res["compacted"] == 1      # compacted past the survivor's seq
+    with pytest.raises(RuntimeError):
+        t.failover()                  # the re-ship tail is gone
+
+
+# -- brownout ladder: wire stamp + byte identity ------------------------------
+
+def test_brownout_stamps_wire_and_recovers_byte_identical():
+    fleet = _mk_fleet()
+    t = fleet.tenants["a"]
+    q = t.daemon.overlay.mutated_points()[:5].copy()
+    pre = _query_through(fleet, 11, "a", q)
+    assert pre.ok and pre.degraded is None
+    assert "degraded" not in pre.to_wire()
+
+    assert t.brown_down() == 1 and t.degraded_tier_name == "bf16"
+    mid = _query_through(fleet, 12, "a", q)
+    assert mid.ok and mid.degraded == "bf16"
+    assert mid.to_wire()["degraded"] == "bf16"
+    # tier 1 is brute-refined: scoring precision drops, ids must not
+    assert np.array_equal(mid.ids, pre.ids)
+
+    assert t.brown_down() == 2 and t.degraded_tier_name == "recall"
+    deep = _query_through(fleet, 13, "a", q)
+    assert deep.ok and deep.degraded == "recall"
+    assert t.brown_down(max_tier=2) == 2      # the ladder has a floor
+
+    assert t.brown_up() == 1 and t.brown_up() == 0 and t.brown_up() == 0
+    post = _query_through(fleet, 14, "a", q)
+    assert post.ok and post.degraded is None
+    # the recovery law: a tenant that walked the ladder answers exactly
+    # like one that never degraded
+    assert np.array_equal(pre.ids, post.ids)
+    assert np.array_equal(pre.d2, post.d2)
+    assert TIER_NAMES == ("exact", "bf16", "recall")
+
+
+def test_shed_refuses_queries_typed_but_never_mutations():
+    fleet = _mk_fleet()
+    sc = fleet.autoscaler
+    t = fleet.tenants["a"]
+    q = t.daemon.overlay.mutated_points()[:3].copy()
+    sc.shed_until["throughput"] = fleet.clock() + 60.0
+    r = fleet.submit(21, "a", "query", q)[0]
+    assert not r.ok and r.retry_after_ms is not None \
+        and r.retry_after_ms > 0
+    pts = (np.random.default_rng(6).random((4, 3)) * 100.0
+           + 5.0).astype(np.float32)
+    assert fleet.submit(22, "a", "insert", pts)[-1].ok   # never shed
+
+
+def test_promotion_resets_brownout_stamp():
+    fleet = _mk_fleet()
+    sc = fleet.autoscaler
+    t = fleet.tenants["a"]
+    assert t.brown_down() == 1
+    assert sc._promote(t, fleet.clock())
+    assert t.is_pod
+    assert t.degraded_tier == 0 and t.degraded_recall == 1.0
+    assert t.degraded_tier_name is None

@@ -296,6 +296,58 @@ def test_artifact_good_requires_proto_stamp_on_fleet_rows(tmp_path):
     assert tpu_watch._artifact_good(str(p))
 
 
+def test_artifact_good_diurnal_autoscale_row_kind(tmp_path):
+    """ISSUE 19 satellite: a diurnal_autoscale row is a claim that the
+    fleet re-provisioned itself (autoscale_ok) AND walked the brownout
+    ladder down and back byte-identically (brownout_ok) -- a QPS number
+    banked without either verdict could have been bought by silently
+    dropping requests or by never recovering to the exact tier.  Both
+    booleans are strict in bench_diff, and the proto stamp is mandatory
+    here too (the policy machine is a modeled protocol)."""
+    p = tmp_path / "da.json"
+    good_row = {"platform": "tpu", "unit": "queries/sec", "value": 8000.0,
+                "config": "serving fleet [diurnal_autoscale]: 6 tenants "
+                          "under sine-modulated flood",
+                "recall": 1.0, "precision": "f32",
+                "autoscale_ok": True, "brownout_ok": True,
+                "proto_version": "1.1.0", "proto_models_ok": True}
+    p.write_text(json.dumps({"rc": 0, "lines": [good_row]}))
+    assert tpu_watch._artifact_good(str(p))
+    for flag in ("autoscale_ok", "brownout_ok"):
+        # verdict missing entirely -> refused
+        p.write_text(json.dumps({"rc": 0, "lines": [
+            {k: v for k, v in good_row.items() if k != flag}]}))
+        assert not tpu_watch._artifact_good(str(p)), flag
+        # verdict false -> refused
+        p.write_text(json.dumps({"rc": 0, "lines": [
+            dict(good_row, **{flag: False})]}))
+        assert not tpu_watch._artifact_good(str(p)), flag
+    # proto stamp missing / dirty -> refused (same law as the other
+    # fleet row kinds)
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        {k: v for k, v in good_row.items()
+         if k not in ("proto_version", "proto_models_ok")}]}))
+    assert not tpu_watch._artifact_good(str(p))
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        dict(good_row, proto_models_ok=False)]}))
+    assert not tpu_watch._artifact_good(str(p))
+    # non-autoscale rows are unaffected by the new row-kind law
+    p.write_text(json.dumps({"rc": 0, "lines": [
+        {"platform": "tpu", "unit": "queries/sec", "value": 1.0,
+         "recall": 1.0, "precision": "f32", "config": "other row"}]}))
+    assert tpu_watch._artifact_good(str(p))
+    # and bench_diff treats both verdicts as strict booleans
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff_da", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "bench_diff.py"))
+    bd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bd)
+    assert "autoscale_ok" in bd.STRICT_BOOLS
+    assert "brownout_ok" in bd.STRICT_BOOLS
+
+
 # -- kntpu-scope capture harness (ISSUE 15) -----------------------------------
 
 def _capture_row(platform="tpu", **over):
